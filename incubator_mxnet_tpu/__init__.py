@@ -1,0 +1,53 @@
+"""incubator_mxnet_tpu — a TPU-native deep learning framework.
+
+A from-scratch re-design of Apache MXNet 2.0's capabilities
+(reference: /root/reference, see SURVEY.md) on the JAX/XLA/Pallas stack:
+
+  - `mx.np` / `mx.npx`  NumPy frontend + NN extensions (≙ python/mxnet/numpy*)
+  - `mx.nd`             legacy-style NDArray namespace
+  - `mx.autograd`       tape-based AD (≙ src/imperative/imperative.cc taping)
+  - `mx.gluon`          Block/HybridBlock/Trainer, nn/rnn layers, data, zoo
+  - `mx.optimizer`      fused optimizer updates
+  - `mx.kvstore`        Push/Pull facade over XLA collectives (≙ src/kvstore)
+  - `mx.parallel`       SPMD meshes, DP/TP/SP sharding, ring attention
+  - `mx.amp`            bf16 automatic mixed precision (≙ python/mxnet/amp)
+  - `mx.profiler`       Chrome-trace profiling (≙ src/profiler)
+
+Typical use:  import incubator_mxnet_tpu as mx
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError, get_env, set_env, env_flags
+from .device import (Device, Context, cpu, gpu, tpu, num_gpus, num_tpus,
+                     current_device, current_context, device_memory_info,
+                     gpu_memory_info)
+from .ndarray import NDArray, waitall
+from . import ndarray
+from . import ndarray as nd
+from . import numpy as np
+from . import numpy_extension as npx
+from . import autograd
+from . import random
+from .random import seed
+from . import ops
+
+# Heavier subsystems import lazily to keep `import mx` fast and allow partial
+# builds during bring-up.
+_LAZY = ("gluon", "optimizer", "kvstore", "parallel", "amp", "profiler",
+         "initializer", "lr_scheduler", "metric", "test_utils", "util",
+         "runtime", "io", "image", "engine", "context")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

@@ -1,0 +1,181 @@
+"""Base utilities: error hierarchy, dtype system, environment-flag layer.
+
+TPU-native re-design of the reference's foundations:
+  - error hierarchy  <- python/mxnet/error.py + src/nnvm/error.h (typed MXNetError tree)
+  - dtype table      <- 3rdparty/mshadow/mshadow/base.h:355-365 (MSHADOW_TYPE_SWITCH)
+  - env flags        <- dmlc::GetEnv use sites; docs/static_site/src/pages/api/faq/env_var.md
+
+Nothing here touches jax at import time beyond numpy dtypes, so the flag layer can be
+used to configure XLA before the first device touch.
+"""
+from __future__ import annotations
+
+import os
+import numpy as _np
+
+__all__ = [
+    "MXNetError", "NotImplementedForSymbol", "InternalError", "ValueError_",
+    "TypeError_", "IndexError_", "AttributeError_", "NotImplementedError_",
+    "string_types", "numeric_types", "integer_types",
+    "DTYPE_NAMES", "name_to_dtype", "dtype_to_name",
+    "get_env", "set_env", "env_flags",
+]
+
+
+# ---------------------------------------------------------------------------
+# Error hierarchy (reference: python/mxnet/error.py register() pattern)
+# ---------------------------------------------------------------------------
+class MXNetError(RuntimeError):
+    """Base error for all framework errors (reference: python/mxnet/error.py:27)."""
+
+
+class InternalError(MXNetError):
+    """Framework-internal invariant violation."""
+
+
+class NotImplementedForSymbol(MXNetError):
+    """Operation unavailable in traced/deferred mode (reference: mxnet/base.py)."""
+
+
+class ValueError_(MXNetError, ValueError):
+    pass
+
+
+class TypeError_(MXNetError, TypeError):
+    pass
+
+
+class IndexError_(MXNetError, IndexError):
+    pass
+
+
+class AttributeError_(MXNetError, AttributeError):
+    pass
+
+
+class NotImplementedError_(MXNetError, NotImplementedError):
+    pass
+
+
+ERROR_TYPES = {
+    "ValueError": ValueError_,
+    "TypeError": TypeError_,
+    "IndexError": IndexError_,
+    "AttributeError": AttributeError_,
+    "NotImplementedError": NotImplementedError_,
+    "InternalError": InternalError,
+}
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+
+# ---------------------------------------------------------------------------
+# Dtype system (reference: mshadow/base.h dtype enum; bf16 is first-class on TPU)
+# ---------------------------------------------------------------------------
+# Names follow the reference's python-visible dtype strings.
+DTYPE_NAMES = (
+    "float32", "float64", "float16", "bfloat16",
+    "uint8", "int8", "int16", "int32", "int64", "bool",
+)
+
+_NAME_TO_DTYPE = {
+    "float32": _np.dtype("float32"),
+    "float64": _np.dtype("float64"),
+    "float16": _np.dtype("float16"),
+    "uint8": _np.dtype("uint8"),
+    "int8": _np.dtype("int8"),
+    "int16": _np.dtype("int16"),
+    "int32": _np.dtype("int32"),
+    "int64": _np.dtype("int64"),
+    "bool": _np.dtype("bool"),
+}
+
+
+def _bfloat16():
+    # ml_dtypes ships with jax; resolved lazily so base.py imports stay cheap.
+    import ml_dtypes
+    return _np.dtype(ml_dtypes.bfloat16)
+
+
+def name_to_dtype(name):
+    """Resolve a dtype name/object to a numpy dtype (bf16 aware)."""
+    if name is None:
+        return _np.dtype("float32")
+    if isinstance(name, str):
+        if name == "bfloat16":
+            return _bfloat16()
+        if name in _NAME_TO_DTYPE:
+            return _NAME_TO_DTYPE[name]
+    return _np.dtype(name)
+
+
+def dtype_to_name(dtype):
+    d = _np.dtype(dtype) if not isinstance(dtype, _np.dtype) else dtype
+    if d.name == "bfloat16":
+        return "bfloat16"
+    return d.name
+
+
+# ---------------------------------------------------------------------------
+# Environment flag layer (reference: 103 documented MXNET_* knobs, env_var.md)
+# ---------------------------------------------------------------------------
+# Central registry: name -> (type, default, help). Unknown flags still work via
+# get_env(); registering gives introspection parity with the reference's doc page.
+_ENV_REGISTRY = {}
+
+
+def _register_env(name, typ, default, doc):
+    _ENV_REGISTRY[name] = (typ, default, doc)
+    return name
+
+
+def env_flags():
+    """Return {name: (type, default, doc)} of registered flags (≙ env_var.md)."""
+    return dict(_ENV_REGISTRY)
+
+
+def get_env(name, default=None, typ=None):
+    """dmlc::GetEnv equivalent: typed environment lookup with registry defaults."""
+    if name in _ENV_REGISTRY:
+        rtyp, rdefault, _ = _ENV_REGISTRY[name]
+        typ = typ or rtyp
+        if default is None:
+            default = rdefault
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw not in ("0", "false", "False", "")
+    if typ is None:
+        return raw
+    return typ(raw)
+
+
+def set_env(name, value):
+    """Mirror of mx.util.set_env."""
+    os.environ[name] = str(value)
+
+
+# Registered flags (TPU-native equivalents of the reference's engine/memory knobs;
+# the ThreadedEngine/GPU-pool knobs collapse into XLA/PJRT configuration).
+_register_env("MXNET_TEST_SEED", int, None, "Fixed seed for test reproducibility")
+_register_env("MXNET_MODULE_SEED", int, None, "Module-level test seed")
+_register_env("MXNET_ENGINE_TYPE", str, "XLA",
+              "Execution engine; only 'XLA' (async PJRT dispatch) and 'Naive' "
+              "(block after every op) are meaningful on TPU")
+_register_env("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
+              "Whether hybridized training steps fuse fwd+bwd+update into one XLA program")
+_register_env("MXNET_USE_FUSION", bool, True,
+              "Kept for API parity; XLA always fuses pointwise chains")
+_register_env("MXNET_SAFE_ACCUMULATION", bool, True,
+              "Accumulate bf16/fp16 reductions in float32")
+_register_env("MXNET_PROFILER_AUTOSTART", bool, False, "Start profiler at import")
+_register_env("MXNET_PROFILER_MODE", str, "symbolic", "Profiler mode")
+_register_env("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", bool, True,
+              "Log when an op falls back to host (numpy) execution")
+_register_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 19,
+              "Arrays above this many elements use flat-bucket allreduce")
+_register_env("MXNET_DEFAULT_DEVICE", str, None,
+              "Override default device, e.g. 'tpu(0)' or 'cpu(0)'")

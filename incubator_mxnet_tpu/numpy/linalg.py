@@ -1,0 +1,48 @@
+"""mx.np.linalg — linear algebra namespace.
+
+Reference: src/operator/numpy/linalg/np_{eig,gesvd,lstsq,norm,pinv,potrf,qr,
+solve,...}* (LAPACK/cuSOLVER backed) and src/operator/tensor/la_op.* (3.3k LoC).
+TPU-native: XLA's native decompositions via jax.numpy.linalg — the whole
+c_lapack_api shim layer disappears.
+"""
+from __future__ import annotations
+
+from ..ndarray import NDArray
+from ..ops.registry import invoke
+
+_NAMES = [
+    "norm", "det", "slogdet", "inv", "pinv", "solve", "lstsq", "matrix_rank",
+    "matrix_power", "cholesky", "qr", "svd", "svdvals", "eig", "eigh",
+    "eigvals", "eigvalsh", "multi_dot", "tensorinv", "tensorsolve", "cond",
+    "cross", "outer", "matmul", "tensordot", "vector_norm", "matrix_norm",
+]
+
+
+def _make(name):
+    def fn(*args, **kwargs):
+        import jax.numpy as jnp
+        import jax.tree_util as jtu
+        jfn = getattr(jnp.linalg, name)
+        leaves, treedef = jtu.tree_flatten((args, kwargs))
+        pos = [i for i, l in enumerate(leaves) if isinstance(l, NDArray)]
+        arrs = tuple(leaves[i] for i in pos)
+
+        def call(*raws):
+            ls = list(leaves)
+            for i, r in zip(pos, raws):
+                ls[i] = r
+            a, kw = jtu.tree_unflatten(treedef, ls)
+            out = jfn(*a, **kw)
+            return tuple(out) if isinstance(out, tuple) else out
+
+        return invoke(call, arrs, name="linalg." + name)
+
+    fn.__name__ = name
+    return fn
+
+
+import jax.numpy as _jnp_mod  # noqa: E402
+for _n in _NAMES:
+    if hasattr(_jnp_mod.linalg, _n):
+        globals()[_n] = _make(_n)
+__all__ = [n for n in _NAMES if n in globals()]

@@ -1,0 +1,279 @@
+"""mx.np.random — samplers over the global (or trace-scoped) PRNG key.
+
+Reference: src/operator/numpy/random/np_*_op.* (4.2k LoC of curand/CPU sampler
+kernels) + python/mxnet/numpy/random.py. TPU-native: jax.random bitgen; the
+per-device parallel-random resources collapse into functional key splitting
+(see incubator_mxnet_tpu/random.py).
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..base import name_to_dtype
+from ..ndarray import NDArray, _wrap, _as_nd
+from ..ops.registry import invoke
+from .. import random as _global_random
+
+__all__ = [
+    "seed", "uniform", "normal", "randn", "rand", "randint", "choice",
+    "shuffle", "permutation", "multinomial", "categorical", "bernoulli",
+    "gamma", "beta", "exponential", "poisson", "laplace", "gumbel",
+    "logistic", "pareto", "power", "rayleigh", "weibull", "lognormal",
+    "chisquare", "multivariate_normal",
+]
+
+seed = _global_random.seed
+
+
+def _jr():
+    import jax.random
+    return jax.random
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def _sample(fn_name, size, dtype, *params, jax_fn=None, **kw):
+    """Generic sampler: splits a key, invokes jax.random.<fn> through the tape
+    (samples are differentiable w.r.t. loc/scale via reparameterization when
+    params are passed as traced inputs)."""
+    key = _global_random.next_key()
+    jr = _jr()
+    jfn = jax_fn or getattr(jr, fn_name)
+    shape = _shape(size)
+    dt = name_to_dtype(dtype) if dtype else None
+    arr_params = tuple(_as_nd(p) if not isinstance(p, NDArray) else p
+                       for p in params)
+
+    def call(*raws):
+        return jfn(key, *raws, shape=shape, dtype=dt, **kw) if dt is not None \
+            else jfn(key, *raws, shape=shape, **kw)
+
+    return invoke(call, arr_params, name=f"random.{fn_name}")
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, device=None, ctx=None, out=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    shape = _shape(size)
+    dt = name_to_dtype(dtype or "float32")
+
+    def call(lo, hi):
+        u = jr.uniform(key, shape, dt.base if hasattr(dt, "base") else dt)
+        return lo + (hi - lo) * u
+
+    res = invoke(call, (_as_nd(low, dtype=dt), _as_nd(high, dtype=dt)),
+                 name="random.uniform")
+    if out is not None:
+        out[:] = res
+        return out
+    return res
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None, out=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    shape = _shape(size)
+    dt = name_to_dtype(dtype or "float32")
+
+    def call(mu, sigma):
+        return mu + sigma * jr.normal(key, shape, dt)
+
+    res = invoke(call, (_as_nd(loc, dtype=dt), _as_nd(scale, dtype=dt)),
+                 name="random.normal")
+    if out is not None:
+        out[:] = res
+        return out
+    return res
+
+
+def randn(*size, dtype=None):
+    return normal(0.0, 1.0, size=size or None, dtype=dtype)
+
+
+def rand(*size, dtype=None):
+    return uniform(0.0, 1.0, size=size or None, dtype=dtype)
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None):
+    from . import exp as _  # noqa: F401  (avoid circular at module load)
+    n = normal(mean, sigma, size=size, dtype=dtype)
+    return invoke(lambda x: __import__("jax.numpy", fromlist=["exp"]).exp(x),
+                  (n,), name="random.lognormal")
+
+
+def randint(low, high=None, size=None, dtype=None, device=None, ctx=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    if high is None:
+        low, high = 0, low
+    dt = name_to_dtype(dtype or "int32")
+    return _wrap(jr.randint(key, _shape(size), int(low), int(high), dt))
+
+
+def choice(a, size=None, replace=True, p=None, device=None, ctx=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    if isinstance(a, NDArray):
+        a = a._arr
+    pr = p._arr if isinstance(p, NDArray) else p
+    return _wrap(jr.choice(key, a, _shape(size), replace, pr))
+
+
+def permutation(x):
+    key = _global_random.next_key()
+    jr = _jr()
+    if isinstance(x, NDArray):
+        return _wrap(jr.permutation(key, x._arr))
+    return _wrap(jr.permutation(key, int(x)))
+
+
+def shuffle(x):
+    """In-place row shuffle (≙ mx.np.random.shuffle)."""
+    key = _global_random.next_key()
+    jr = _jr()
+    x._set_arr(jr.permutation(key, x._arr))
+
+
+def multinomial(n, pvals, size=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    pv = pvals._arr if isinstance(pvals, NDArray) else _onp.asarray(pvals)
+    shape = _shape(size)
+    counts = jr.multinomial(key, n, pv, shape=shape + _onp.shape(pv)[:-1]
+                            if shape else None)
+    return _wrap(counts)
+
+
+def categorical(logits, shape=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    lg = logits._arr if isinstance(logits, NDArray) else logits
+    return _wrap(jr.categorical(key, lg, shape=_shape(shape) if shape else None))
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    import jax.numpy as jnp
+    dt = name_to_dtype(dtype or "float32")
+    if prob is None:
+        p = jnp.squeeze(1.0 / (1.0 + jnp.exp(
+            -(logit._arr if isinstance(logit, NDArray) else logit))))
+    else:
+        p = prob._arr if isinstance(prob, NDArray) else prob
+    shape = _shape(size) if size is not None else _onp.shape(p)
+    return _wrap(jr.bernoulli(key, p, shape).astype(dt))
+
+
+def gamma(shape_param, scale=1.0, size=None, dtype=None, device=None, ctx=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    sh = _shape(size) if size is not None else None
+
+    def call(a, s):
+        g = jr.gamma(key, a, shape=sh)
+        return g * s
+
+    return invoke(call, (_as_nd(shape_param), _as_nd(scale)), name="random.gamma")
+
+
+def beta(a, b, size=None, dtype=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    sh = _shape(size) if size is not None else None
+    return invoke(lambda x, y: jr.beta(key, x, y, shape=sh),
+                  (_as_nd(a), _as_nd(b)), name="random.beta")
+
+
+def exponential(scale=1.0, size=None, dtype=None, device=None, ctx=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    sh = _shape(size)
+    return invoke(lambda s: jr.exponential(key, shape=sh) * s,
+                  (_as_nd(scale),), name="random.exponential")
+
+
+def poisson(lam=1.0, size=None, dtype=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    return _wrap(jr.poisson(key, lam._arr if isinstance(lam, NDArray) else lam,
+                            shape=_shape(size) if size is not None else None))
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, device=None, ctx=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    sh = _shape(size)
+    return invoke(lambda m, s: m + s * jr.laplace(key, shape=sh),
+                  (_as_nd(loc), _as_nd(scale)), name="random.laplace")
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, dtype=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    sh = _shape(size)
+    return invoke(lambda m, s: m + s * jr.gumbel(key, shape=sh),
+                  (_as_nd(loc), _as_nd(scale)), name="random.gumbel")
+
+
+def logistic(loc=0.0, scale=1.0, size=None, dtype=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    sh = _shape(size)
+    return invoke(lambda m, s: m + s * jr.logistic(key, shape=sh),
+                  (_as_nd(loc), _as_nd(scale)), name="random.logistic")
+
+
+def pareto(a, size=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    sh = _shape(size) if size is not None else None
+    return invoke(lambda b: jr.pareto(key, b, shape=sh) - 1.0,
+                  (_as_nd(a),), name="random.pareto")
+
+
+def power(a, size=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    import jax.numpy as jnp
+    sh = _shape(size)
+    return invoke(lambda b: jnp.power(jr.uniform(key, sh), 1.0 / b),
+                  (_as_nd(a),), name="random.power")
+
+
+def rayleigh(scale=1.0, size=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    import jax.numpy as jnp
+    sh = _shape(size)
+    return invoke(
+        lambda s: s * jnp.sqrt(-2.0 * jnp.log(jr.uniform(key, sh, minval=1e-20))),
+        (_as_nd(scale),), name="random.rayleigh")
+
+
+def weibull(a, size=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    import jax.numpy as jnp
+    sh = _shape(size)
+    return invoke(
+        lambda b: jnp.power(-jnp.log(jr.uniform(key, sh, minval=1e-20)), 1.0 / b),
+        (_as_nd(a),), name="random.weibull")
+
+
+def chisquare(df, size=None, dtype=None):
+    return gamma(df / 2.0, 2.0, size=size, dtype=dtype)
+
+
+def multivariate_normal(mean, cov, size=None):
+    key = _global_random.next_key()
+    jr = _jr()
+    sh = _shape(size) if size is not None else None
+    return invoke(lambda m, c: jr.multivariate_normal(key, m, c, shape=sh),
+                  (_as_nd(mean), _as_nd(cov)), name="random.multivariate_normal")

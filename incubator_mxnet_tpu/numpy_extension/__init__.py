@@ -1,0 +1,376 @@
+"""mx.npx — NumPy extensions: NN operators + control flow.
+
+Reference: python/mxnet/numpy_extension (the `_npx_*` op namespace: activations,
+softmax, pick, topk, control flow `_npx_foreach/_npx_while_loop/_npx_cond`
+(src/operator/npx_control_flow.cc:513-918), sequence ops, set_np scope).
+TPU-native: wrappers over ops/nn.py jax compositions; control flow lowers to
+lax.scan / lax.while_loop / lax.cond — autograd through foreach/cond is native
+jax vjp; while_loop is forward-only exactly like XLA requires.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _onp
+
+from ..base import MXNetError, name_to_dtype
+from ..ndarray import NDArray, _as_nd, _wrap
+from ..ops.registry import invoke, register_op
+from ..ops import nn as _nn
+from .. import random as _grandom
+from .. import autograd as _autograd
+
+__all__ = [
+    "relu", "sigmoid", "tanh", "softmax", "log_softmax", "masked_softmax",
+    "gelu", "leaky_relu", "elu", "selu", "silu", "swish", "activation",
+    "one_hot", "pick", "topk", "sequence_mask", "embedding", "dropout",
+    "batch_norm", "layer_norm", "group_norm", "instance_norm", "rms_norm",
+    "l2_normalization", "fully_connected", "convolution", "deconvolution",
+    "pooling", "foreach", "while_loop", "cond", "scan",
+    "set_np", "reset_np", "is_np_array", "is_np_shape", "use_np", "erf",
+    "erfinv", "gamma", "gammaln", "digamma", "multi_sum_sq", "clip_by_global_norm",
+    "arange_like", "broadcast_like", "shape_array", "stop_gradient",
+    "smooth_l1", "scaled_dot_product_attention",
+]
+
+
+def _unary(jfn, name):
+    def fn(x, **kwargs):
+        return invoke(functools.partial(jfn, **kwargs) if kwargs else jfn,
+                      (_as_nd(x),), name=name)
+    fn.__name__ = name
+    register_op("npx." + name, fn)
+    return fn
+
+
+def _make_nn(fname, name=None):
+    f = getattr(_nn, fname)
+
+    def fn(*arrays, **kwargs):
+        arrs = tuple(_as_nd(a) if not isinstance(a, NDArray) else a
+                     for a in arrays)
+        return invoke(functools.partial(f, **kwargs) if kwargs else f,
+                      arrs, name=name or fname)
+    fn.__name__ = name or fname
+    register_op("npx." + (name or fname), fn)
+    return fn
+
+
+import jax  # noqa: E402
+import jax.numpy as _jnp  # noqa: E402
+
+relu = _unary(jax.nn.relu, "relu")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+tanh = _unary(_jnp.tanh, "tanh")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+gamma = _unary(lambda x: _jnp.exp(jax.scipy.special.gammaln(x)), "gamma")
+gammaln = _unary(jax.scipy.special.gammaln, "gammaln")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+softplus = _unary(jax.nn.softplus, "softplus")
+log_sigmoid = _unary(jax.nn.log_sigmoid, "log_sigmoid")
+silu = _unary(jax.nn.silu, "silu")
+swish = silu
+stop_gradient = _unary(jax.lax.stop_gradient, "stop_gradient")
+
+softmax = _make_nn("softmax")
+log_softmax = _make_nn("log_softmax")
+masked_softmax = _make_nn("masked_softmax")
+activation = _make_nn("activation")
+layer_norm = _make_nn("layer_norm")
+group_norm = _make_nn("group_norm")
+instance_norm = _make_nn("instance_norm")
+rms_norm = _make_nn("rms_norm")
+l2_normalization = _make_nn("l2_normalize", "l2_normalization")
+one_hot = _make_nn("one_hot")
+pick = _make_nn("pick")
+topk = _make_nn("topk")
+sequence_mask = _make_nn("sequence_mask")
+embedding = _make_nn("embedding")
+scaled_dot_product_attention = _make_nn("scaled_dot_product_attention")
+
+
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, **kwargs):
+    arrs = (_as_nd(data),)
+    kw = dict(act_type=act_type, slope=slope, **kwargs)
+    if act_type == "prelu":
+        return invoke(lambda x, g: _nn.leaky_relu(x, "prelu", gamma=g),
+                      (_as_nd(data), _as_nd(gamma)), name="leaky_relu")
+    if act_type == "rrelu" and _autograd.is_training():
+        kw["key"] = _grandom.next_key()
+        kw["training"] = True
+    return invoke(functools.partial(_nn.leaky_relu, **kw), arrs,
+                  name="leaky_relu")
+
+
+def gelu(x, approximate=False):
+    return invoke(functools.partial(jax.nn.gelu, approximate=approximate),
+                  (_as_nd(x),), name="gelu")
+
+
+def elu(x, alpha=1.0):
+    return invoke(functools.partial(jax.nn.elu, alpha=alpha), (_as_nd(x),),
+                  name="elu")
+
+
+def selu(x):
+    return invoke(jax.nn.selu, (_as_nd(x),), name="selu")
+
+
+def dropout(data, p=0.5, axes=None, training=None):
+    if training is None:
+        training = _autograd.is_training()
+    if not training or p <= 0:
+        return _as_nd(data)
+    key = _grandom.next_key()
+    return invoke(lambda x: _nn.dropout(x, p, key, True, axes), (_as_nd(data),),
+                  name="dropout")
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
+               momentum=0.9, axis=1, use_global_stats=False, training=None,
+               sync_axis_name=None):
+    """Functional batch norm; returns output only and writes running stats
+    in-place on the NDArrays (eager path). Inside traces use ops.nn.batch_norm
+    directly through the state-update protocol (gluon/block.py)."""
+    if training is None:
+        training = _autograd.is_training()
+    out, nm, nv = invoke(
+        functools.partial(_nn.batch_norm, momentum=momentum, eps=eps,
+                          training=training, axis=axis,
+                          use_global_stats=use_global_stats,
+                          sync_axis_name=sync_axis_name),
+        (_as_nd(x), _as_nd(gamma), _as_nd(beta), _as_nd(running_mean),
+         _as_nd(running_var)),
+        name="batch_norm", multi_out=True)
+    if training and isinstance(running_mean, NDArray):
+        with _autograd.pause():
+            running_mean._set_arr(nm.detach()._arr)
+            running_var._set_arr(nv.detach()._arr)
+    return out
+
+
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    arrs = (_as_nd(x), _as_nd(weight)) + (() if no_bias or bias is None
+                                          else (_as_nd(bias),))
+    return invoke(functools.partial(_nn.dense, flatten=flatten), arrs,
+                  name="fully_connected")
+
+
+def convolution(data, weight, bias=None, kernel=None, stride=1, dilate=1,
+                pad=0, num_filter=None, num_group=1, no_bias=False,
+                layout="NCHW"):
+    arrs = (_as_nd(data), _as_nd(weight)) + (() if no_bias or bias is None
+                                             else (_as_nd(bias),))
+    return invoke(functools.partial(_nn.conv, stride=stride, padding=pad,
+                                    dilation=dilate, groups=num_group,
+                                    layout=layout),
+                  arrs, name="convolution")
+
+
+def deconvolution(data, weight, bias=None, stride=1, dilate=1, pad=0, adj=0,
+                  num_group=1, no_bias=False, layout="NCHW"):
+    arrs = (_as_nd(data), _as_nd(weight)) + (() if no_bias or bias is None
+                                             else (_as_nd(bias),))
+    return invoke(functools.partial(_nn.conv_transpose, stride=stride,
+                                    padding=pad, dilation=dilate,
+                                    output_padding=adj, groups=num_group,
+                                    layout=layout),
+                  arrs, name="deconvolution")
+
+
+def pooling(data, kernel=1, pool_type="max", stride=None, pad=0,
+            global_pool=False, count_include_pad=True, layout="NCHW"):
+    return invoke(functools.partial(_nn.pooling, kernel=kernel,
+                                    pool_type=pool_type, stride=stride,
+                                    padding=pad, global_pool=global_pool,
+                                    count_include_pad=count_include_pad,
+                                    layout=layout),
+                  (_as_nd(data),), name="pooling")
+
+
+def smooth_l1(x, scalar=1.0):
+    """reference: smooth_l1 op (src/operator/tensor/elemwise_unary_op)"""
+    def f(v):
+        s2 = scalar * scalar
+        return _jnp.where(_jnp.abs(v) < 1.0 / s2,
+                          0.5 * s2 * v * v, _jnp.abs(v) - 0.5 / s2)
+    return invoke(f, (_as_nd(x),), name="smooth_l1")
+
+
+def multi_sum_sq(*arrays):
+    """Sum of squares per array, fused (reference: multi_sum_sq op used by
+    clip_global_norm / LARS)."""
+    arrs = tuple(_as_nd(a) for a in arrays)
+    return invoke(lambda *xs: tuple(_jnp.sum(_jnp.square(x)) for x in xs),
+                  arrs, name="multi_sum_sq", multi_out=True)
+
+
+def clip_by_global_norm(arrays, max_norm):
+    """In-place global-norm clipping over a list of NDArrays; returns the norm
+    (≙ gluon.utils.clip_global_norm)."""
+    sqs = multi_sum_sq(*arrays)
+    total = sqs[0]
+    for s in sqs[1:]:
+        total = total + s
+    norm = total.sqrt()
+    scale = float(max_norm) / max(float(norm.asscalar()), float(max_norm))
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return norm
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None):
+    def f(x):
+        if axis is None:
+            n = int(_onp.prod(x.shape))
+            return _jnp.arange(start, start + step * n, step,
+                               dtype=x.dtype).reshape(x.shape)
+        n = x.shape[axis]
+        return _jnp.arange(start, start + step * n, step, dtype=x.dtype)
+    return invoke(f, (_as_nd(data),), name="arange_like")
+
+
+def broadcast_like(lhs, rhs):
+    return invoke(lambda a, b: _jnp.broadcast_to(a, b.shape),
+                  (_as_nd(lhs), _as_nd(rhs)), name="broadcast_like")
+
+
+def shape_array(data):
+    return _wrap(_jnp.asarray(_as_nd(data).shape, dtype="int64"))
+
+
+# ---------------------------------------------------------------------------
+# control flow (reference: src/operator/npx_control_flow.cc:513-918 — stateful
+# subgraph ops with LoopState; here: direct lax lowering, differentiable where
+# XLA supports it)
+# ---------------------------------------------------------------------------
+def foreach(body, data, init_states):
+    """Run `body(x_t, states) -> (out_t, new_states)` over axis 0 of data
+    (≙ _npx_foreach). Differentiable (lax.scan)."""
+    from jax import lax
+    import jax.tree_util as jtu
+    single_data = isinstance(data, NDArray)
+    datas = (data,) if single_data else tuple(data)
+    single_state = isinstance(init_states, NDArray)
+    states = (init_states,) if single_state else tuple(init_states)
+    n_data = len(datas)
+
+    def call(*raws):
+        xs = raws[:n_data]
+        ss = raws[n_data:]
+
+        def step(carry, x):
+            xs_nd = [_wrap(xi) for xi in (x if n_data > 1 else (x,))]
+            ss_nd = [_wrap(c) for c in carry]
+            out, new_s = body(xs_nd[0] if single_data else xs_nd,
+                              ss_nd[0] if single_state else ss_nd)
+            outs = (out,) if isinstance(out, NDArray) else tuple(out)
+            new_ss = (new_s,) if isinstance(new_s, NDArray) else tuple(new_s)
+            return (tuple(s._arr for s in new_ss),
+                    tuple(o._arr for o in outs))
+
+        carry, ys = lax.scan(step, tuple(ss), xs if n_data > 1 else xs[0])
+        return tuple(ys) + tuple(carry)
+
+    res = invoke(call, datas + states, name="foreach", multi_out=True)
+    n_out = len(res) - len(states)
+    outs = res[:n_out]
+    fin = res[n_out:]
+    outs = outs[0] if n_out == 1 else list(outs)
+    fin = fin[0] if single_state else list(fin)
+    return outs, fin
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations=None):
+    """≙ _npx_while_loop. Lowers to lax.while_loop — forward-only (XLA cannot
+    reverse-differentiate an unbounded loop; use `foreach` with
+    max_iterations for a differentiable variant)."""
+    from jax import lax
+    single = isinstance(loop_vars, NDArray)
+    lvs = (loop_vars,) if single else tuple(loop_vars)
+
+    def call(*raws):
+        def c(state):
+            return cond_fn(*[_wrap(s) for s in state])._arr \
+                if single is False else cond_fn(_wrap(state[0]))._arr
+
+        def b(state):
+            out = func(*[_wrap(s) for s in state]) if not single \
+                else func(_wrap(state[0]))
+            outs = (out,) if isinstance(out, NDArray) else tuple(out)
+            return tuple(o._arr for o in outs)
+
+        return lax.while_loop(c, b, tuple(raws))
+
+    res = invoke(call, lvs, name="while_loop", multi_out=True)
+    # reference returns (outputs, final_loop_vars); outputs unsupported here
+    return [], (res[0] if single else list(res))
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """≙ _npx_cond. Differentiable (lax.cond)."""
+    from jax import lax
+    inputs = inputs or []
+    single = isinstance(inputs, NDArray)
+    ins = (inputs,) if single else tuple(inputs)
+    p = pred(*ins) if callable(pred) else pred
+
+    def call(praw, *raws):
+        def t(xs):
+            out = then_func(*[_wrap(x) for x in xs]) if xs else then_func()
+            outs = (out,) if isinstance(out, NDArray) else tuple(out)
+            return tuple(o._arr for o in outs)
+
+        def f(xs):
+            out = else_func(*[_wrap(x) for x in xs]) if xs else else_func()
+            outs = (out,) if isinstance(out, NDArray) else tuple(out)
+            return tuple(o._arr for o in outs)
+
+        return lax.cond(praw.astype(bool).reshape(()), t, f, raws)
+
+    res = invoke(call, (_as_nd(p),) + ins, name="cond", multi_out=True)
+    return res[0] if len(res) == 1 else list(res)
+
+
+scan = foreach
+
+
+# ---------------------------------------------------------------------------
+# np-mode scopes (reference: mx.npx.set_np / is_np_array; the numpy frontend
+# is always-on here, kept for script compatibility)
+# ---------------------------------------------------------------------------
+_np_mode = {"array": True, "shape": True}
+
+
+def set_np(shape=True, array=True, dtype=None):
+    _np_mode["array"] = array
+    _np_mode["shape"] = shape
+
+
+def reset_np():
+    set_np()
+
+
+def is_np_array():
+    return _np_mode["array"]
+
+
+def is_np_shape():
+    return _np_mode["shape"]
+
+
+def use_np(func):
+    return func
+
+
+def load(fname):
+    from ..ndarray import load as _load
+    return _load(fname)
+
+
+def save(fname, data):
+    from ..ndarray import save as _save
+    return _save(fname, data)

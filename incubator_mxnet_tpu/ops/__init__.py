@@ -1,0 +1,13 @@
+"""Operator layer: autograd-aware invoke machinery + op registry.
+
+Reference equivalent: the NNVM op registry + Imperative::Invoke dispatch
+(src/imperative/imperative.cc:105, src/imperative/imperative_utils.h:177-288).
+On TPU there is no FCompute/FComputeEx split, no DispatchMode, and no manual
+shape/dtype inference pass: every op is a pure jax-traceable function; XLA does
+inference, fusion and memory planning. What survives from the reference design
+is (1) a single choke-point `invoke` that handles NDArray unwrap/wrap and
+autograd taping, and (2) a name registry for introspection/AMP lists.
+"""
+from .registry import invoke, register_op, get_op, list_ops, apply_op
+
+__all__ = ["invoke", "register_op", "get_op", "list_ops", "apply_op"]

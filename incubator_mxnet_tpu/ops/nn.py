@@ -1,0 +1,602 @@
+"""Pure functional NN ops on raw jax arrays — the kernel layer.
+
+Reference equivalents: src/operator/nn/* (23k LoC: conv/FC/pool/norm/softmax/
+dropout/activation C++ & CUDA kernels), src/operator/nn/cudnn/* and
+src/operator/nn/mkldnn/* backend dispatch. TPU-native: each op is a jax/lax
+composition that XLA lowers straight onto the MXU/VPU; the cuDNN/oneDNN
+descriptor + algo-autotune machinery (cudnn_algoreg-inl.h) has no equivalent
+because XLA picks conv algorithms during compilation. All functions here take
+and return raw jax arrays; NDArray wrapping/taping happens in the `npx`/gluon
+wrappers via ops.registry.invoke.
+
+Layouts: accepts NCHW (reference default) or NHWC; on TPU NHWC is the
+MXU-friendly layout and is used by the model zoo's hybridized path.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+
+def _jx():
+    import jax
+    return jax
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# dense / linear (reference: src/operator/nn/fully_connected.cc:252-323)
+# ---------------------------------------------------------------------------
+def dense(x, weight, bias=None, flatten=True):
+    """y = x @ W^T + b. `flatten=True` collapses trailing dims (reference
+    FullyConnectedParam.flatten)."""
+    jnp = _jnp()
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    y = jnp.matmul(x, weight.T)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+# ---------------------------------------------------------------------------
+# convolution (reference: src/operator/nn/convolution*.cc + im2col;
+# cudnn_convolution-inl.h collapses into lax.conv_general_dilated)
+# ---------------------------------------------------------------------------
+def _tuplize(v, n):
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v,) * n
+
+
+def conv(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+         layout="NCHW"):
+    """N-D convolution. weight layout follows the data layout
+    (OIHW for NCHW, HWIO for NHWC)."""
+    lax = _jx().lax
+    nd = x.ndim - 2
+    stride = _tuplize(stride, nd)
+    dilation = _tuplize(dilation, nd)
+    padding = _tuplize(padding, nd)
+    pads = [(p, p) for p in padding]
+    if layout.startswith("NC"):  # NCW / NCHW / NCDHW
+        spatial = layout[2:]
+        dn = lax.conv_dimension_numbers(
+            x.shape, weight.shape,
+            (layout, "OI" + spatial, layout))
+    else:  # NWC / NHWC / NDHWC
+        spatial = layout[1:-1]
+        dn = lax.conv_dimension_numbers(
+            x.shape, weight.shape,
+            (layout, spatial + "IO", layout))
+    y = lax.conv_general_dilated(
+        x, weight, stride, pads, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=None)
+    if bias is not None:
+        if layout.startswith("NC"):
+            y = y + bias.reshape((1, -1) + (1,) * nd)
+        else:
+            y = y + bias
+    return y
+
+
+def conv_transpose(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                   output_padding=0, groups=1, layout="NCHW"):
+    """Transposed convolution (reference: src/operator/nn/deconvolution*).
+    Implemented as lax.conv_transpose-equivalent via input dilation."""
+    lax = _jx().lax
+    nd = x.ndim - 2
+    stride = _tuplize(stride, nd)
+    dilation = _tuplize(dilation, nd)
+    padding = _tuplize(padding, nd)
+    output_padding = _tuplize(output_padding, nd)
+    if groups != 1:
+        raise NotImplementedError("grouped transposed conv: pending")
+    if layout.startswith("NC"):
+        spatial = layout[2:]
+        # deconv weight layout in the reference is (in, out, *k)
+        dn = lax.conv_dimension_numbers(
+            x.shape, weight.shape, (layout, "IO" + spatial, layout))
+        kdims = [weight.shape[2 + i] for i in range(nd)]
+    else:
+        spatial = layout[1:-1]
+        dn = lax.conv_dimension_numbers(
+            x.shape, weight.shape, (layout, spatial + "OI", layout))
+        kdims = [weight.shape[i] for i in range(nd)]
+    pads = []
+    for i in range(nd):
+        k = (kdims[i] - 1) * dilation[i] + 1
+        lo = k - 1 - padding[i]
+        hi = k - 1 - padding[i] + output_padding[i]
+        pads.append((lo, hi))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=(1,) * nd, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn)
+    if bias is not None:
+        if layout.startswith("NC"):
+            y = y + bias.reshape((1, -1) + (1,) * nd)
+        else:
+            y = y + bias
+    return y
+
+
+# ---------------------------------------------------------------------------
+# pooling (reference: src/operator/nn/pooling*.cc; cudnn_pooling-inl.h)
+# ---------------------------------------------------------------------------
+def pooling(x, kernel, pool_type="max", stride=None, padding=0,
+            global_pool=False, count_include_pad=True, layout="NCHW"):
+    lax = _jx().lax
+    jnp = _jnp()
+    nd = x.ndim - 2
+    channel_last = not layout.startswith("NC")
+    if global_pool:
+        axes = tuple(range(1, 1 + nd)) if channel_last else tuple(range(2, 2 + nd))
+        if pool_type == "max":
+            return jnp.max(x, axis=axes, keepdims=True)
+        return jnp.mean(x, axis=axes, keepdims=True)
+    kernel = _tuplize(kernel, nd)
+    stride = _tuplize(stride if stride is not None else kernel, nd)
+    padding = _tuplize(padding, nd)
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = ((0, 0),) + tuple((p, p) for p in padding) + ((0, 0),)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(x, 0.0 if not jnp.issubdtype(x.dtype, jnp.floating)
+                              else jnp.array(0, x.dtype),
+                              lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad or all(p == 0 for p in padding):
+            denom = _np.prod(kernel)
+            return s / _np.asarray(denom, dtype=_np.float32).astype(x.dtype)
+        ones = jnp.ones_like(x)
+        denom = lax.reduce_window(ones, jnp.array(0, x.dtype), lax.add,
+                                  window, strides, pads)
+        return s / denom
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def adaptive_avg_pool2d(x, output_size, layout="NCHW"):
+    """reference: src/operator/contrib/adaptive_avg_pooling.cc"""
+    jnp = _jnp()
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    if layout == "NCHW":
+        n, c, h, w = x.shape
+    else:
+        n, h, w, c = x.shape
+    oh, ow = output_size
+    if h % oh == 0 and w % ow == 0:
+        kh, kw = h // oh, w // ow
+        return pooling(x, (kh, kw), "avg", stride=(kh, kw), layout=layout)
+    # fallback: mean over fractional windows via resize-style gather
+    hi = _np.floor(_np.arange(oh + 1) * h / oh).astype(int)
+    wi = _np.floor(_np.arange(ow + 1) * w / ow).astype(int)
+    rows = []
+    for i in range(oh):
+        cols = []
+        for j in range(ow):
+            if layout == "NCHW":
+                patch = x[:, :, hi[i]:hi[i + 1], wi[j]:wi[j + 1]]
+                cols.append(jnp.mean(patch, axis=(2, 3)))
+            else:
+                patch = x[:, hi[i]:hi[i + 1], wi[j]:wi[j + 1], :]
+                cols.append(jnp.mean(patch, axis=(1, 2)))
+        rows.append(jnp.stack(cols, axis=-1))
+    out = jnp.stack(rows, axis=-2)
+    if layout == "NCHW":
+        return out  # (n, c, oh, ow)
+    return jnp.moveaxis(out, 1, -1)
+
+
+# ---------------------------------------------------------------------------
+# normalization (reference: src/operator/nn/batch_norm*, layer_norm*,
+# group_norm*, instance_norm.cc; SyncBatchNorm in contrib)
+# ---------------------------------------------------------------------------
+def batch_norm(x, gamma, beta, running_mean, running_var, momentum=0.9,
+               eps=1e-5, training=True, axis=1, use_global_stats=False,
+               sync_axis_name=None):
+    """Returns (out, new_running_mean, new_running_var). When
+    `sync_axis_name` is set and we're inside shard_map/pmap, batch statistics
+    are allreduced over that mesh axis (≙ contrib SyncBatchNorm,
+    src/operator/contrib/sync_batch_norm-inl.h — cross-device moments)."""
+    jnp = _jnp()
+    lax = _jx().lax
+    reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    bshape = [1] * x.ndim
+    bshape[axis % x.ndim] = x.shape[axis % x.ndim]
+    if training and not use_global_stats:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=reduce_axes)
+        mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
+        if sync_axis_name is not None:
+            mean = lax.pmean(mean, sync_axis_name)
+            mean_sq = lax.pmean(mean_sq, sync_axis_name)
+        var = mean_sq - jnp.square(mean)
+        new_rm = momentum * running_mean + (1 - momentum) * mean
+        new_rv = momentum * running_var + (1 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    out = (x.astype(jnp.float32) - mean.reshape(bshape)) * inv.reshape(bshape)
+    out = out.astype(x.dtype)
+    if gamma is not None:
+        out = out * gamma.reshape(bshape)
+    if beta is not None:
+        out = out + beta.reshape(bshape)
+    return out, new_rm, new_rv
+
+
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    """reference: src/operator/nn/layer_norm*.cc"""
+    jnp = _jnp()
+    lax = _jx().lax
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axis, keepdims=True)
+    var = jnp.var(xf, axis=axis, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + eps)
+    out = out.astype(x.dtype)
+    if gamma is not None:
+        bshape = [1] * x.ndim
+        bshape[axis % x.ndim] = x.shape[axis % x.ndim]
+        out = out * gamma.reshape(bshape) + beta.reshape(bshape)
+    return out
+
+
+def group_norm(x, gamma, beta, num_groups, eps=1e-5):
+    """reference: src/operator/nn/group_norm*.cc (NCHW layout)"""
+    jnp = _jnp()
+    lax = _jx().lax
+    n, c = x.shape[0], x.shape[1]
+    rest = x.shape[2:]
+    xg = x.reshape((n, num_groups, c // num_groups) + rest).astype(jnp.float32)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape).astype(x.dtype)
+    if gamma is not None:
+        bshape = (1, c) + (1,) * len(rest)
+        out = out * gamma.reshape(bshape) + beta.reshape(bshape)
+    return out
+
+
+def instance_norm(x, gamma, beta, eps=1e-5):
+    """reference: src/operator/instance_norm.cc (normalize over spatial dims)"""
+    jnp = _jnp()
+    lax = _jx().lax
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = ((xf - mean) * lax.rsqrt(var + eps)).astype(x.dtype)
+    bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+def l2_normalize(x, axis=-1, eps=1e-10):
+    """reference: src/operator/l2_normalization.cc"""
+    jnp = _jnp()
+    return x / jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+
+
+def rms_norm(x, gamma, axis=-1, eps=1e-6):
+    """RMSNorm — beyond-reference op for modern transformer parity."""
+    jnp = _jnp()
+    lax = _jx().lax
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
+    out = (xf * lax.rsqrt(ms + eps)).astype(x.dtype)
+    return out * gamma if gamma is not None else out
+
+
+# ---------------------------------------------------------------------------
+# dropout (reference: src/operator/nn/dropout*.cc — mask cached for backward)
+# ---------------------------------------------------------------------------
+def dropout(x, rate, key, training=True, axes=None):
+    jnp = _jnp()
+    jr = _jx().random
+    if not training or rate <= 0.0:
+        return x
+    shape = x.shape if not axes else tuple(
+        x.shape[i] if i in axes else 1 for i in range(x.ndim))
+    keep = 1.0 - rate
+    mask = jr.bernoulli(key, keep, shape)
+    return jnp.where(mask, x / keep, jnp.zeros((), x.dtype)).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# softmax family (reference: src/operator/nn/softmax*.cc, log_softmax, softmin)
+# ---------------------------------------------------------------------------
+def softmax(x, axis=-1, temperature=None, length=None):
+    jax = _jx()
+    jnp = _jnp()
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        x = sequence_mask_axis(x, length, axis, -_np.inf)
+        out = jax.nn.softmax(x, axis=axis)
+        return jnp.where(jnp.isnan(out), jnp.zeros((), out.dtype), out)
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return _jx().nn.log_softmax(x, axis=axis)
+
+
+def softmin(x, axis=-1):
+    return _jx().nn.softmax(-x, axis=axis)
+
+
+def masked_softmax(x, mask, axis=-1, temperature=1.0):
+    jnp = _jnp()
+    x = jnp.where(mask, x / temperature, jnp.full((), -1e30, x.dtype))
+    out = _jx().nn.softmax(x, axis=axis)
+    return jnp.where(mask, out, jnp.zeros((), out.dtype))
+
+
+def sequence_mask_axis(x, length, axis, value):
+    """Mask positions >= length along `axis` (helper for softmax(length=...))."""
+    jnp = _jnp()
+    n = x.shape[axis]
+    idx_shape = [1] * x.ndim
+    idx_shape[axis] = n
+    idx = jnp.arange(n).reshape(idx_shape)
+    len_shape = [1] * x.ndim
+    len_shape[0] = x.shape[0]
+    lb = length.reshape(len_shape)
+    return jnp.where(idx < lb, x, jnp.full((), value, x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# activations (reference: src/operator/nn/activation.cc, leaky_relu.cc zoo)
+# ---------------------------------------------------------------------------
+def activation(x, act_type):
+    jax = _jx()
+    jnp = _jnp()
+    if act_type == "relu":
+        return jax.nn.relu(x)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(x)
+    if act_type == "log_sigmoid":
+        return jax.nn.log_sigmoid(x)
+    if act_type == "mish":
+        return x * jnp.tanh(jax.nn.softplus(x))
+    raise ValueError(f"unknown activation {act_type!r}")
+
+
+def leaky_relu(x, act_type="leaky", slope=0.25, gamma=None, upper=0.334,
+               lower=0.125, key=None, training=False):
+    """reference: src/operator/leaky_relu.cc (leaky/prelu/rrelu/elu/selu/gelu)"""
+    jax = _jx()
+    jnp = _jnp()
+    if act_type == "leaky":
+        return jax.nn.leaky_relu(x, slope)
+    if act_type == "prelu":
+        return jnp.where(x >= 0, x, gamma * x)
+    if act_type == "elu":
+        return jax.nn.elu(x, slope)
+    if act_type == "selu":
+        return jax.nn.selu(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    if act_type == "rrelu":
+        if training and key is not None:
+            u = jax.random.uniform(key, x.shape, jnp.float32, lower, upper)
+            return jnp.where(x >= 0, x, (u * x.astype(jnp.float32)).astype(x.dtype))
+        return jax.nn.leaky_relu(x, (lower + upper) / 2)
+    raise ValueError(f"unknown leaky_relu type {act_type!r}")
+
+
+def silu(x):
+    return _jx().nn.silu(x)
+
+
+swish = silu
+
+
+# ---------------------------------------------------------------------------
+# indexing helpers (reference: src/operator/tensor/indexing_op.*)
+# ---------------------------------------------------------------------------
+def embedding(indices, weight):
+    """reference: Embedding op (indexing_op.h) — gather rows."""
+    return weight[indices.astype("int32")]
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    jax = _jx()
+    return jax.nn.one_hot(indices, depth, dtype=dtype) * (on_value - off_value) \
+        + off_value
+
+
+def pick(x, index, axis=-1, keepdims=False, mode="clip"):
+    """reference: pick op — select one element along axis per position."""
+    jnp = _jnp()
+    idx = jnp.clip(index.astype("int32"), 0, x.shape[axis] - 1)
+    picked = jnp.take_along_axis(x, jnp.expand_dims(idx, axis), axis=axis)
+    return picked if keepdims else jnp.squeeze(picked, axis)
+
+
+def topk(x, k=1, axis=-1, ret_typ="indices", is_ascend=False):
+    """reference: src/operator/tensor/ordering_op-inl.h"""
+    jax = _jx()
+    jnp = _jnp()
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "value":
+        return vals
+    return vals, idx
+
+
+def sequence_mask(x, sequence_length=None, use_sequence_length=False, value=0.0,
+                  axis=0):
+    """reference: src/operator/sequence_mask.cc (time-major default)"""
+    jnp = _jnp()
+    if not use_sequence_length or sequence_length is None:
+        return x
+    n = x.shape[axis]
+    batch_axis = 1 - axis
+    idx_shape = [1] * x.ndim
+    idx_shape[axis] = n
+    idx = jnp.arange(n).reshape(idx_shape)
+    len_shape = [1] * x.ndim
+    len_shape[batch_axis] = x.shape[batch_axis]
+    lb = sequence_length.reshape(len_shape)
+    return jnp.where(idx < lb, x, jnp.full((), value, x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# fused RNN (reference: src/operator/rnn.cc + rnn_impl.h — LSTM/GRU/vanilla,
+# cuDNN-backed on GPU). TPU-native: lax.scan over time, weights packed per
+# layer/direction like the reference's flat parameter vector.
+# ---------------------------------------------------------------------------
+def lstm_cell(x, h, c, wx, wh, b):
+    jnp = _jnp()
+    jax = _jx()
+    gates = jnp.matmul(x, wx.T) + jnp.matmul(h, wh.T) + b
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def gru_cell(x, h, wx, wh, bx, bh):
+    jnp = _jnp()
+    jax = _jx()
+    gx = jnp.matmul(x, wx.T) + bx
+    gh = jnp.matmul(h, wh.T) + bh
+    rx, zx, nx = jnp.split(gx, 3, axis=-1)
+    rh, zh, nh = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(rx + rh)
+    z = jax.nn.sigmoid(zx + zh)
+    n = jnp.tanh(nx + r * nh)
+    return (1 - z) * n + z * h
+
+
+def rnn_relu_cell(x, h, wx, wh, b, act="tanh"):
+    jnp = _jnp()
+    pre = jnp.matmul(x, wx.T) + jnp.matmul(h, wh.T) + b
+    return _jx().nn.relu(pre) if act == "relu" else jnp.tanh(pre)
+
+
+def _scan_layer(cell_step, xs, carry_init, reverse=False):
+    lax = _jx().lax
+    carry, ys = lax.scan(cell_step, carry_init, xs, reverse=reverse)
+    return carry, ys
+
+
+def rnn(x, params, state, mode="lstm", num_layers=1, hidden_size=None,
+        bidirectional=False, dropout_rate=0.0, key=None, training=False):
+    """Multi-layer (bi)directional RNN over time-major input (T, N, C).
+
+    `params` is a dict  {(layer, direction): {"wx","wh","bx","bh"}};
+    `state` is (h0,) or (h0, c0) with shape (L*D, N, H).
+    Returns (output (T,N,H*D), new_state tuple). ≙ the fused `rnn` op
+    (src/operator/rnn.cc) that rnn_layer.py lowers to.
+    """
+    jnp = _jnp()
+    ndir = 2 if bidirectional else 1
+    h0 = state[0]
+    c0 = state[1] if mode == "lstm" else None
+    out = x
+    h_list, c_list = [], []
+    for layer in range(num_layers):
+        dir_outs = []
+        for d in range(ndir):
+            p = params[(layer, d)]
+            idx = layer * ndir + d
+            hh = h0[idx]
+            if mode == "lstm":
+                cc = c0[idx]
+
+                def step(carry, xt, p=p):
+                    h, c = carry
+                    hn, cn = lstm_cell(xt, h, c, p["wx"], p["wh"],
+                                       p["bx"] + p["bh"])
+                    return (hn, cn), hn
+
+                (hT, cT), ys = _scan_layer(step, out, (hh, cc), reverse=(d == 1))
+                c_list.append(cT)
+            elif mode == "gru":
+                def step(h, xt, p=p):
+                    hn = gru_cell(xt, h, p["wx"], p["wh"], p["bx"], p["bh"])
+                    return hn, hn
+
+                hT, ys = _scan_layer(step, out, hh, reverse=(d == 1))
+            else:  # rnn_tanh / rnn_relu
+                act = "relu" if mode == "rnn_relu" else "tanh"
+
+                def step(h, xt, p=p, act=act):
+                    hn = rnn_relu_cell(xt, h, p["wx"], p["wh"],
+                                       p["bx"] + p["bh"], act)
+                    return hn, hn
+
+                hT, ys = _scan_layer(step, out, hh, reverse=(d == 1))
+            h_list.append(hT)
+            dir_outs.append(ys)
+        out = dir_outs[0] if ndir == 1 else jnp.concatenate(dir_outs, axis=-1)
+        if dropout_rate > 0 and training and key is not None and layer < num_layers - 1:
+            import jax.random as jr
+            key, sub = jr.split(key)
+            out = dropout(out, dropout_rate, sub, training=True)
+    h_out = jnp.stack(h_list, axis=0)
+    if mode == "lstm":
+        return out, (h_out, jnp.stack(c_list, axis=0))
+    return out, (h_out,)
+
+
+# ---------------------------------------------------------------------------
+# attention (reference: src/operator/contrib/transformer.cc:676-869 —
+# interleaved_matmul_selfatt fused attention). TPU-native: jnp einsum which XLA
+# fuses onto the MXU; flash/ring variants live in ops/pallas & parallel/.
+# ---------------------------------------------------------------------------
+def scaled_dot_product_attention(q, k, v, mask=None, scale=None, causal=False):
+    """q,k,v: (..., T, H). Returns attention output (..., T, H)."""
+    jnp = _jnp()
+    jax = _jx()
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / _np.sqrt(d)
+    logits = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(cm, logits, jnp.full((), -1e30, logits.dtype))
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.full((), -1e30, logits.dtype))
+    w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("...qk,...kd->...qd", w, v)

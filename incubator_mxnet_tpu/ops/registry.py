@@ -1,0 +1,127 @@
+"""Single-choke-point op dispatch with autograd taping.
+
+Reference: Imperative::Invoke → SetShapeType → PushFCompute
+(src/imperative/imperative.cc:49-140, imperative_utils.h:648). TPU-native:
+`invoke(fn, args)` unwraps NDArrays, runs the jax function (XLA handles shape
+and dtype inference; PJRT dispatch is already async — the ThreadedEngine's
+var-dependency scheduling collapses into XLA buffer futures), and, when
+autograd is recording, captures a `jax.vjp` closure as the tape node
+(≙ Imperative::RecordOp, imperative.cc:210).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import autograd
+from ..base import MXNetError
+
+_OP_REGISTRY = {}
+
+
+class OpInfo:
+    """Registry entry: name, callable, AMP behavior, docs (≙ nnvm::Op attrs)."""
+
+    __slots__ = ("name", "fn", "amp", "doc")
+
+    def __init__(self, name, fn, amp="neutral", doc=""):
+        self.name = name
+        self.fn = fn
+        self.amp = amp  # 'safe' (run bf16) | 'unsafe' (keep f32) | 'neutral'
+        self.doc = doc
+
+
+def register_op(name, fn=None, amp="neutral", doc=""):
+    """Register an op (decorator or direct). ≙ NNVM_REGISTER_OP."""
+    def _reg(f):
+        _OP_REGISTRY[name] = OpInfo(name, f, amp, doc or (f.__doc__ or ""))
+        return f
+    if fn is not None:
+        return _reg(fn)
+    return _reg
+
+
+def get_op(name):
+    try:
+        return _OP_REGISTRY[name]
+    except KeyError:
+        raise MXNetError(f"operator {name!r} is not registered") from None
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY)
+
+
+def apply_op(name, *args, **kwargs):
+    """Invoke a registered op by name on NDArray/array args."""
+    import functools
+    info = get_op(name)
+    fn = functools.partial(info.fn, **kwargs) if kwargs else info.fn
+    return invoke(fn, args, name=name)
+
+
+def _is_float_dtype(dtype):
+    try:
+        return _np.issubdtype(_np.dtype(dtype), _np.floating)
+    except TypeError:
+        return str(dtype) in ("bfloat16", "float8_e4m3fn", "float8_e5m2")
+
+
+def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False):
+    """Execute `fn` on arrays, wrapping results and taping when recording.
+
+    `fn` is a pure jax function of the array-positional args (static/scalar
+    params must be closed over by the caller). Returns NDArray or tuple.
+    """
+    import jax
+    from ..ndarray import NDArray, _wrap
+
+    raw = []
+    tracked_any = False
+    parents = []
+    for a in args:
+        if isinstance(a, NDArray):
+            raw.append(a._arr)
+            if a._var is not None:
+                parents.append(("var", a))
+                tracked_any = True
+            elif a._entry is not None:
+                parents.append(("node", a._entry[0], a._entry[1]))
+                tracked_any = True
+            else:
+                parents.append(None)
+        else:
+            raw.append(a)
+            parents.append(None)
+
+    if _vjp_tuple:
+        inner = fn
+        fn = lambda *xs: inner(tuple(xs))
+
+    recording = autograd.is_recording() and tracked_any
+    if not recording:
+        out = fn(*raw)
+        if isinstance(out, (tuple, list)):
+            res = tuple(_wrap(o) for o in out)
+            return res if (multi_out or len(res) != 1) else res[0]
+        return (_wrap(out),) if multi_out else _wrap(out)
+
+    outs, vjp_fn = jax.vjp(fn, *raw)
+    single = not isinstance(outs, (tuple, list))
+    outs_t = (outs,) if single else tuple(outs)
+
+    any_float = any(_is_float_dtype(o.dtype) for o in outs_t)
+    wrapped = tuple(_wrap(o) for o in outs_t)
+    if any_float:
+        if single:
+            tape_fn = lambda cts: vjp_fn(cts[0])
+        else:
+            tape_fn = lambda cts: vjp_fn(tuple(cts))
+        node = autograd.Node(tape_fn, parents,
+                             [(o.shape, o.dtype) for o in outs_t], name=name,
+                             fn=fn,
+                             inputs=tuple(args), single_out=single)
+        for i, w in enumerate(wrapped):
+            w._entry = (node, i)
+    if single and not multi_out:
+        return wrapped[0]
+    return wrapped

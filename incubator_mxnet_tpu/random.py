@@ -1,0 +1,71 @@
+"""Global random state: seedable, trace-aware PRNG key plumbing.
+
+Reference: mx.random + per-device RandGenerator resources
+(include/mxnet/random_generator.h, src/resource.cc kRandom/kParallelRandom).
+TPU-native: JAX's functional threefry keys. Eager ops draw from a process-global
+key (split per call). Inside a traced/hybridized computation, a *trace key
+scope* supplies a traced key instead, keeping the trace pure: the jit wrapper
+passes a fresh key argument each call (≙ the reference re-seeding per-forward
+dropout through the resource manager).
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import get_env
+
+_state = threading.local()
+_global = {"key": None, "seed": 0}
+_lock = threading.Lock()
+
+
+def _key_module():
+    import jax
+    return jax.random
+
+
+def seed(seed_state=None, ctx="all"):
+    """Seed the global generator (≙ mx.random.seed)."""
+    if seed_state is None:
+        import os
+        seed_state = int.from_bytes(os.urandom(4), "little")
+    with _lock:
+        _global["seed"] = int(seed_state)
+        _global["key"] = _key_module().PRNGKey(int(seed_state))
+
+
+class _TraceKeyScope:
+    """Context supplying a traced PRNG key for use inside jit traces."""
+
+    def __init__(self, key):
+        self.holder = [key]
+
+    def __enter__(self):
+        stack = getattr(_state, "stack", None)
+        if stack is None:
+            stack = _state.stack = []
+        stack.append(self.holder)
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+
+
+def trace_key_scope(key):
+    return _TraceKeyScope(key)
+
+
+def next_key():
+    """Return a fresh PRNG key (splitting trace key or the global key)."""
+    jr = _key_module()
+    stack = getattr(_state, "stack", None)
+    if stack:
+        holder = stack[-1]
+        holder[0], sub = jr.split(holder[0])
+        return sub
+    with _lock:
+        if _global["key"] is None:
+            test_seed = get_env("MXNET_TEST_SEED", typ=int)
+            _global["key"] = jr.PRNGKey(test_seed if test_seed is not None else 0)
+        _global["key"], sub = jr.split(_global["key"])
+    return sub

@@ -1,0 +1,30 @@
+"""Test fixtures: force an 8-device CPU mesh and seed control.
+
+Reference pattern: conftest.py:85-130 (MXNET_TEST_SEED reproduction) and the
+`--xla_force_host_platform_device_count` emulation recipe (SURVEY §4: the
+reference's `--launcher local` multi-process tests map onto a virtual device
+mesh in-process).
+"""
+import os
+
+# Must happen before jax initializes.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as _np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_all(request):
+    """Per-test deterministic seeding, reproducible via MXNET_TEST_SEED
+    (≙ reference conftest.py seed logging)."""
+    import incubator_mxnet_tpu as mx
+    seed = mx.get_env("MXNET_TEST_SEED", typ=int)
+    if seed is None:
+        seed = abs(hash(request.node.nodeid)) % (2 ** 31)
+    _np.random.seed(seed % (2 ** 31))
+    mx.seed(seed)
+    yield

@@ -1,0 +1,183 @@
+"""NDArray core semantics (≙ reference tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def test_create_and_asnumpy():
+    a = mx.np.array([[1, 2], [3, 4]], dtype="float32")
+    assert a.shape == (2, 2)
+    assert a.dtype == onp.float32
+    assert onp.array_equal(a.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_creation_ops():
+    assert mx.np.zeros((2, 3)).asnumpy().sum() == 0
+    assert mx.np.ones((2, 3)).asnumpy().sum() == 6
+    assert mx.np.full((2, 2), 7).asnumpy().sum() == 28
+    a = mx.nd.arange(0, 10, 2)
+    assert onp.array_equal(a.asnumpy(), [0, 2, 4, 6, 8])
+    e = mx.np.eye(3)
+    assert onp.array_equal(e.asnumpy(), onp.eye(3, dtype=onp.float32))
+
+
+def test_arithmetic():
+    a = mx.np.array([1.0, 2.0, 3.0])
+    b = mx.np.array([4.0, 5.0, 6.0])
+    assert onp.allclose((a + b).asnumpy(), [5, 7, 9])
+    assert onp.allclose((a - b).asnumpy(), [-3, -3, -3])
+    assert onp.allclose((a * b).asnumpy(), [4, 10, 18])
+    assert onp.allclose((b / a).asnumpy(), [4, 2.5, 2])
+    assert onp.allclose((a ** 2).asnumpy(), [1, 4, 9])
+    assert onp.allclose((2 + a).asnumpy(), [3, 4, 5])
+    assert onp.allclose((-a).asnumpy(), [-1, -2, -3])
+    assert onp.allclose((10 - a).asnumpy(), [9, 8, 7])
+    assert onp.allclose((1 / a).asnumpy(), [1, 0.5, 1 / 3])
+
+
+def test_inplace_arithmetic():
+    a = mx.np.array([1.0, 2.0])
+    aid = id(a)
+    a += 1
+    a *= 2
+    assert id(a) == aid
+    assert onp.allclose(a.asnumpy(), [4, 6])
+
+
+def test_matmul_dot():
+    a = mx.np.ones((2, 3))
+    b = mx.np.ones((3, 4))
+    assert (a @ b).shape == (2, 4)
+    assert onp.allclose((a @ b).asnumpy(), 3)
+    assert onp.allclose(a.dot(b).asnumpy(), 3)
+
+
+def test_reshape_transpose():
+    a = mx.np.arange(12).reshape(3, 4)
+    assert a.shape == (3, 4)
+    assert a.T.shape == (4, 3)
+    assert a.reshape(-1).shape == (12,)
+    assert a.reshape((0, -1)).shape == (3, 4)  # reference 0 = copy-dim
+    assert a.transpose(1, 0).shape == (4, 3)
+    assert a.flatten().shape == (3, 4)
+    b = mx.np.zeros((1, 3, 1))
+    assert b.squeeze().shape == (3,)
+    assert b.squeeze(axis=0).shape == (3, 1)
+    assert b.expand_dims(0).shape == (1, 1, 3, 1)
+
+
+def test_indexing_read():
+    a = mx.np.arange(12).reshape(3, 4)
+    assert a[0].shape == (4,)
+    assert a[0, 1].item() == 1
+    assert a[1:3].shape == (2, 4)
+    assert a[:, 2].shape == (3,)
+    assert onp.array_equal(a[-1].asnumpy(), [8, 9, 10, 11])
+    # boolean mask
+    m = a > 5
+    assert a[m].shape == (6,)
+    # integer array indexing
+    idx = mx.np.array([0, 2], dtype="int32")
+    assert a[idx].shape == (2, 4)
+
+
+def test_indexing_write():
+    a = mx.np.zeros((3, 4))
+    a[1] = 5
+    assert a.asnumpy()[1].sum() == 20
+    a[0, 0] = 1
+    assert a[0, 0].item() == 1
+    a[:, 2] = 9
+    assert onp.array_equal(a.asnumpy()[:, 2], [9, 9, 9])
+    a[:] = 0
+    assert a.asnumpy().sum() == 0
+
+
+def test_view_write_through():
+    a = mx.np.zeros((4, 4))
+    v = a[1:3]
+    v[:] = 3
+    assert a.asnumpy()[1:3].sum() == 24
+    assert a.asnumpy()[0].sum() == 0
+    # view reads see base updates
+    a[1] = 7
+    assert v.asnumpy()[0, 0] == 7
+
+
+def test_reductions():
+    a = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert a.sum().item() == 10
+    assert a.mean().item() == 2.5
+    assert a.max().item() == 4
+    assert a.min().item() == 1
+    assert onp.array_equal(a.sum(axis=0).asnumpy(), [4, 6])
+    assert a.argmax().item() == 3
+    assert a.prod().item() == 24
+    assert a.norm().item() == pytest.approx(onp.sqrt(30), rel=1e-5)
+
+
+def test_astype_copy():
+    a = mx.np.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == onp.int32
+    c = a.copy()
+    c[:] = 0
+    assert a.asnumpy().sum() == 4.0
+    bf = a.astype("bfloat16")
+    assert str(bf.dtype) == "bfloat16"
+
+
+def test_device_movement():
+    a = mx.np.ones((2, 2))
+    b = a.as_in_context(mx.cpu(0))
+    assert b.device.device_type == "cpu"
+    c = mx.nd.zeros((2, 2), ctx=mx.cpu(0))
+    assert c.device.device_type == "cpu"
+
+
+def test_concat_stack_split():
+    a = mx.np.ones((2, 3))
+    b = mx.np.zeros((2, 3))
+    c = mx.np.concatenate([a, b], axis=0)
+    assert c.shape == (4, 3)
+    s = mx.np.stack([a, b])
+    assert s.shape == (2, 2, 3)
+    parts = mx.np.split(mx.np.arange(9), 3)
+    assert len(parts) == 3 and parts[1].asnumpy()[0] == 3
+
+
+def test_scalar_conversion():
+    a = mx.np.array([3.5])
+    assert float(a) == 3.5
+    assert a.item() == 3.5
+    with pytest.raises(Exception):
+        bool(mx.np.ones((2, 2)))
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs.npz")
+    d = {"w": mx.np.ones((2, 2)), "b": mx.np.zeros(3)}
+    mx.nd.save(f, d)
+    back = mx.nd.load(f)
+    assert set(back) == {"w", "b"}
+    assert onp.array_equal(back["w"].asnumpy(), onp.ones((2, 2)))
+    lst = [mx.np.ones(2), mx.np.zeros(3)]
+    f2 = str(tmp_path / "list.npz")
+    mx.nd.save(f2, lst)
+    back2 = mx.nd.load(f2)
+    assert isinstance(back2, list) and back2[1].shape == (3,)
+
+
+def test_sparse_unsupported():
+    a = mx.np.ones((2, 2))
+    assert a.stype == "default"
+    with pytest.raises(mx.MXNetError):
+        a.tostype("row_sparse")
+
+
+def test_waitall_and_wait_to_read():
+    a = mx.np.ones((8, 8)) * 2
+    a.wait_to_read()
+    mx.waitall()
+    assert a.asnumpy().sum() == 128
