@@ -1,0 +1,68 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Headline (BASELINE.md north star): ResNet-50 training throughput in
+images/sec on one chip, compared against the reference's published V100 fp32
+row (298.51 img/s @ bs32, docs/.../faq/perf.md:243-253).
+
+The training step is the framework's own path: gluon ResNet-50 hybridized
+(one XLA computation for fwd+bwd via the cached-op tape) + SGD updates.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_V100_FP32_TRAIN_BS32 = 298.51  # img/s (BASELINE.md)
+
+
+def bench_resnet50_train(batch_size=32, iters=12, warmup=3):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet50_v1()
+    net.initialize()
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+
+    x = mx.np.array(np.random.uniform(-1, 1,
+                                      (batch_size, 3, 224, 224)).astype(np.float32))
+    y = mx.np.array(np.random.randint(0, 1000, (batch_size,)))
+
+    def step():
+        with mx.autograd.record():
+            out = net(x)
+            L = loss_fn(out, y).mean()
+        L.backward()
+        trainer.step(batch_size, ignore_stale_grad=True)
+        return L
+
+    for _ in range(warmup):
+        step().wait_to_read()
+    mx.waitall()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        L = step()
+    L.wait_to_read()
+    mx.waitall()
+    dt = time.perf_counter() - t0
+    return batch_size * iters / dt
+
+
+def main():
+    ips = bench_resnet50_train()
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_bs32",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / BASELINE_V100_FP32_TRAIN_BS32, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

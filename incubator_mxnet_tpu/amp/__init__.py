@@ -1,0 +1,182 @@
+"""mx.amp — automatic mixed precision (≙ python/mxnet/amp/amp.py 2.3k LoC +
+C++ ReducePrecision pass src/nnvm/low_precision_pass.cc:408).
+
+Reference design: list-driven wrapper injection over the nd/np/symbol
+namespaces (amp/lists/symbol_bf16.py) + dynamic loss scaling via the
+all_finite grad scan op. TPU-native: the SAME list-driven policy applied at
+the single op choke point (ops.registry.invoke consults `amp_dtype_for`),
+with bf16 as the native low-precision type (MXU runs bf16 natively — fp16
+loss scaling is rarely required on TPU, but the scaler is provided for
+API + convergence parity).
+
+  amp.init()                     activate autocast (process-wide)
+  amp.scale_loss(loss, trainer)  context mgr: scale loss, unscale grads
+  amp.init_trainer(trainer)      attach the dynamic LossScaler
+  amp.convert_hybrid_block(net)  cast a net's params to bf16 (offline path)
+  all_finite(arrays)             ≙ src/operator/all_finite.cc
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import numpy as _np
+
+from ..base import MXNetError
+from .lists import BF16_FUNCS, FP32_FUNCS, WIDEST_TYPE_CASTS
+
+__all__ = ["init", "uninit", "is_active", "scale_loss", "unscale",
+           "init_trainer", "convert_hybrid_block", "all_finite", "LossScaler",
+           "autocast", "amp_dtype_for"]
+
+_state = {"active": False, "target_dtype": "bfloat16"}
+_tls = threading.local()
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Activate autocast (≙ amp.init, amp/amp.py:308)."""
+    if target_dtype not in ("bfloat16", "float16"):
+        raise MXNetError("target_dtype must be bfloat16 or float16; bfloat16 "
+                         "is the TPU-native choice")
+    _state["active"] = True
+    _state["target_dtype"] = target_dtype
+    if target_precision_ops:
+        BF16_FUNCS.update(target_precision_ops)
+    if fp32_ops:
+        FP32_FUNCS.update(fp32_ops)
+
+
+def uninit():
+    _state["active"] = False
+
+
+def is_active():
+    if getattr(_tls, "suspended", 0):
+        return False
+    return _state["active"]
+
+
+@contextmanager
+def autocast(active=True):
+    """Scope to locally enable/disable autocast (nests correctly: an inner
+    autocast(True) re-enables inside an autocast(False) region)."""
+    prev_susp = getattr(_tls, "suspended", 0)
+    prev_active = _state["active"]
+    if active:
+        _tls.suspended = 0
+        _state["active"] = True
+    else:
+        _tls.suspended = prev_susp + 1
+    try:
+        yield
+    finally:
+        _tls.suspended = prev_susp
+        _state["active"] = prev_active
+
+
+def amp_dtype_for(op_name):
+    """Policy lookup used by ops.registry.invoke: returns 'bfloat16',
+    'float32' or None (leave dtypes alone)."""
+    if not is_active():
+        return None
+    base = op_name.split(".")[-1]
+    if base in BF16_FUNCS:
+        return _state["target_dtype"]
+    if base in FP32_FUNCS:
+        return "float32"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# loss scaling (≙ amp.scale_loss :290 + dynamic scaler via all_finite)
+# ---------------------------------------------------------------------------
+def all_finite(arrays):
+    """True iff every element of every array is finite
+    (≙ src/operator/all_finite.cc multi-tensor scan)."""
+    import jax.numpy as jnp
+    from ..ndarray import NDArray, _wrap
+    raws = [a._arr if isinstance(a, NDArray) else a for a in arrays]
+    ok = jnp.array(True)
+    for r in raws:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(r)))
+    return _wrap(ok)
+
+
+class LossScaler:
+    """Dynamic loss scaler (≙ amp/loss_scaler.py): x2 every 2000 good steps,
+    /2 on overflow, skip update on overflow."""
+
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = float(init_scale)
+        self._factor = scale_factor
+        self._window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        grads = [p.grad() for p in params
+                 if p.grad_req != "null" and p._data is not None]
+        if not grads:
+            return False
+        finite = bool(all_finite(grads).asnumpy())
+        if not finite:
+            self.loss_scale = max(self.loss_scale / self._factor, 1.0)
+            self._unskipped = 0
+            return True
+        self._unskipped += 1
+        if self._unskipped >= self._window:
+            self.loss_scale *= self._factor
+            self._unskipped = 0
+        return False
+
+
+def init_trainer(trainer):
+    """Attach a LossScaler to a gluon Trainer (≙ amp.init_trainer :374)."""
+    trainer._amp_loss_scaler = LossScaler()
+    trainer._amp_original_scale = trainer._scale
+
+
+@contextmanager
+def scale_loss(loss, trainer):
+    """with amp.scale_loss(loss, trainer) as scaled: scaled.backward()
+
+    Scales the loss up; trainer.step later divides grads back down (the
+    trainer's rescale_grad absorbs 1/scale). Skips the update on overflow.
+    """
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        init_trainer(trainer)
+        scaler = trainer._amp_loss_scaler
+    trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+    with autocast(False):  # the scaling multiply itself must stay f32
+        if isinstance(loss, (list, tuple)):
+            scaled = [l * scaler.loss_scale for l in loss]
+        else:
+            scaled = loss * scaler.loss_scale
+    yield scaled
+
+
+def step_with_overflow_check(trainer, batch_size):
+    """Optional helper: trainer.step that skips on grad overflow."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is not None and scaler.has_overflow(trainer._params):
+        trainer._mark_consumed()  # drop this step
+        return False
+    trainer.step(batch_size)
+    return True
+
+
+def convert_hybrid_block(net, target_dtype="bfloat16", cast_params=True,
+                         excluded_sym_names=None, device=None):
+    """Offline conversion: cast a HybridBlock's float params to bf16
+    (≙ amp.convert_hybrid_block :425-670 — the graph ReducePrecision pass
+    collapses to a dtype cast + XLA's own precision propagation)."""
+    if cast_params:
+        for _, p in net.collect_params().items():
+            if p._data is not None and _np.issubdtype(
+                    _np.dtype(p.data().dtype) if str(p.data().dtype) != "bfloat16"
+                    else _np.float32, _np.floating):
+                p.cast(target_dtype)
+    net.reset_cache() if hasattr(net, "reset_cache") else None
+    return net

@@ -1,0 +1,35 @@
+"""AMP op lists (≙ python/mxnet/amp/lists/symbol_bf16.py — per-op
+low-precision safety classification).
+
+BF16_FUNCS: MXU-bound ops that are safe and fast in bf16.
+FP32_FUNCS: numerically sensitive ops pinned to fp32.
+Everything else: widest-type rule (inputs' promoted dtype).
+"""
+
+BF16_FUNCS = {
+    # matmul/conv class (the FLOPs)
+    "dot", "matmul", "batch_dot", "convolution", "deconvolution",
+    "fully_connected", "einsum", "tensordot", "inner", "outer", "kron",
+    "conv", "dense", "scaled_dot_product_attention",
+    # cheap elementwise that feed the MXU
+    "relu", "leaky_relu", "activation", "add", "subtract", "multiply",
+    "maximum", "minimum", "concat", "stack", "reshape", "transpose",
+    "pooling",
+}
+
+FP32_FUNCS = {
+    # reductions & normalizations (accumulate in fp32)
+    "softmax", "log_softmax", "masked_softmax", "softmin",
+    "batch_norm", "layer_norm", "group_norm", "instance_norm", "rms_norm",
+    "l2_normalization", "norm", "sum", "mean", "prod", "var", "std",
+    "cumsum", "logsumexp",
+    # math with precision cliffs
+    "exp", "expm1", "log", "log1p", "log2", "log10", "power", "sqrt",
+    "rsqrt", "cbrt", "square", "reciprocal", "erf", "erfinv", "gamma",
+    "gammaln", "digamma", "sin", "cos", "tan", "arcsin", "arccos", "arctan",
+    "sinh", "cosh", "arcsinh", "arccosh", "arctanh",
+    # losses
+    "ctc_loss", "smooth_l1", "true_divide", "divide", "mod",
+}
+
+WIDEST_TYPE_CASTS = set()  # default path: leave dtypes to jnp promotion

@@ -197,6 +197,18 @@ def _is_float0(x):
     return getattr(x, "dtype", None) == jax.dtypes.float0
 
 
+def _amp_suspended():
+    """Suspend AMP autocast during backward: gradient math (vjp application
+    and cotangent accumulation) must run in the recorded dtypes, not get
+    re-cast by the forward autocast lists."""
+    import sys
+    from contextlib import nullcontext
+    amp_mod = sys.modules.get("incubator_mxnet_tpu.amp")
+    if amp_mod is not None and amp_mod._state["active"]:
+        return amp_mod.autocast(False)
+    return nullcontext()
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
              create_graph=False, variables=None):
     """Run the tape backward from `heads` (≙ autograd.backward / MXAutogradBackwardEx).
@@ -204,6 +216,13 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     If `variables` is given, returns their gradients instead of writing into
     marked .grad buffers (≙ autograd.grad, autograd.py:272).
     """
+    with _amp_suspended():
+        return _backward_impl(heads, head_grads, retain_graph, train_mode,
+                              create_graph, variables)
+
+
+def _backward_impl(heads, head_grads, retain_graph, train_mode,
+                   create_graph, variables):
     import jax.numpy as jnp
     from .ndarray import NDArray, _wrap
     from .ops.registry import invoke
@@ -222,6 +241,16 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     roots = []
     var_grads = {}  # id(var array) -> NDArray cotangent (for grad() mode)
     var_arrays = {}
+    # grad() w.r.t. tape-connected intermediates: capture the cotangent of
+    # their producing (node, out_idx) entry right before that node's vjp runs
+    entry_targets = {}  # id(node) -> {out_idx: array}
+    if variables is not None:
+        for v in variables:
+            entry = getattr(v, "_entry", None)
+            if entry is not None and getattr(v, "_var", None) is None:
+                node, idx = entry
+                entry_targets.setdefault(id(node), {})[idx] = v
+                roots.append(node)
 
     def _acc_var(arr, ct):
         key = id(arr)
@@ -257,12 +286,21 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     # Reverse topological: children (late ops) first.
     for node in reversed(order):
         node_cts = cts.pop(id(node), {})
+        for idx, target in entry_targets.get(id(node), {}).items():
+            if idx in node_cts:
+                _acc_var(target, node_cts[idx])
         if not node_cts:
             continue
         full = []
         for i, (shape, dtype) in enumerate(node.out_avals):
             if i in node_cts:
-                full.append(node_cts[i])
+                ct = node_cts[i]
+                # cross-dtype edges (AMP bf16<->f32 casts) need the cotangent
+                # in the producing output's dtype for jax.vjp
+                if ct.dtype != dtype:
+                    with _Scope(recording=False):
+                        ct = ct.astype(dtype)
+                full.append(ct)
             elif _np.issubdtype(_np.dtype(dtype), _np.floating) or str(dtype) == "bfloat16":
                 full.append(_wrap(jnp.zeros(shape, dtype)))
             else:

@@ -1,0 +1,11 @@
+"""mx.context — legacy Context API (≙ python/mxnet/context.py).
+
+The reference deprecated Context in favor of Device in 2.0; both names are
+kept here. Devices map to PJRT devices (tpu ≙ gpu slots)."""
+from .device import (Device, Context, cpu, gpu, tpu, num_gpus, num_tpus,
+                     current_device, current_context, device_memory_info,
+                     gpu_memory_info)
+
+__all__ = ["Device", "Context", "cpu", "gpu", "tpu", "num_gpus", "num_tpus",
+           "current_device", "current_context", "device_memory_info",
+           "gpu_memory_info"]

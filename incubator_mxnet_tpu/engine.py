@@ -1,0 +1,56 @@
+"""mx.engine — execution-engine facade.
+
+Reference: src/engine/ (ThreadedEngine var-dependency scheduler, SURVEY
+§2.1) + python bulk-append API. TPU-native: there IS no user-visible engine —
+PJRT dispatch is already async and XLA owns scheduling — so this module
+preserves the API surface (bulk, set_bulk_size, waitall) as cheap no-ops/
+aliases, documenting the mapping:
+
+  Engine::PushAsync       -> implicit: every jax op call is async-dispatched
+  Engine::WaitForVar      -> NDArray.wait_to_read (block_until_ready)
+  Engine::WaitForAll      -> mx.waitall()
+  op bulking (BulkFlush)  -> jax.jit / hybridize (true fusion, not batching)
+  NaiveEngine env toggle  -> MXNET_ENGINE_TYPE honored: 'NaiveEngine' makes
+                             every invoke block (debug determinism)
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from .base import get_env
+
+__all__ = ["bulk", "set_bulk_size", "current_bulk_size", "is_naive",
+           "wait_for_all"]
+
+_bulk_size = [0]
+
+
+def set_bulk_size(size):
+    """≙ mx.engine.set_bulk_size. Advisory only: XLA fuses via jit."""
+    prev = _bulk_size[0]
+    _bulk_size[0] = int(size)
+    return prev
+
+
+def current_bulk_size():
+    return _bulk_size[0]
+
+
+@contextmanager
+def bulk(size):
+    """≙ mx.engine.bulk context manager."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+
+
+def is_naive():
+    """True when MXNET_ENGINE_TYPE=NaiveEngine (synchronous debug mode)."""
+    return get_env("MXNET_ENGINE_TYPE") == "NaiveEngine"
+
+
+def wait_for_all():
+    from .ndarray import waitall
+    waitall()

@@ -1,0 +1,31 @@
+"""mx.gluon — the imperative/hybrid module API.
+
+Reference: python/mxnet/gluon/ (Block/HybridBlock block.py:202,997;
+Parameter parameter.py:47; Trainer trainer.py:31; nn/rnn layer catalogs in
+SURVEY.md Appendix B; loss.py; metric.py; data/; model_zoo/).
+"""
+from __future__ import annotations
+
+from .parameter import Parameter, Constant, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import metric
+from . import utils
+
+# lazy heavy submodules
+_LAZY = ("rnn", "data", "model_zoo", "contrib", "probability")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

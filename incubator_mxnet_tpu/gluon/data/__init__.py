@@ -1,0 +1,7 @@
+"""gluon.data (≙ python/mxnet/gluon/data/): Dataset/Sampler/DataLoader."""
+from .dataset import (Dataset, SimpleDataset, ArrayDataset,
+                      RecordFileDataset, _LazyTransformDataset)
+from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler,
+                      FilterSampler)
+from .dataloader import DataLoader, default_batchify_fn
+from . import vision

@@ -1,0 +1,95 @@
+"""DataLoader (≙ python/mxnet/gluon/data/dataloader.py:307/514).
+
+TPU-native design: the reference forks worker *processes* and ships batches
+through POSIX shared memory (CPUSharedStorageManager) because its decode
+path is GIL-bound C++ calls. Here batches are numpy work: a thread pool
+(decode/augment release the GIL in numpy/PIL) prefetches `prefetch` batches
+ahead and the main thread uploads them to the device — double-buffering host
+→ HBM copies behind the step (≙ the PrefetcherIter double buffer,
+src/io/iter_prefetcher.h). Worker processes are unnecessary and actively
+harmful with a live PJRT client (fork-safety), mirroring the reference's own
+fork-handler dance (src/initialize.cc) — thread mode is its
+`thread_pool=True` path."""
+from __future__ import annotations
+
+import itertools
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as _np
+
+from ...base import MXNetError
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (≙ dataloader.default_batchify_fn)."""
+    from ...ndarray import NDArray, array
+    if isinstance(data[0], NDArray):
+        from ...ndarray import stack
+        return stack(*data, axis=0)
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn(list(s)) for s in zip(*data))
+    arr = _np.asarray(data)
+    return array(arr)
+
+
+default_mp_batchify_fn = default_batchify_fn
+
+
+class DataLoader:
+    """≙ gluon.data.DataLoader(dataset, batch_size, shuffle, sampler,
+    last_batch, batch_sampler, batchify_fn, num_workers, pin_memory,
+    prefetch, thread_pool)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=True, timeout=120,
+                 try_nopython=None):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError("batch_size required when no batch_sampler")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle conflicts with explicit sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise MXNetError("batch_sampler conflicts with batch_size/"
+                             "shuffle/sampler/last_batch")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        self._pin_memory = pin_memory
+
+    def _make_batch(self, indices):
+        samples = [self._dataset[i] for i in indices]
+        return self._batchify_fn(samples)
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            it = iter(self._batch_sampler)
+            pending = []
+            for indices in itertools.islice(it, self._prefetch + 1):
+                pending.append(pool.submit(self._make_batch, indices))
+            while pending:
+                fut = pending.pop(0)
+                nxt = next(it, None)
+                if nxt is not None:
+                    pending.append(pool.submit(self._make_batch, nxt))
+                yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,198 @@
+"""Vision transforms (≙ python/mxnet/gluon/data/vision/transforms.py).
+
+Transforms operate on HWC uint8/float NDArrays (the reference convention) and
+run host-side through the numpy frontend — batches then upload once. ToTensor
+converts HWC [0,255] → CHW [0,1] float32 like the reference.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ....base import MXNetError
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "CropResize"]
+
+
+class Compose(Sequential):
+    """≙ transforms.Compose."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (≙ transforms.ToTensor)."""
+
+    def forward(self, x):
+        x = x.astype("float32") / 255.0
+        if x.ndim == 3:
+            return x.transpose((2, 0, 1))
+        return x.transpose((0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    """(x - mean) / std per channel on CHW input (≙ transforms.Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = _np.asarray(mean, _np.float32).reshape(-1, 1, 1)
+        self._std = _np.asarray(std, _np.float32).reshape(-1, 1, 1)
+
+    def forward(self, x):
+        from .... import numpy as mxnp
+        return (x - mxnp.array(self._mean)) / mxnp.array(self._std)
+
+
+def _resize_hwc(x, size, interp="bilinear"):
+    """Resize HWC array with jax.image (≙ src/operator/image/resize.cc)."""
+    import jax.image
+    from ....ops.registry import invoke
+    if isinstance(size, int):
+        size = (size, size)  # (w, h) in reference order
+    w, h = size
+    method = {"bilinear": "linear", "nearest": "nearest",
+              "bicubic": "cubic"}.get(interp, "linear")
+
+    def f(a):
+        shape = (h, w, a.shape[-1]) if a.ndim == 3 else \
+            (a.shape[0], h, w, a.shape[-1])
+        return jax.image.resize(a.astype("float32"), shape, method)
+
+    return invoke(f, (x,), name="resize")
+
+
+class Resize(Block):
+    """≙ transforms.Resize(size, keep_ratio, interpolation)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interp = "bilinear"
+
+    def forward(self, x):
+        size = self._size
+        if self._keep and isinstance(size, int):
+            h, w = x.shape[-3], x.shape[-2]
+            if h < w:
+                size = (int(w * size / h), size)
+            else:
+                size = (size, int(h * size / w))
+        return _resize_hwc(x, size, self._interp)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[-3], x.shape[-2]
+        y0 = max((H - h) // 2, 0)
+        x0 = max((W - w) // 2, 0)
+        out = x[..., y0:y0 + h, x0:x0 + w, :]
+        if out.shape[-3] != h or out.shape[-2] != w:
+            out = _resize_hwc(out, (w, h))
+        return out
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._pad = pad
+
+    def forward(self, x):
+        from .... import numpy as mxnp
+        w, h = self._size
+        if self._pad:
+            p = self._pad
+            x = mxnp.pad(x, ((p, p), (p, p), (0, 0)), mode="constant")
+        H, W = x.shape[-3], x.shape[-2]
+        y0 = _np.random.randint(0, max(H - h, 0) + 1)
+        x0 = _np.random.randint(0, max(W - w, 0) + 1)
+        return x[..., y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomResizedCrop(Block):
+    """≙ transforms.RandomResizedCrop (area/ratio jitter then resize)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        H, W = x.shape[-3], x.shape[-2]
+        area = H * W
+        for _ in range(10):
+            target_area = _np.random.uniform(*self._scale) * area
+            log_ratio = (_np.log(self._ratio[0]), _np.log(self._ratio[1]))
+            aspect = _np.exp(_np.random.uniform(*log_ratio))
+            w = int(round(_np.sqrt(target_area * aspect)))
+            h = int(round(_np.sqrt(target_area / aspect)))
+            if 0 < w <= W and 0 < h <= H:
+                y0 = _np.random.randint(0, H - h + 1)
+                x0 = _np.random.randint(0, W - w + 1)
+                crop = x[..., y0:y0 + h, x0:x0 + w, :]
+                return _resize_hwc(crop, self._size)
+        return _resize_hwc(x, self._size)  # fallback
+
+
+class RandomFlipLeftRight(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        from .... import numpy as mxnp
+        if _np.random.rand() < self._p:
+            return mxnp.flip(x, axis=-2)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        from .... import numpy as mxnp
+        if _np.random.rand() < self._p:
+            return mxnp.flip(x, axis=-3)
+        return x
+
+
+class CropResize(Block):
+    """≙ transforms.CropResize(x, y, w, h, size)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=None):
+        super().__init__()
+        self._x, self._y = x, y
+        self._w, self._h = width, height
+        self._size = size
+
+    def forward(self, img):
+        out = img[..., self._y:self._y + self._h,
+                  self._x:self._x + self._w, :]
+        if self._size:
+            out = _resize_hwc(out, self._size)
+        return out

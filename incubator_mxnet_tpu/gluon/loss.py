@@ -1,0 +1,343 @@
+"""gluon.loss — loss functions.
+
+Reference: python/mxnet/gluon/loss.py (L1Loss, L2Loss, SigmoidBCELoss,
+SoftmaxCELoss, KLDivLoss, CTCLoss, HuberLoss, HingeLoss, SquaredHingeLoss,
+LogisticLoss, TripletLoss, PoissonNLLLoss, CosineEmbeddingLoss, SDMLLoss).
+Semantics preserved: per-example loss with `weight` scaling and
+`sample_weight` broadcasting (`_apply_weighting`), `batch_axis` mean.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import numpy as mxnp
+from .. import numpy_extension as npx
+from .block import HybridBlock
+
+__all__ = [
+    "Loss", "L1Loss", "L2Loss", "SigmoidBinaryCrossEntropyLoss",
+    "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss", "KLDivLoss",
+    "CTCLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss", "LogisticLoss",
+    "TripletLoss", "PoissonNLLLoss", "CosineEmbeddingLoss",
+]
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    """≙ gluon.loss._apply_weighting."""
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def _batch_mean(loss, batch_axis):
+    axes = tuple(i for i in range(loss.ndim) if i != batch_axis)
+    return loss.mean(axis=axes) if axes else loss
+
+
+class Loss(HybridBlock):
+    """Base loss (≙ gluon.loss.Loss)."""
+
+    def __init__(self, weight=None, batch_axis=0):
+        super().__init__()
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_axis={self._batch_axis}, w={self._weight})"
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        loss = mxnp.abs(label - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class L2Loss(Loss):
+    """0.5 * (pred - label)^2 (reference keeps the 1/2 factor)."""
+
+    def __init__(self, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        loss = mxnp.square(label - pred)
+        loss = _apply_weighting(loss, self._weight / 2, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        label = label.reshape(pred.shape)
+        if not self._from_sigmoid:
+            # log(1+exp(x)) stable form: max(x,0) - x*z + log(1+exp(-|x|))
+            relu_x = mxnp.maximum(pred, 0)
+            softplus = mxnp.log1p(mxnp.exp(-mxnp.abs(pred)))
+            if pos_weight is None:
+                loss = relu_x - pred * label + softplus
+            else:
+                w = (pos_weight - 1) * label + 1
+                loss = relu_x - pred * label + w * softplus \
+                    + (w - 1) * mxnp.maximum(-pred, 0)
+        else:
+            eps = 1e-12
+            if pos_weight is None:
+                loss = -(mxnp.log(pred + eps) * label
+                         + mxnp.log(1 - pred + eps) * (1 - label))
+            else:
+                loss = -(mxnp.log(pred + eps) * label * pos_weight
+                         + mxnp.log(1 - pred + eps) * (1 - label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """≙ gluon.loss.SoftmaxCrossEntropyLoss (sparse_label / from_logits)."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = npx.log_softmax(pred, axis=self._axis)
+        if self._sparse_label:
+            loss = -npx.pick(pred, label, axis=self._axis, keepdims=False)
+        else:
+            label = label.reshape(pred.shape)
+            loss = -(pred * label).sum(axis=self._axis)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = npx.log_softmax(pred, axis=self._axis)
+        loss = label * (mxnp.log(label + 1e-12) - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification (≙ gluon.loss.CTCLoss;
+    kernel src/operator/nn/ctc_loss.cc). TPU-native: vectorized
+    alpha-recursion in log space via lax.scan over time."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None):
+        if layout not in ("NTC", "TNC"):
+            raise MXNetError(f"unsupported layout {layout}")
+        super().__init__(weight, 0 if layout.startswith("N") else 1)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        from ..ops.registry import invoke
+        from ..ndarray import _as_nd
+        if self._layout == "TNC":
+            pred = pred.swapaxes(0, 1)  # -> NTC
+        if self._label_layout == "TN":
+            label = label.swapaxes(0, 1)
+        args = [_as_nd(pred), _as_nd(label)]
+        if pred_lengths is not None:
+            args.append(_as_nd(pred_lengths))
+        if label_lengths is not None:
+            args.append(_as_nd(label_lengths))
+        loss = invoke(lambda *a: _ctc_loss_raw(*a), tuple(args), name="ctc_loss")
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss
+
+
+def _ctc_loss_raw(logits, labels, pred_lengths=None, label_lengths=None,
+                  blank=0):
+    """log-domain CTC forward algorithm. logits: (N, T, C); labels: (N, L)."""
+    import jax
+    import jax.numpy as jnp
+    N, T, C = logits.shape
+    L = labels.shape[1]
+    labels = labels.astype(jnp.int32)
+    if pred_lengths is None:
+        pred_lengths = jnp.full((N,), T, dtype=jnp.int32)
+    else:
+        pred_lengths = pred_lengths.astype(jnp.int32)
+    if label_lengths is None:
+        label_lengths = jnp.sum((labels != blank).astype(jnp.int32), axis=1)
+    else:
+        label_lengths = label_lengths.astype(jnp.int32)
+
+    logp = jax.nn.log_softmax(logits, axis=-1)  # (N,T,C)
+    # extended label sequence with blanks: (N, 2L+1)
+    S = 2 * L + 1
+    ext = jnp.full((N, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    neg_inf = jnp.asarray(-1e30, logp.dtype)
+
+    # alpha init
+    alpha0 = jnp.full((N, S), neg_inf, logp.dtype)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    first_lab = jnp.take_along_axis(logp[:, 0, :], ext[:, 1:2], axis=1)[:, 0]
+    alpha0 = alpha0.at[:, 1].set(jnp.where(label_lengths > 0, first_lab, neg_inf))
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, t):
+        lp = jnp.take_along_axis(logp[:, t, :], ext, axis=1)  # (N,S)
+        a_shift1 = jnp.concatenate(
+            [jnp.full((N, 1), neg_inf, logp.dtype), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate(
+            [jnp.full((N, 2), neg_inf, logp.dtype), alpha[:, :-2]], axis=1)
+        a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+        new = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2) + lp
+        # freeze past each sequence's length
+        new = jnp.where((t < pred_lengths)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    s_last = 2 * label_lengths  # index of final blank
+    ll_blank = jnp.take_along_axis(alpha, s_last[:, None], axis=1)[:, 0]
+    ll_label = jnp.take_along_axis(
+        alpha, jnp.maximum(s_last - 1, 0)[:, None], axis=1)[:, 0]
+    ll_label = jnp.where(label_lengths > 0, ll_label, neg_inf)
+    return -jnp.logaddexp(ll_blank, ll_label)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        err = mxnp.abs(label - pred)
+        loss = mxnp.where(err > self._rho,
+                          err - 0.5 * self._rho,
+                          (0.5 / self._rho) * mxnp.square(err))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        loss = mxnp.maximum(self._margin - pred * label, 0)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        loss = mxnp.square(mxnp.maximum(self._margin - pred * label, 0))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed"):
+        super().__init__(weight, batch_axis)
+        if label_format not in ("signed", "binary"):
+            raise MXNetError(f"bad label_format {label_format}")
+        self._label_format = label_format
+
+    def forward(self, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        if self._label_format == "signed":
+            label = (label + 1.0) / 2.0
+        loss = (mxnp.maximum(pred, 0) - pred * label
+                + mxnp.log1p(mxnp.exp(-mxnp.abs(pred))))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        positive = positive.reshape(pred.shape)
+        negative = negative.reshape(pred.shape)
+        loss = (mxnp.square(pred - positive)
+                - mxnp.square(pred - negative))
+        loss = _batch_mean(loss, self._batch_axis)
+        loss = mxnp.maximum(loss + self._margin, 0)
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False):
+        super().__init__(weight, batch_axis)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, target, sample_weight=None, epsilon=1e-08):
+        target = target.reshape(pred.shape)
+        if self._from_logits:
+            loss = mxnp.exp(pred) - target * pred
+        else:
+            loss = pred - target * mxnp.log(pred + epsilon)
+        if self._compute_full:
+            stirling = (target * mxnp.log(target + epsilon) - target
+                        + 0.5 * mxnp.log(2 * _np.pi * (target + epsilon)))
+            stirling = stirling * (target > 1)
+            loss = loss + stirling
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss.mean()
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        input1 = input1.reshape((input1.shape[0], -1))
+        input2 = input2.reshape((input2.shape[0], -1))
+        eps = 1e-12
+        num = (input1 * input2).sum(axis=1)
+        den = mxnp.sqrt(mxnp.square(input1).sum(axis=1)
+                        * mxnp.square(input2).sum(axis=1) + eps)
+        cos = num / den
+        label = label.reshape((-1,))
+        loss = mxnp.where(label == 1, 1.0 - cos,
+                          mxnp.maximum(cos - self._margin, 0))
+        return _apply_weighting(loss, self._weight, sample_weight)

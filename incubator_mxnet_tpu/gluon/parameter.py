@@ -1,0 +1,248 @@
+"""gluon.Parameter — a trainable tensor with deferred initialization.
+
+Reference: python/mxnet/gluon/parameter.py:47 (Parameter: grad_req, lr_mult,
+wd_mult, deferred init via shape with unknown dims, data()/grad()/set_data,
+cast, zero_grad; DeferredInitializationError).
+
+TPU-native notes: a Parameter owns ONE NDArray (SPMD sharding over a mesh
+replaces the reference's per-device `list_data()` replication — see
+mx.parallel). `list_data()`/`list_grad()` return 1-element lists for API
+compatibility. Sparse stypes (`row_sparse`) are rejected: no sparse storage
+on TPU (SURVEY §7 hard-part #4).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError, name_to_dtype
+from .. import initializer as init_mod
+
+__all__ = ["Parameter", "Constant", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before its shape is known
+    (≙ gluon.parameter.DeferredInitializationError)."""
+
+
+def _shape_known(shape):
+    return shape is not None and all(s > 0 for s in shape)
+
+
+class Parameter:
+    """A trainable parameter (≙ gluon.Parameter, parameter.py:47)."""
+
+    def __init__(self, shape=None, dtype="float32", init=None,
+                 grad_req="write", lr_mult=1.0, wd_mult=1.0,
+                 allow_deferred_init=True, differentiable=True,
+                 stype="default", grad_stype="default", name=None):
+        if stype != "default" or grad_stype != "default":
+            raise MXNetError(
+                "sparse parameter storage (row_sparse/csr) is unsupported on "
+                "TPU; use dense parameters (reference: parameter.py stype)")
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.init = init
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.allow_deferred_init = allow_deferred_init
+        self._grad_req = grad_req if differentiable else "null"
+        self._data = None          # NDArray
+        self._deferred_init = None  # (init, device, default_init) waiting for shape
+        self._name = name or "param"
+        self._structural_name = None  # set by Block registration
+
+    # ------------------------------------------------------------------
+    @property
+    def name(self):
+        return self._structural_name or self._name
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if new_shape is None:
+            return
+        if isinstance(new_shape, int):
+            new_shape = (new_shape,)
+        if self._shape is not None:
+            if len(self._shape) != len(new_shape) or any(
+                    s not in (0, -1, n) for s, n in zip(self._shape, new_shape)):
+                raise MXNetError(
+                    f"inferred shape {new_shape} incompatible with declared "
+                    f"shape {self._shape} for parameter {self.name}")
+        self._shape = tuple(new_shape)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise MXNetError(f"invalid grad_req {req!r}")
+        self._grad_req = req
+        if self._data is not None:
+            self._data.attach_grad(req)
+
+    # ------------------------------------------------------------------
+    # initialization
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, device=None, default_init=None,
+                   force_reinit=False, ctx=None):
+        """Materialize the parameter (≙ Parameter.initialize).
+
+        With unknown shape dims the init is deferred until shape inference
+        (HybridBlock.infer_shape) fills them in."""
+        device = device or ctx
+        if self._data is not None and not force_reinit:
+            return
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if not _shape_known(self._shape):
+            if not self.allow_deferred_init:
+                raise MXNetError(
+                    f"cannot initialize parameter {self.name}: shape "
+                    f"{self._shape} unknown and deferred init not allowed")
+            self._deferred_init = (init, device, default_init)
+            return
+        self._finish_init(init, device, default_init)
+
+    def _finish_init(self, init, device, default_init):
+        import zlib
+        from ..ndarray import NDArray
+        from .. import random as _random
+        # stable per-parameter seed: crc32 (NOT python hash(), which is
+        # salted per process and would break cross-run reproducibility)
+        name_key = zlib.crc32(self.name.encode("utf-8"))
+        rng = _np.random.default_rng(
+            (_random._global["seed"] + name_key) & 0x7FFFFFFF)
+        initializer = init_mod.create(
+            init if init is not None else (self.init if self.init is not None
+                                           else default_init))
+        dtype = name_to_dtype(self.dtype)
+        value = initializer(init_mod.InitDesc(self.name), self._shape,
+                            _np.float32, rng)
+        value = _np.asarray(value, dtype=dtype)
+        self._data = NDArray(value, device=device)
+        self._data.attach_grad(self._grad_req)
+        self._deferred_init = None
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        if not _shape_known(self._shape):
+            raise DeferredInitializationError(
+                f"parameter {self.name} shape {self._shape} still unknown")
+        init, device, default_init = self._deferred_init
+        self._finish_init(init, device, default_init)
+
+    # ------------------------------------------------------------------
+    # access
+    # ------------------------------------------------------------------
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init is not None:
+            raise DeferredInitializationError(
+                f"parameter {self.name} has deferred init pending shape "
+                f"inference (shape={self._shape})")
+        raise MXNetError(
+            f"parameter {self.name} has not been initialized; call "
+            f".initialize() on the Block")
+
+    def data(self, device=None, ctx=None):
+        """The parameter value on `device` (≙ Parameter.data)."""
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        self._check_initialized()
+        return [self._data]
+
+    def grad(self, device=None, ctx=None):
+        self._check_initialized()
+        if self._grad_req == "null":
+            raise MXNetError(
+                f"cannot get gradient of parameter {self.name}: grad_req='null'")
+        return self._data.grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return [self._data.device]
+
+    list_device = list_ctx
+
+    def set_data(self, data):
+        """Replace the value on all devices (≙ Parameter.set_data)."""
+        from ..ndarray import NDArray, _as_nd
+        data = _as_nd(data)
+        if self._data is None:
+            # setting data also resolves a deferred init
+            self.shape = data.shape
+            self._data = data.copy()
+            self._data.attach_grad(self._grad_req)
+            self._deferred_init = None
+            return
+        if tuple(data.shape) != tuple(self._data.shape):
+            raise MXNetError(
+                f"set_data shape {data.shape} != parameter shape "
+                f"{self._data.shape} for {self.name}")
+        self._data[:] = data
+
+    def zero_grad(self):
+        """Zero the gradient buffer (≙ Parameter.zero_grad / reset_arrays)."""
+        if self._data is not None and self._data.grad is not None:
+            self._data.grad[:] = 0
+
+    def cast(self, dtype):
+        """Cast value (and grad buffer) to dtype (≙ Parameter.cast)."""
+        self.dtype = dtype
+        if self._data is not None:
+            self._data = self._data.astype(dtype)
+            self._data.attach_grad(self._grad_req)
+
+    def reset_ctx(self, device):
+        if self._data is not None:
+            self._data = self._data.as_in_context(device)
+            self._data.attach_grad(self._grad_req)
+
+    reset_device = reset_ctx
+
+    @property
+    def var_entry(self):
+        return self._data
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self._shape}, "
+                f"dtype={self.dtype})")
+
+
+class Constant(Parameter):
+    """Non-trainable parameter holding a fixed value (≙ gluon.Constant)."""
+
+    def __init__(self, value, name=None):
+        if not isinstance(value, _np.ndarray):
+            value = _np.asarray(value, dtype=_np.float32)
+        self.value = value
+        super().__init__(shape=value.shape,
+                         dtype=str(value.dtype),
+                         init=init_mod.Constant(0.0), grad_req="null",
+                         name=name or "const")
+        self.init = _ConstInit(value)
+
+
+class _ConstInit(init_mod.Initializer):
+    def __init__(self, value):
+        super().__init__()
+        self._value = value
+
+    def __call__(self, name, shape, dtype, rng):
+        return self._value.astype(dtype, copy=False)

@@ -1,0 +1,567 @@
+"""gluon.rnn — recurrent cells and fused recurrent layers.
+
+Reference: python/mxnet/gluon/rnn/rnn_cell.py (RNNCell:304, LSTMCell:413,
+GRUCell:540, SequentialRNNCell:670, DropoutCell:838, ZoneoutCell:935,
+ResidualCell:984, BidirectionalCell:1029, VariationalDropoutCell:1110,
+LSTMPCell:1284) and rnn_layer.py (fused RNN:260 / LSTM:353 / GRU:480 lowering
+to the fused `rnn` op, src/operator/rnn.cc).
+
+TPU-native: cells are plain HybridBlocks; `unroll` builds a lax.scan under
+hybridization (through npx.foreach) or a Python loop eagerly. The fused
+layers lower to ops.nn.rnn — a lax.scan over packed per-layer weights that
+XLA maps onto the MXU (the cuDNN-RNN descriptor machinery has no equivalent).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from ... import numpy as mxnp
+from ... import numpy_extension as npx
+from ... import random as _random
+from ... import autograd
+from ...ndarray import NDArray, _wrap
+from ...ops.registry import invoke
+from ...ops import nn as _nn
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = [
+    "RecurrentCell", "RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
+    "HybridSequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+    "BidirectionalCell", "VariationalDropoutCell", "LSTMPCell",
+    "RNN", "LSTM", "GRU",
+]
+
+
+class RecurrentCell(HybridBlock):
+    """Base recurrent cell (≙ rnn_cell.py RecurrentCell)."""
+
+    def __init__(self):
+        super().__init__()
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial zero states (≙ RecurrentCell.begin_state)."""
+        states = []
+        for info in self.state_info(batch_size):
+            shape = info["shape"]
+            if func is None:
+                states.append(mxnp.zeros(shape))
+            else:
+                states.append(func(shape=shape, **kwargs))
+        return states
+
+    def reset(self):
+        pass
+
+    def __call__(self, inputs, states, **kwargs):
+        return super().__call__(inputs, states, **kwargs)
+
+    def forward(self, inputs, states):
+        raise NotImplementedError
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll over the time axis (≙ RecurrentCell.unroll)."""
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+            batch = seq[0].shape[batch_axis if batch_axis < axis else 0]
+        else:
+            batch = inputs.shape[batch_axis]
+            if axis != 0:
+                inputs = inputs.swapaxes(0, axis)
+            seq = [inputs[t] for t in range(length)]
+        states = begin_state if begin_state is not None \
+            else self.begin_state(batch)
+        outputs = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+        if merge_outputs is None or merge_outputs:
+            from ...ndarray import stack
+            merged = stack(*outputs, axis=axis)
+            if valid_length is not None:
+                merged = npx.sequence_mask(
+                    merged, sequence_length=valid_length,
+                    use_sequence_length=True, axis=axis)
+            return merged, states
+        return outputs, states
+
+
+def _cell_param(shape, init, name):
+    return Parameter(shape=shape, init=init, allow_deferred_init=True,
+                     name=name)
+
+
+class _BaseFusedGateCell(RecurrentCell):
+    """Shared plumbing for RNN/LSTM/GRU cells: i2h/h2h weights + biases."""
+
+    def __init__(self, hidden_size, num_gates, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = num_gates
+        self.i2h_weight = _cell_param((ng * hidden_size, input_size),
+                                      i2h_weight_initializer, "i2h_weight")
+        self.h2h_weight = _cell_param((ng * hidden_size, hidden_size),
+                                      h2h_weight_initializer, "h2h_weight")
+        self.i2h_bias = _cell_param((ng * hidden_size,),
+                                    i2h_bias_initializer, "i2h_bias")
+        self.h2h_bias = _cell_param((ng * hidden_size,),
+                                    h2h_bias_initializer, "h2h_bias")
+
+    def infer_shape(self, inputs, *states):
+        in_sz = inputs.shape[-1]
+        self.i2h_weight.shape = (self.i2h_weight.shape[0], in_sz)
+
+
+class RNNCell(_BaseFusedGateCell):
+    """Simple Elman cell (≙ rnn_cell.py RNNCell:304)."""
+
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(hidden_size, 1, input_size, **kwargs)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        act = self._activation
+        out = invoke(
+            lambda x, h, wx, wh, bx, bh: _nn.rnn_relu_cell(
+                x, h, wx, wh, bx + bh, "relu" if act == "relu" else "tanh"),
+            (inputs, states[0], self.i2h_weight.data(), self.h2h_weight.data(),
+             self.i2h_bias.data(), self.h2h_bias.data()), name="rnn_cell")
+        return out, [out]
+
+
+class LSTMCell(_BaseFusedGateCell):
+    """≙ rnn_cell.py LSTMCell:413 (gate order i,f,g,o)."""
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 4, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        h, c = invoke(
+            lambda x, h0, c0, wx, wh, bx, bh: _nn.lstm_cell(
+                x, h0, c0, wx, wh, bx + bh),
+            (inputs, states[0], states[1], self.i2h_weight.data(),
+             self.h2h_weight.data(), self.i2h_bias.data(),
+             self.h2h_bias.data()),
+            name="lstm_cell", multi_out=True)
+        return h, [h, c]
+
+
+class GRUCell(_BaseFusedGateCell):
+    """≙ rnn_cell.py GRUCell:540 (gate order r,z,n)."""
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 3, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        out = invoke(
+            lambda x, h, wx, wh, bx, bh: _nn.gru_cell(x, h, wx, wh, bx, bh),
+            (inputs, states[0], self.i2h_weight.data(), self.h2h_weight.data(),
+             self.i2h_bias.data(), self.h2h_bias.data()), name="gru_cell")
+        return out, [out]
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with hidden projection (≙ rnn_cell.py LSTMPCell:1284)."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros"):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self.i2h_weight = _cell_param((4 * hidden_size, input_size),
+                                      i2h_weight_initializer, "i2h_weight")
+        self.h2h_weight = _cell_param((4 * hidden_size, projection_size),
+                                      h2h_weight_initializer, "h2h_weight")
+        self.h2r_weight = _cell_param((projection_size, hidden_size),
+                                      h2r_weight_initializer, "h2r_weight")
+        self.i2h_bias = _cell_param((4 * hidden_size,),
+                                    i2h_bias_initializer, "i2h_bias")
+        self.h2h_bias = _cell_param((4 * hidden_size,),
+                                    h2h_bias_initializer, "h2h_bias")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, inputs, *states):
+        self.i2h_weight.shape = (self.i2h_weight.shape[0], inputs.shape[-1])
+
+    def forward(self, inputs, states):
+        def f(x, r, c0, wx, wh, wr, bx, bh):
+            h, c = _nn.lstm_cell(x, r, c0, wx, wh, bx + bh)
+            import jax.numpy as jnp
+            return jnp.matmul(h, wr.T), c
+
+        r, c = invoke(f, (inputs, states[0], states[1],
+                          self.i2h_weight.data(), self.h2h_weight.data(),
+                          self.h2r_weight.data(), self.i2h_bias.data(),
+                          self.h2h_bias.data()),
+                      name="lstmp_cell", multi_out=True)
+        return r, [r, c]
+
+
+# ---------------------------------------------------------------------------
+# modifier / composite cells
+# ---------------------------------------------------------------------------
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells vertically (≙ rnn_cell.py SequentialRNNCell:670)."""
+
+    def __init__(self, *cells):
+        super().__init__()
+        for c in cells:
+            self.add(c)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for c in self._children.values():
+            out.extend(c.state_info(batch_size))
+        return out
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        states = []
+        for c in self._children.values():
+            states.extend(c.begin_state(batch_size, func, **kwargs))
+        return states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def forward(self, inputs, states):
+        next_states = []
+        pos = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            cell_states = states[pos:pos + n]
+            pos += n
+            inputs, new_s = cell(inputs, cell_states)
+            next_states.extend(new_s)
+        return inputs, next_states
+
+
+HybridSequentialRNNCell = SequentialRNNCell
+
+
+class _ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__()
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return self.base_cell.begin_state(batch_size, func, **kwargs)
+
+
+class DropoutCell(RecurrentCell):
+    """≙ rnn_cell.py DropoutCell:838."""
+
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        if self._rate > 0:
+            inputs = npx.dropout(inputs, p=self._rate, axes=self._axes or None)
+        return inputs, states
+
+
+class ZoneoutCell(_ModifierCell):
+    """≙ rnn_cell.py ZoneoutCell:935 — stochastically preserve prior states."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        out, new_states = self.base_cell(inputs, states)
+        if autograd.is_training():
+            def mask(p, like):
+                return npx.dropout(mxnp.ones_like(like), p=p) * p if False \
+                    else (npx.dropout(mxnp.ones_like(like), p=p))
+            if self._zo > 0:
+                prev = self._prev_output if self._prev_output is not None \
+                    else mxnp.zeros_like(out)
+                m = mask(self._zo, out)
+                out = mxnp.where(m != 0, out, prev)
+            if self._zs > 0:
+                new_states = [
+                    mxnp.where(mask(self._zs, ns) != 0, ns, s)
+                    for ns, s in zip(new_states, states)]
+        self._prev_output = out
+        return out, new_states
+
+
+class ResidualCell(_ModifierCell):
+    """≙ rnn_cell.py ResidualCell:984."""
+
+    def forward(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+
+class VariationalDropoutCell(_ModifierCell):
+    """≙ rnn_cell.py VariationalDropoutCell:1110 — one dropout mask reused
+    across time steps."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self._di, self._ds, self._do = drop_inputs, drop_states, drop_outputs
+        self._mask_in = self._mask_out = self._mask_states = None
+
+    def reset(self):
+        self._mask_in = self._mask_out = self._mask_states = None
+
+    def _mask(self, cached, p, like):
+        if cached is None:
+            cached = npx.dropout(mxnp.ones_like(like), p=p)
+        return cached
+
+    def forward(self, inputs, states):
+        if autograd.is_training():
+            if self._di > 0:
+                self._mask_in = self._mask(self._mask_in, self._di, inputs)
+                inputs = inputs * self._mask_in
+            if self._ds > 0:
+                self._mask_states = self._mask(self._mask_states, self._ds,
+                                               states[0])
+                states = [states[0] * self._mask_states] + list(states[1:])
+        out, states = self.base_cell(inputs, states)
+        if autograd.is_training() and self._do > 0:
+            self._mask_out = self._mask(self._mask_out, self._do, out)
+            out = out * self._mask_out
+        return out, states
+
+
+class BidirectionalCell(RecurrentCell):
+    """≙ rnn_cell.py BidirectionalCell:1029 — unroll-only composite."""
+
+    def __init__(self, l_cell, r_cell):
+        super().__init__()
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        l, r = self._children.values()
+        return l.state_info(batch_size) + r.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        l, r = self._children.values()
+        return (l.begin_state(batch_size, func, **kwargs)
+                + r.begin_state(batch_size, func, **kwargs))
+
+    def forward(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped; use unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        l_cell, r_cell = self._children.values()
+        axis = layout.find("T")
+        if not isinstance(inputs, (list, tuple)):
+            if axis != 0:
+                inputs = inputs.swapaxes(0, axis)
+            seq = [inputs[t] for t in range(length)]
+        else:
+            seq = list(inputs)
+        batch = seq[0].shape[0]
+        states = begin_state if begin_state is not None \
+            else self.begin_state(batch)
+        nl = len(l_cell.state_info())
+        l_states, r_states = states[:nl], states[nl:]
+        l_outs, l_states = l_cell.unroll(length, seq, l_states,
+                                         merge_outputs=False)
+        r_outs, r_states = r_cell.unroll(length, list(reversed(seq)), r_states,
+                                         merge_outputs=False)
+        outs = [mxnp.concatenate([lo, ro], axis=-1)
+                for lo, ro in zip(l_outs, reversed(r_outs))]
+        if merge_outputs is None or merge_outputs:
+            from ...ndarray import stack
+            merged = stack(*outs, axis=axis)
+            return merged, l_states + r_states
+        return outs, l_states + r_states
+
+
+# ---------------------------------------------------------------------------
+# fused multi-layer recurrent layers (≙ rnn_layer.py → fused rnn op)
+# ---------------------------------------------------------------------------
+class _FusedRNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers=1, layout="TNC",
+                 dropout=0.0, bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32", **kwargs):
+        super().__init__()
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid layout {layout}")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        ng = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[mode]
+        self._gates = ng
+        for layer in range(num_layers):
+            for d in range(self._dir):
+                pre = f"{'lr'[0] if d == 0 else 'r'}{layer}_"
+                in_sz = input_size if layer == 0 \
+                    else hidden_size * self._dir
+                suffix = "l" if d == 0 else "r"
+                setattr(self, f"{suffix}{layer}_i2h_weight",
+                        _cell_param((ng * hidden_size, in_sz if in_sz else 0),
+                                    i2h_weight_initializer, "i2h_weight"))
+                setattr(self, f"{suffix}{layer}_h2h_weight",
+                        _cell_param((ng * hidden_size, hidden_size),
+                                    h2h_weight_initializer, "h2h_weight"))
+                setattr(self, f"{suffix}{layer}_i2h_bias",
+                        _cell_param((ng * hidden_size,),
+                                    i2h_bias_initializer, "i2h_bias"))
+                setattr(self, f"{suffix}{layer}_h2h_bias",
+                        _cell_param((ng * hidden_size,),
+                                    h2h_bias_initializer, "h2h_bias"))
+
+    def _layer_params(self):
+        out = []
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                suffix = "l" if d == 0 else "r"
+                out.append(tuple(
+                    getattr(self, f"{suffix}{layer}_{n}")
+                    for n in ("i2h_weight", "h2h_weight", "i2h_bias",
+                              "h2h_bias")))
+        return out
+
+    def infer_shape(self, inputs, *args):
+        in_sz = inputs.shape[-1]
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                suffix = "l" if d == 0 else "r"
+                p = getattr(self, f"{suffix}{layer}_i2h_weight")
+                layer_in = in_sz if layer == 0 else self._hidden_size * self._dir
+                p.shape = (p.shape[0], layer_in)
+
+    def state_info(self, batch_size=0):
+        L = self._num_layers * self._dir
+        shape = (L, batch_size, self._hidden_size)
+        if self._mode == "lstm":
+            return [{"shape": shape}, {"shape": shape}]
+        return [{"shape": shape}]
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return [mxnp.zeros(i["shape"]) for i in self.state_info(batch_size)]
+
+    def __call__(self, inputs, states=None, **kwargs):
+        return super().__call__(inputs, *([states] if states is not None
+                                          else []))
+
+    def forward(self, inputs, states=None):
+        time_major = self._layout == "TNC"
+        explicit_states = states is not None
+        batch = inputs.shape[1] if time_major else inputs.shape[0]
+        if states is None:
+            states = self.begin_state(batch)
+        if isinstance(states, NDArray):
+            states = [states]
+        x = inputs if time_major else inputs.swapaxes(0, 1)
+
+        layer_params = self._layer_params()
+        flat = []
+        for tup in layer_params:
+            flat.extend(p.data() for p in tup)
+        mode, L, D = self._mode, self._num_layers, self._dir
+        dropout_rate = self._dropout
+        training = autograd.is_training()
+        key = _random.next_key() if (dropout_rate > 0 and training) else None
+
+        def run(x_raw, *rest):
+            ns = len(states)
+            st = rest[:ns]
+            ws = rest[ns:]
+            params = {}
+            i = 0
+            for layer in range(L):
+                for d in range(D):
+                    params[(layer, d)] = {"wx": ws[i], "wh": ws[i + 1],
+                                          "bx": ws[i + 2], "bh": ws[i + 3]}
+                    i += 4
+            out, new_state = _nn.rnn(x_raw, params, st, mode=mode,
+                                     num_layers=L, bidirectional=(D == 2),
+                                     dropout_rate=dropout_rate, key=key,
+                                     training=training)
+            return (out,) + tuple(new_state)
+
+        res = invoke(run, (x,) + tuple(states) + tuple(flat),
+                     name=f"rnn_{mode}", multi_out=True)
+        out, new_states = res[0], list(res[1:])
+        if not time_major:
+            out = out.swapaxes(0, 1)
+        if explicit_states:
+            return out, new_states
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._input_size} -> "
+                f"{self._hidden_size}, layers={self._num_layers}, "
+                f"bidirectional={self._dir == 2})")
+
+
+class RNN(_FusedRNNLayer):
+    """≙ rnn_layer.py RNN:260."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="tanh", **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(mode, hidden_size, num_layers, **kwargs)
+
+
+class LSTM(_FusedRNNLayer):
+    """≙ rnn_layer.py LSTM:353."""
+
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, **kwargs)
+
+
+class GRU(_FusedRNNLayer):
+    """≙ rnn_layer.py GRU:480."""
+
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, **kwargs)

@@ -1,0 +1,184 @@
+"""gluon.Trainer — bridges autograd grads ↔ KVStore ↔ Optimizer.
+
+Reference: python/mxnet/gluon/trainer.py:31 (`_init_kvstore`:188 decides
+update_on_kvstore, `step`:334, `allreduce_grads`:363, `update`:411,
+save/load_states:468-530). Grad aggregation priority ordering (engine
+priority = -param_index overlapping comm with backprop) is unnecessary on
+TPU: XLA schedules the update computation asynchronously after the grads
+materialize, and in SPMD mode the psum is fused into the step.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from ..kvstore import KVStore, create as kv_create
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore=None,
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict,)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError(
+                "params must be the dict from net.collect_params() or a list")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p!r}")
+            self._params.append(p)
+            self._param2idx[id(p)] = i
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._scale = optimizer_params.get("rescale_grad", 1.0)
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_arg = kvstore
+        self._update_on_kvstore_arg = update_on_kvstore
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._kv_initialized = False
+        self._states = [None] * len(self._params)
+        self._states_created = [False] * len(self._params)
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params and set(optimizer_params) != {"rescale_grad"}:
+                raise MXNetError(
+                    "optimizer_params must be None when optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt_mod.create(optimizer, **optimizer_params)
+        self._optimizer.param_dict = param_dict
+
+    # ------------------------------------------------------------------
+    def _init_kvstore(self):
+        """≙ trainer.py:188 — decide kvstore & update placement."""
+        arg = self._kvstore_arg
+        if arg is None or arg is False:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            kv = kv_create(arg) if isinstance(arg, str) else arg
+            self._kvstore = kv
+            u = self._update_on_kvstore_arg
+            if u is None:
+                u = kv.type.startswith("dist") if hasattr(kv, "type") else False
+            self._update_on_kvstore = u
+            if u:
+                self._kvstore.set_updater(opt_mod.get_updater(self._optimizer))
+            for i, p in enumerate(self._params):
+                if p._data is not None:
+                    self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # ------------------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """grad-normalize by batch_size, allreduce, update (≙ step:334)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        if self._kvstore is not None:
+            self._allreduce_grads()
+            if self._update_on_kvstore:
+                for i, p in enumerate(self._params):
+                    if p.grad_req != "null":
+                        self._kvstore.pull(i, p.data())
+                self._mark_consumed()
+                return
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is None:
+            return
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                if self._update_on_kvstore:
+                    self._kvstore.push(i, p.list_grad())
+                else:
+                    g = p.grad()
+                    self._kvstore.pushpull(i, p.list_grad(), out=g)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "update() is not supported when update_on_kvstore; use step()")
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            if p._data is None:
+                continue
+            var = p._data._var
+            if not ignore_stale_grad and var is not None and not var.fresh:
+                raise MXNetError(
+                    f"gradient of parameter {p.name} has not been updated by "
+                    "backward since the last step; set ignore_stale_grad=True "
+                    "to suppress (≙ trainer.py stale-grad check)")
+            if not self._states_created[i]:
+                self._states[i] = \
+                    self._optimizer.create_state_multi_precision(i, p.data())
+                self._states_created[i] = True
+            self._optimizer.update_multi_precision(i, p.data(), p.grad(),
+                                                   self._states[i])
+            if var is not None:
+                var.fresh = False
+
+    def _mark_consumed(self):
+        for p in self._params:
+            if p._data is not None and p._data._var is not None:
+                p._data._var.fresh = False
+
+    # ------------------------------------------------------------------
+    def save_states(self, fname):
+        """≙ trainer.py:468."""
+        import pickle
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+            return
+        payload = {
+            "num_update": self._optimizer.num_update,
+            "index_count": self._optimizer._index_update_count,
+            "states": {i: opt_mod._state_to_numpy(s)
+                       for i, s in enumerate(self._states)
+                       if self._states_created[i]},
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    def load_states(self, fname):
+        """≙ trainer.py:500."""
+        import pickle
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        self._optimizer.num_update = payload["num_update"]
+        self._optimizer._index_update_count = payload["index_count"]
+        for i, s in payload["states"].items():
+            self._states[int(i)] = opt_mod._state_from_numpy(s)
+            self._states_created[int(i)] = True

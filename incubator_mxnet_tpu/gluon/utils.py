@@ -1,0 +1,63 @@
+"""gluon.utils (≙ python/mxnet/gluon/utils.py): split_and_load,
+clip_global_norm, shape checking helpers."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """≙ gluon.utils.split_data."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}")
+    step = size // num_slice
+    if batch_axis == 0:
+        return [data[i * step:(i + 1) * step] for i in range(num_slice)]
+    from .. import numpy as mxnp
+    return mxnp.split(data, num_slice, axis=batch_axis)
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """≙ gluon.utils.split_and_load. With one device (the common TPU-SPMD
+    case — sharding replaces device lists) returns [data]."""
+    from ..ndarray import _as_nd
+    data = _as_nd(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """≙ gluon.utils.clip_global_norm."""
+    from .. import numpy_extension as npx
+    if not arrays:
+        raise MXNetError("arrays must not be empty")
+    return npx.clip_by_global_norm(arrays, max_norm)
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """≙ gluon.utils.download. Zero-egress environments raise at call time."""
+    raise MXNetError(
+        "download() requires network egress, which this environment does not "
+        "provide; place files locally and load them directly")

@@ -1,0 +1,202 @@
+"""mx.image — legacy image processing API (≙ python/mxnet/image/image.py +
+src/operator/image/*).
+
+Functional ops run through jax (resize/crop/flip/normalize lower to XLA);
+decode needs PIL (no OpenCV in this environment). The gluon
+data.vision.transforms module is the primary augmentation path; this keeps
+legacy `mx.image.*` call sites alive.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray, _as_nd, array
+
+__all__ = ["imdecode", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize", "HorizontalFlipAug",
+           "CastAug", "ColorNormalizeAug", "ResizeAug", "CenterCropAug",
+           "RandomCropAug", "CreateAugmenter", "Augmenter", "ImageIter"]
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """Decode an encoded image buffer (≙ mx.image.imdecode)."""
+    try:
+        import io
+        from PIL import Image
+    except ImportError:
+        raise MXNetError("imdecode needs PIL (no OpenCV in this build)")
+    img = Image.open(io.BytesIO(bytes(buf)))
+    img = img.convert("RGB" if flag else "L")
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return array(arr)
+
+
+def imresize(src, w, h, interp=1):
+    """≙ mx.image.imresize (src/operator/image/resize.cc)."""
+    from .gluon.data.vision.transforms import _resize_hwc
+    return _resize_hwc(_as_nd(src), (w, h))
+
+
+def resize_short(src, size, interp=2):
+    """Resize shorter edge to `size` (≙ mx.image.resize_short)."""
+    h, w = src.shape[0], src.shape[1]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = _as_nd(src)[y0:y0 + h, x0:x0 + w, :]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    cw, ch = size
+    x0 = max((w - cw) // 2, 0)
+    y0 = max((h - ch) // 2, 0)
+    return fixed_crop(src, x0, y0, min(cw, w), min(ch, h), size, interp), \
+        (x0, y0, cw, ch)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    cw, ch = size
+    x0 = _np.random.randint(0, max(w - cw, 0) + 1)
+    y0 = _np.random.randint(0, max(h - ch, 0) + 1)
+    return fixed_crop(src, x0, y0, min(cw, w), min(ch, h), size, interp), \
+        (x0, y0, cw, ch)
+
+
+def color_normalize(src, mean, std=None):
+    src = _as_nd(src).astype("float32")
+    out = src - _as_nd(_np.asarray(mean, _np.float32))
+    if std is not None:
+        out = out / _as_nd(_np.asarray(std, _np.float32))
+    return out
+
+
+class Augmenter:
+    """≙ mx.image.Augmenter."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return resize_short(src, self.size)
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return center_crop(src, self.size)[0]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return random_crop(src, self.size)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _np.random.rand() < self.p:
+            from . import numpy as mxnp
+            return mxnp.flip(_as_nd(src), axis=1)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return _as_nd(src).astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, **kwargs):
+    """≙ mx.image.CreateAugmenter — assemble the standard pipeline."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size))
+    else:
+        auglist.append(CenterCropAug(crop_size))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """≙ mx.image.ImageIter — python-level image iterator over .rec or
+    file list. Minimal: backed by gluon ImageRecordDataset + DataLoader."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 aug_list=None, shuffle=False, **kwargs):
+        from .gluon.data import DataLoader
+        from .gluon.data.vision.datasets import ImageRecordDataset
+        if path_imgrec is None:
+            raise MXNetError("ImageIter requires path_imgrec in this build")
+        self._dataset = ImageRecordDataset(path_imgrec)
+        self._aug_list = aug_list or []
+        self._batch_size = batch_size
+
+        def _transform(x, y):
+            for aug in self._aug_list:
+                x = aug(x)
+            return x.transpose((2, 0, 1)), y
+
+        self._loader = DataLoader(self._dataset.transform(_transform),
+                                  batch_size=batch_size, shuffle=shuffle)
+
+    def __iter__(self):
+        from .io import DataBatch
+        for x, y in self._loader:
+            yield DataBatch([x], [y])

@@ -1,0 +1,268 @@
+"""Weight initializers (≙ python/mxnet/initializer.py).
+
+Reference surface: Zero, One, Constant, Uniform, Normal, Orthogonal, Xavier,
+MSRAPrelu, Bilinear, LSTMBias, Mixed + the `@register` alias registry so
+string names ("xavier", "msra", ...) resolve in Parameter(init=...).
+
+TPU-native: initializers produce numpy arrays host-side (they run once, at
+init time — no reason to compile them), then the Parameter places them on
+device. Stateless; seeded from mx.random's global seed.
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as _np
+
+__all__ = [
+    "Initializer", "register", "create", "Zero", "One", "Constant", "Uniform",
+    "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+    "Mixed", "InitDesc",
+]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    """≙ mx.init.register: makes the class resolvable by lowercase name."""
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(init, **kwargs):
+    """Resolve an initializer from an instance, a string name, or None."""
+    if init is None:
+        return Uniform()
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        name = init.lower()
+        if name not in _REGISTRY:
+            from .base import MXNetError
+            raise MXNetError(f"unknown initializer {init!r}; "
+                             f"registered: {sorted(_REGISTRY)}")
+        return _REGISTRY[name](**kwargs)
+    raise TypeError(f"cannot create initializer from {type(init)}")
+
+
+class InitDesc(str):
+    """Parameter-name descriptor passed to initializers; carries attrs
+    (≙ mxnet.init.InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    """Base initializer (≙ python/mxnet/initializer.py:93)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, shape, dtype, rng):
+        """Dispatch on the parameter name suffix the way the reference's
+        InitDesc pattern matching does (initializer.py `__call__`)."""
+        name = str(name)
+        if name.endswith("gamma") or "weight_v" in name:
+            return self._init_one(shape, dtype)
+        if name.endswith("beta") or name.endswith("bias"):
+            return self._init_zero(shape, dtype)
+        if name.endswith("running_mean") or name.endswith("moving_mean"):
+            return self._init_zero(shape, dtype)
+        if name.endswith("running_var") or name.endswith("moving_var"):
+            return self._init_one(shape, dtype)
+        return self.init_array(name, shape, dtype, rng)
+
+    def init_array(self, name, shape, dtype, rng):
+        return self._init_weight(shape, dtype, rng)
+
+    def _init_weight(self, shape, dtype, rng):
+        raise NotImplementedError
+
+    @staticmethod
+    def _init_zero(shape, dtype):
+        return _np.zeros(shape, dtype=_np.float32).astype(dtype, copy=False)
+
+    @staticmethod
+    def _init_one(shape, dtype):
+        return _np.ones(shape, dtype=_np.float32).astype(dtype, copy=False)
+
+    def __repr__(self):
+        kw = ", ".join(f"{k}={v}" for k, v in self._kwargs.items())
+        return f"{type(self).__name__}({kw})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, shape, dtype, rng):
+        return self._init_zero(shape, dtype)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, shape, dtype, rng):
+        return self._init_one(shape, dtype)
+
+
+# reference aliases "zeros"/"ones"
+_REGISTRY["zeros"] = Zero
+_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, shape, dtype, rng):
+        v = _np.asarray(self.value, dtype=_np.float32)
+        return _np.broadcast_to(v, shape).astype(dtype, copy=False).copy()
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, shape, dtype, rng):
+        return rng.uniform(-self.scale, self.scale,
+                           size=shape).astype(dtype, copy=False)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, shape, dtype, rng):
+        return (rng.standard_normal(size=shape) * self.sigma).astype(
+            dtype, copy=False)
+
+
+@register
+class Orthogonal(Initializer):
+    """≙ mx.init.Orthogonal (Saxe et al., initializer.py)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, shape, dtype, rng):
+        nout = shape[0]
+        nin = int(_np.prod(shape[1:])) if len(shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = rng.uniform(-1.0, 1.0, size=(nout, nin))
+        else:
+            tmp = rng.standard_normal(size=(nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        return (self.scale * q.reshape(shape)).astype(dtype, copy=False)
+
+
+@register
+class Xavier(Initializer):
+    """Glorot init (≙ mx.init.Xavier, initializer.py Xavier class)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, shape, dtype, rng):
+        if len(shape) < 2:
+            # degenerate (bias-like) shape: fall back to uniform
+            fan_in = fan_out = max(int(_np.prod(shape)), 1)
+        else:
+            hw_scale = float(_np.prod(shape[2:])) if len(shape) > 2 else 1.0
+            fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            from .base import MXNetError
+            raise MXNetError(f"invalid factor_type {self.factor_type!r}")
+        scale = math.sqrt(self.magnitude / max(factor, 1e-12))
+        if self.rnd_type == "uniform":
+            out = rng.uniform(-scale, scale, size=shape)
+        elif self.rnd_type == "gaussian":
+            out = rng.standard_normal(size=shape) * scale
+        else:
+            from .base import MXNetError
+            raise MXNetError(f"invalid rnd_type {self.rnd_type!r}")
+        return out.astype(dtype, copy=False)
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He init with PReLU slope correction (≙ mx.init.MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+_REGISTRY["msra"] = MSRAPrelu
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (≙ mx.init.Bilinear, for deconv upsampling)."""
+
+    def _init_weight(self, shape, dtype, rng):
+        weight = _np.zeros(int(_np.prod(shape)), dtype=_np.float32)
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return weight.reshape(shape).astype(dtype, copy=False)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (≙ mx.init.LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, shape, dtype, rng):
+        b = _np.zeros(shape, dtype=_np.float32)
+        num_hidden = shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias  # i,f,g,o gate order
+        return b.astype(dtype, copy=False)
+
+
+@register
+class Mixed(Initializer):
+    """Pattern → initializer routing (≙ mx.init.Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        if len(patterns) != len(initializers):
+            from .base import MXNetError
+            raise MXNetError("patterns and initializers must pair up")
+        self.map = [(re.compile(p), create(i)) for p, i in
+                    zip(patterns, initializers)]
+
+    def __call__(self, name, shape, dtype, rng):
+        for pat, init in self.map:
+            if pat.search(str(name)):
+                return init(name, shape, dtype, rng)
+        from .base import MXNetError
+        raise MXNetError(f"parameter {name!r} matched no pattern; add '.*'")

@@ -1,0 +1,260 @@
+"""mx.io — legacy data iterator API (≙ python/mxnet/io/).
+
+Reference: DataIter/DataBatch/DataDesc + NDArrayIter (python/mxnet/io/io.py)
+and the ctypes-wrapped C++ iterators (MXDataIter over src/io registrations,
+SURVEY §2.4). The gluon DataLoader is the primary path; this module keeps
+legacy training scripts working.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """≙ mx.io.DataDesc (name, shape[, dtype, layout])."""
+
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    @staticmethod
+    def get_batch_axis(layout):
+        return 0 if layout is None else layout.find("N")
+
+
+class DataBatch:
+    """≙ mx.io.DataBatch."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """≙ mx.io.DataIter."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(), self.getpad(),
+                             self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        return []
+    if isinstance(data, (NDArray, _np.ndarray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        data = {f"{default_name}{('_%d' % i) if i else ''}": d
+                for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        if not isinstance(v, NDArray):
+            v = array(_np.asarray(v))
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """≙ mx.io.NDArrayIter(data, label, batch_size, shuffle, last_batch_handle)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise MXNetError(f"invalid last_batch_handle {last_batch_handle}")
+        self.last_batch_handle = last_batch_handle
+        self.shuffle = shuffle
+        self.cursor = -batch_size
+        self._order = _np.arange(self.num_data)
+        if shuffle:
+            _np.random.shuffle(self._order)
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         v.dtype) for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         v.dtype) for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            _np.random.shuffle(self._order)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _slice(self, arrays):
+        out = []
+        idx = self._order[self.cursor:self.cursor + self.batch_size]
+        pad = self.getpad()
+        if pad:
+            idx = _np.concatenate([idx, self._order[:pad]])
+        for _, v in arrays:
+            out.append(array(v.asnumpy()[idx]))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if self.last_batch_handle == "pad" and end > self.num_data:
+            return end - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """≙ mx.io.ResizeIter — cap/extend an iterator to `size` batches."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad or 0
+
+
+class PrefetchingIter(DataIter):
+    """≙ mx.io.PrefetchingIter — background thread prefetch wrapper."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        import queue
+        import threading
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        if len(iters) != 1:
+            raise MXNetError("multi-iter prefetching is not supported; "
+                             "compose datasets instead")
+        super().__init__(iters[0].batch_size)
+        self.iter = iters[0]
+        self._queue = queue.Queue(maxsize=2)
+        self._started = False
+        self._thread = None
+        self.current_batch = None
+
+    def _worker(self):
+        try:
+            for batch in self.iter:
+                self._queue.put(batch)
+        finally:
+            self._queue.put(None)
+
+    def _ensure_started(self):
+        import threading
+        if not self._started:
+            self._thread = threading.Thread(target=self._worker, daemon=True)
+            self._thread.start()
+            self._started = True
+
+    def reset(self):
+        if self._thread is not None:
+            while self._queue.get() is not None:
+                pass
+            self._thread.join()
+        self.iter.reset()
+        self._started = False
+
+    def iter_next(self):
+        self._ensure_started()
+        batch = self._queue.get()
+        if batch is None:
+            return False
+        self.current_batch = batch
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad or 0

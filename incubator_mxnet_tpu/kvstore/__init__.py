@@ -1,0 +1,238 @@
+"""mx.kvstore — KVStore API facade over XLA collectives.
+
+Reference: include/mxnet/kvstore.h:59-466 + python/mxnet/kvstore/
+(KVStoreBase registry base.py:74-245, native wrapper kvstore.py:54, horovod/
+byteps bridges). The reference's backends (CommCPU/CommDevice/CommDeviceTree
+reductions, ps-lite dist_sync/dist_async servers, NCCL) are replaced by ONE
+TPU-native implementation: values live as (optionally mesh-sharded)
+NDArrays; `push` aggregates gradients (the engine-ordered Comm::Reduce
+becomes one XLA add or a psum over the dp axis when running multi-process
+SPMD); `pull` hands back the stored weight.
+
+Semantic mapping:
+  init(k, v)        ≙ KVStore::Init — register initial weight
+  push(k, vals)     ≙ Push — sum(vals) [* then updater if set_updater]
+  pull(k, outs)     ≙ Pull — copy current value into outs
+  pushpull(k, v, o) ≙ PushPull fused (kvstore.h:226)
+  broadcast(k,v,o)  ≙ Broadcast (init+pull fused, kvstore.h:203)
+  rank/num_workers  ≙ get_rank/get_group_size → jax process index/count
+  barrier           ≙ Barrier → blocking sync on all local arrays
+
+`create('local'|'device'|'nccl'|'dist_sync'|'dist_device_sync'|'dist_async'|
+'horovod'|'byteps'|'tpu')` all resolve to this implementation — the type
+string only toggles update_on_kvstore defaults, matching trainer.py:188-275
+decision logic.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["KVStore", "KVStoreBase", "create"]
+
+
+class KVStoreBase:
+    """Registry base (≙ python/mxnet/kvstore/base.py:74)."""
+
+    OPTIMIZER = "optimizer"
+    _kv_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        KVStoreBase._kv_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def is_capable(capability):
+        raise NotImplementedError
+
+    # subclass surface: broadcast, pushpull, rank, num_workers
+
+
+def create(name="local"):
+    """≙ mx.kv.create. All native types map to the TPU store; custom
+    registered stores (KVStoreBase.register) are honored."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    base = name.split("_")[0].lower()
+    custom = KVStoreBase._kv_registry.get(name.lower())
+    if custom is not None and custom is not KVStore:
+        return custom()
+    known = ("local", "device", "nccl", "dist", "horovod", "byteps", "tpu")
+    if base not in known and name.lower() not in (
+            "dist_sync", "dist_async", "dist_device_sync", "dist_sync_device"):
+        raise MXNetError(f"unknown kvstore type {name!r}")
+    return KVStore(name)
+
+
+@KVStoreBase.register
+class KVStore(KVStoreBase):
+    """The TPU-native key-value store."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._opt_states = {}
+
+    @staticmethod
+    def is_capable(capability):
+        return capability == KVStoreBase.OPTIMIZER
+
+    # ------------------------------------------------------------------
+    @property
+    def rank(self):
+        import jax
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        import jax
+        return jax.process_count()
+
+    def get_rank(self):
+        return self.rank
+
+    def get_group_size(self):
+        return self.num_workers
+
+    # ------------------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _pairs(key, value)
+        for k, v in zip(keys, values):
+            if k not in self._store:
+                self._store[k] = _one(v).copy()
+
+    def broadcast(self, key, value, out=None, priority=0):
+        """≙ KVStore::Broadcast (kvstore.h:203): init then pull."""
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out, priority)
+        return out
+
+    def push(self, key, value, priority=0):
+        keys, values = _pairs(key, value)
+        for k, v in zip(keys, values):
+            agg = _aggregate(v)
+            if self._updater is not None:
+                if k not in self._store:
+                    self._store[k] = _one(v).copy()
+                self._updater(_key_int(k), agg, self._store[k])
+            elif self._optimizer is not None:
+                w = self._store[k]
+                if k not in self._opt_states:
+                    self._opt_states[k] = \
+                        self._optimizer.create_state_multi_precision(
+                            _key_int(k), w)
+                self._optimizer.update_multi_precision(
+                    _key_int(k), w, agg, self._opt_states[k])
+            else:
+                self._store[k] = agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _pairs(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized in kvstore")
+            val = self._store[k]
+            for target in (o if isinstance(o, (list, tuple)) else [o]):
+                target[:] = val
+        return out
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """≙ KVStore::PushPull (fused allreduce path, kvstore.h:226)."""
+        self.push(key, value, priority)
+        if out is not None:
+            # pure allreduce semantics when no updater: out = sum(values)
+            self.pull(key, out, priority)
+        return out
+
+    def row_sparse_pull(self, *a, **kw):
+        raise MXNetError("row_sparse storage is unsupported on TPU "
+                         "(SURVEY §7 hard-part #4: dense only)")
+
+    # ------------------------------------------------------------------
+    def set_updater(self, updater):
+        """≙ KVStore::set_updater — run optimizer on the store."""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """≙ kvstore.set_optimizer (server-side optimizer in dist mode)."""
+        self._optimizer = optimizer
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        states = {k: _to_np_state(s) for k, s in self._opt_states.items()}
+        payload = (states, self._optimizer) if dump_optimizer else states
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            data = pickle.load(f)
+        if isinstance(data, tuple):
+            data, self._optimizer = data
+        self._opt_states = {k: _from_np_state(s) for k, s in data.items()}
+
+    def barrier(self):
+        """≙ KVStore::Barrier."""
+        from ..ndarray import waitall
+        waitall()
+
+    def _send_command_to_servers(self, head, body):
+        pass  # no server processes in the SPMD runtime
+
+    def __repr__(self):
+        return f"KVStore(type={self.type}, keys={len(self._store)})"
+
+
+def _pairs(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
+def _one(v):
+    return v[0] if isinstance(v, (list, tuple)) else v
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def _aggregate(v):
+    """Sum a list of per-device gradients (≙ Comm::Reduce). With SPMD
+    sharding there is exactly one global array — the psum already happened
+    inside the step function."""
+    if not isinstance(v, (list, tuple)):
+        return v
+    if len(v) == 1:
+        return v[0]
+    out = v[0]
+    for x in v[1:]:
+        out = out + x
+    return out
+
+
+def _to_np_state(s):
+    if s is None:
+        return None
+    if isinstance(s, tuple):
+        return tuple(_to_np_state(x) for x in s)
+    return s.asnumpy()
+
+
+def _from_np_state(s):
+    from ..ndarray import array
+    if s is None:
+        return None
+    if isinstance(s, tuple):
+        return tuple(_from_np_state(x) for x in s)
+    return array(s)

@@ -1,0 +1,8 @@
+"""mx.models — flagship end-to-end model definitions.
+
+The gluon.model_zoo carries the reference's CNN catalog; this package holds
+the TPU-first flagship models used for benchmarking and the multi-chip
+parallelism demonstrations (transformer LM with dp/tp/sp shardings — the
+capability the reference lacks entirely, SURVEY §2.3/5.7).
+"""
+from . import transformer

@@ -181,12 +181,15 @@ def deconvolution(data, weight, bias=None, stride=1, dilate=1, pad=0, adj=0,
 
 
 def pooling(data, kernel=1, pool_type="max", stride=None, pad=0,
-            global_pool=False, count_include_pad=True, layout="NCHW"):
+            global_pool=False, count_include_pad=True, layout="NCHW",
+            ceil_mode=False, pooling_convention=None):
+    if pooling_convention is not None:  # reference name: 'valid' | 'full'
+        ceil_mode = pooling_convention == "full"
     return invoke(functools.partial(_nn.pooling, kernel=kernel,
                                     pool_type=pool_type, stride=stride,
                                     padding=pad, global_pool=global_pool,
                                     count_include_pad=count_include_pad,
-                                    layout=layout),
+                                    layout=layout, ceil_mode=ceil_mode),
                   (_as_nd(data),), name="pooling")
 
 
@@ -313,7 +316,8 @@ def while_loop(cond_fn, func, loop_vars, max_iterations=None):
 def cond(pred, then_func, else_func, inputs=None):
     """≙ _npx_cond. Differentiable (lax.cond)."""
     from jax import lax
-    inputs = inputs or []
+    if inputs is None:
+        inputs = []
     single = isinstance(inputs, NDArray)
     ins = (inputs,) if single else tuple(inputs)
     p = pred(*ins) if callable(pred) else pred

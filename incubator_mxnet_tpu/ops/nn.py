@@ -130,7 +130,8 @@ def conv_transpose(x, weight, bias=None, stride=1, padding=0, dilation=1,
 # pooling (reference: src/operator/nn/pooling*.cc; cudnn_pooling-inl.h)
 # ---------------------------------------------------------------------------
 def pooling(x, kernel, pool_type="max", stride=None, padding=0,
-            global_pool=False, count_include_pad=True, layout="NCHW"):
+            global_pool=False, count_include_pad=True, layout="NCHW",
+            ceil_mode=False):
     lax = _jx().lax
     jnp = _jnp()
     nd = x.ndim - 2
@@ -143,14 +144,25 @@ def pooling(x, kernel, pool_type="max", stride=None, padding=0,
     kernel = _tuplize(kernel, nd)
     stride = _tuplize(stride if stride is not None else kernel, nd)
     padding = _tuplize(padding, nd)
+    spatial = x.shape[1:1 + nd] if channel_last else x.shape[2:2 + nd]
+    # ceil_mode (reference pooling_convention='full'): extend right padding so
+    # the last partial window is included: out = ceil((in+2p-k)/s)+1
+    pad_pairs = []
+    for size, k, s, p in zip(spatial, kernel, stride, padding):
+        hi = p
+        if ceil_mode:
+            out = -(-(size + 2 * p - k) // s) + 1
+            needed = (out - 1) * s + k - size - p
+            hi = max(p, needed)
+        pad_pairs.append((p, hi))
     if channel_last:
         window = (1,) + kernel + (1,)
         strides = (1,) + stride + (1,)
-        pads = ((0, 0),) + tuple((p, p) for p in padding) + ((0, 0),)
+        pads = ((0, 0),) + tuple(pad_pairs) + ((0, 0),)
     else:
         window = (1, 1) + kernel
         strides = (1, 1) + stride
-        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+        pads = ((0, 0), (0, 0)) + tuple(pad_pairs)
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, window, strides, pads)
@@ -160,7 +172,8 @@ def pooling(x, kernel, pool_type="max", stride=None, padding=0,
                               lax.add, window, strides, pads)
         if pool_type == "sum":
             return s
-        if count_include_pad or all(p == 0 for p in padding):
+        if count_include_pad or all(lo == 0 and hi == 0
+                                    for lo, hi in pad_pairs):
             denom = _np.prod(kernel)
             return s / _np.asarray(denom, dtype=_np.float32).astype(x.dtype)
         ones = jnp.ones_like(x)

@@ -59,11 +59,31 @@ def apply_op(name, *args, **kwargs):
     return invoke(fn, args, name=name)
 
 
+def _amp_dtype(name):
+    """AMP policy lookup (lazy import so amp stays optional)."""
+    import sys
+    amp_mod = sys.modules.get("incubator_mxnet_tpu.amp")
+    if amp_mod is None or not amp_mod.is_active():
+        return None
+    return amp_mod.amp_dtype_for(name)
+
+
+def _amp_cast(r, dtype):
+    import jax
+    import jax.numpy as jnp
+    if isinstance(r, (jax.Array, _np.ndarray)) and _is_float_dtype(r.dtype) \
+            and str(r.dtype) != dtype:
+        return r.astype(dtype)
+    return r
+
+
 def _is_float_dtype(dtype):
+    if str(dtype) in ("bfloat16", "float8_e4m3fn", "float8_e5m2"):
+        return True  # ml_dtypes extension floats are not np.floating subtypes
     try:
         return _np.issubdtype(_np.dtype(dtype), _np.floating)
     except TypeError:
-        return str(dtype) in ("bfloat16", "float8_e4m3fn", "float8_e5m2")
+        return False
 
 
 def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False):
@@ -96,6 +116,12 @@ def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False):
     if _vjp_tuple:
         inner = fn
         fn = lambda *xs: inner(tuple(xs))
+
+    # AMP autocast: cast float inputs per the op's list classification
+    # (≙ the reference's list-driven wrapper injection, amp/amp.py:105-176)
+    amp_dt = _amp_dtype(name)
+    if amp_dt is not None:
+        raw = [_amp_cast(r, amp_dt) for r in raw]
 
     recording = autograd.is_recording() and tracked_any
     if not recording:

@@ -1,0 +1,893 @@
+"""mx.optimizer — optimizers with fused XLA update kernels.
+
+Reference: python/mxnet/optimizer/ (20 optimizers dispatching to fused C++
+update ops, src/operator/optimizer_op.cc:49-1095 — sgd_update, sgd_mom_update,
+adam_update, lamb_update_phase1/2, multi-tensor variants, multi-precision
+fp32 master weights).
+
+TPU-native design: each optimizer's update rule is ONE pure jax function
+jitted with buffer donation — weight and state buffers are donated so XLA
+updates in place (≙ the reference's in-place FCompute updates). Scalars
+(lr, wd, momentum...) enter as traced args so schedule changes never
+recompile. Multi-precision keeps an fp32 master copy for fp16/bf16 weights
+(≙ mp_sgd_update / MultiPrecision in the reference).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, _wrap, zeros
+from ..lr_scheduler import LRScheduler
+
+__all__ = [
+    "Optimizer", "register", "create", "SGD", "Signum", "SGLD", "DCASGD",
+    "NAG", "AdaGrad", "AdaDelta", "Adam", "AdamW", "Adamax", "Nadam", "FTML",
+    "FTRL", "LARS", "LAMB", "LANS", "RMSProp", "AdaBelief", "Updater",
+    "get_updater",
+]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    """≙ mx.optimizer.register."""
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    """≙ mx.optimizer.create('sgd', ...)."""
+    if isinstance(name, Optimizer):
+        return name
+    name = name.lower()
+    if name not in _REGISTRY:
+        raise MXNetError(f"unknown optimizer {name!r}: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+def _jit_update(fn, donate=()):
+    """Jit an update kernel donating weight+state buffers so XLA aliases
+    them in place (≙ the reference's in-place FCompute updates)."""
+    import jax
+    return jax.jit(fn, donate_argnums=donate)
+
+
+class Optimizer:
+    """Base optimizer (≙ python/mxnet/optimizer/optimizer.py Optimizer)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 multi_precision=False, param_dict=None,
+                 aggregate_num=None, use_fused_step=True, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.param_dict = param_dict or {}
+        self.idx2name = param_idx2name or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.num_update = 0
+        self._index_update_count = {}
+        self._all_index_update_counts = {0: self._index_update_count}
+        self._jitted = {}
+
+    # ------------------------------------------------------------------
+    # lr / wd plumbing (≙ Optimizer._get_lrs/_get_wds)
+    # ------------------------------------------------------------------
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("cannot set lr directly when lr_scheduler is set")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = 0
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.learning_rate
+        param = self.param_dict.get(index)
+        if param is not None:
+            lr *= getattr(param, "lr_mult", 1.0)
+        else:
+            lr *= self.lr_mult.get(self.idx2name.get(index, index), 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        param = self.param_dict.get(index)
+        if param is not None:
+            wd *= getattr(param, "wd_mult", 1.0)
+        else:
+            wd *= self.wd_mult.get(self.idx2name.get(index, index), 1.0)
+        return wd
+
+    # ------------------------------------------------------------------
+    # state
+    # ------------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp32 master copy for low-precision weights (≙ mp_* ops)."""
+        if self.multi_precision and str(weight.dtype) in ("float16", "bfloat16"):
+            master = weight.astype("float32")
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    # ------------------------------------------------------------------
+    # update
+    # ------------------------------------------------------------------
+    def _preprocess(self, grad_raw, wd):
+        """rescale + clip; returns a jax expression fragment used in kernels."""
+        import jax.numpy as jnp
+        g = grad_raw * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def update(self, index, weight, grad, state):
+        """Single-param update; mutates weight (and state) NDArrays."""
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self.step_one(index, weight, grad, state, lr, wd)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and str(weight.dtype) in ("float16", "bfloat16"):
+            master, inner = state
+            self._update_count(index)
+            lr, wd = self._get_lr(index), self._get_wd(index)
+            grad32 = grad.astype("float32")
+            self.step_one(index, master, grad32, inner, lr, wd)
+            weight._set_arr(master._arr.astype(weight.dtype))
+            return
+        self.update(index, weight, grad, state)
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        raise NotImplementedError
+
+    # allow lists (≙ reference update(self, indices, weights, grads, states))
+    def update_all(self, indices, weights, grads, states):
+        for i, w, g, s in zip(indices, weights, grads, states):
+            self.update(i, w, g, s)
+
+    def _kernel(self, name, fn, donate=()):
+        # rescale_grad/clip_gradient are closed over by the kernel body, so
+        # the compiled fn is only valid for their current values — key the
+        # cache on them (Trainer.step rewrites rescale_grad per batch size).
+        key = (name, self.rescale_grad, self.clip_gradient)
+        k = self._jitted.get(key)
+        if k is None:
+            k = _jit_update(fn, donate)
+            self._jitted[key] = k
+        return k
+
+
+# ---------------------------------------------------------------------------
+# SGD family
+# ---------------------------------------------------------------------------
+@register
+class SGD(Optimizer):
+    """SGD + momentum (≙ optimizer/sgd.py; kernel optimizer_op.cc sgd_update/
+    sgd_mom_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+
+        def k_mom(w, g, mom, lr, wd, momentum):
+            g = self._preprocess(g, wd) + wd * w
+            mom = momentum * mom - lr * g
+            return w + mom, mom
+
+        def k_plain(w, g, lr, wd):
+            g = self._preprocess(g, wd) + wd * w
+            return w - lr * g
+
+        if state is not None:
+            new_w, new_m = self._kernel("mom", k_mom, donate=(0, 2))(
+                weight._arr, grad._arr, state._arr,
+                _np.float32(lr), _np.float32(wd), _np.float32(self.momentum))
+            weight._set_arr(new_w)
+            state._set_arr(new_m)
+        else:
+            weight._set_arr(self._kernel("plain", k_plain, donate=(0,))(
+                weight._arr, grad._arr, _np.float32(lr), _np.float32(wd)))
+
+
+@register
+class Signum(Optimizer):
+    """≙ optimizer/signum.py (signsgd/signum kernels)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+
+        def k(w, g, mom, lr, wd, momentum, wd_lh):
+            g = self._preprocess(g, wd)
+            mom = momentum * mom - (1 - momentum) * (g + wd * w)
+            w = (1 - lr * wd_lh) * w + lr * jnp.sign(mom)
+            return w, mom
+
+        def k_sign(w, g, lr, wd, wd_lh):
+            g = self._preprocess(g, wd) + wd * w
+            return (1 - lr * wd_lh) * w - lr * jnp.sign(g)
+
+        if state is not None:
+            new_w, new_m = self._kernel("signum", k, donate=(0, 2))(
+                weight._arr, grad._arr, state._arr, _np.float32(lr),
+                _np.float32(wd), _np.float32(self.momentum),
+                _np.float32(self.wd_lh))
+            weight._set_arr(new_w)
+            state._set_arr(new_m)
+        else:
+            weight._set_arr(self._kernel("signsgd", k_sign, donate=(0,))(
+                weight._arr, grad._arr, _np.float32(lr), _np.float32(wd),
+                _np.float32(self.wd_lh)))
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (≙ optimizer/sgld.py)."""
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax
+        from .. import random as _random
+        key = _random.next_key()
+
+        def k(w, g, key, lr, wd):
+            import jax.numpy as jnp
+            g = self._preprocess(g, wd) + wd * w
+            noise = jax.random.normal(key, w.shape, w.dtype) * jnp.sqrt(lr)
+            return w - lr / 2 * g + noise
+
+        weight._set_arr(self._kernel("sgld", k, donate=(0,))(
+            weight._arr, grad._arr, key, _np.float32(lr), _np.float32(wd)))
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (≙ optimizer/dcasgd.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = zeros(weight.shape, dtype=weight.dtype) \
+            if self.momentum != 0.0 else None
+        prev = weight.copy()
+        return (mom, prev)
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        mom, prev = state
+
+        def k(w, g, pw, lr, wd, lamda):
+            g = self._preprocess(g, wd) + wd * w
+            comp = g + lamda * g * g * (w - pw)
+            return w - lr * comp, w
+
+        def k_mom(w, g, m, pw, lr, wd, momentum, lamda):
+            g = self._preprocess(g, wd) + wd * w
+            comp = g + lamda * g * g * (w - pw)
+            m = momentum * m - lr * comp
+            return w + m, m, w
+
+        if mom is not None:
+            new_w, new_m, new_prev = self._kernel("dcasgd_m", k_mom, donate=(0, 2, 3))(
+                weight._arr, grad._arr, mom._arr, prev._arr, _np.float32(lr),
+                _np.float32(wd), _np.float32(self.momentum),
+                _np.float32(self.lamda))
+            mom._set_arr(new_m)
+        else:
+            new_w, new_prev = self._kernel("dcasgd", k, donate=(0, 2))(
+                weight._arr, grad._arr, prev._arr, _np.float32(lr),
+                _np.float32(wd), _np.float32(self.lamda))
+        weight._set_arr(new_w)
+        prev._set_arr(new_prev)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (≙ optimizer/nag.py, nag_mom_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        def k(w, g, mom, lr, wd, momentum):
+            g = self._preprocess(g, wd) + wd * w
+            mom = momentum * mom + g
+            return w - lr * (g + momentum * mom), mom
+
+        new_w, new_m = self._kernel("nag", k, donate=(0, 2))(
+            weight._arr, grad._arr, state._arr, _np.float32(lr),
+            _np.float32(wd), _np.float32(self.momentum))
+        weight._set_arr(new_w)
+        state._set_arr(new_m)
+
+
+# ---------------------------------------------------------------------------
+# adaptive family
+# ---------------------------------------------------------------------------
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+
+        def k(w, g, hist, lr, wd, eps):
+            g = self._preprocess(g, wd) + wd * w
+            hist = hist + g * g
+            return w - lr * g / (jnp.sqrt(hist) + eps), hist
+
+        new_w, new_h = self._kernel("adagrad", k, donate=(0, 2))(
+            weight._arr, grad._arr, state._arr, _np.float32(lr),
+            _np.float32(wd), _np.float32(self.epsilon))
+        weight._set_arr(new_w)
+        state._set_arr(new_h)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype))
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+        acc_g, acc_delta = state
+
+        def k(w, g, ag, ad, lr, wd, rho, eps):
+            g = self._preprocess(g, wd) + wd * w
+            ag = rho * ag + (1 - rho) * g * g
+            delta = jnp.sqrt(ad + eps) / jnp.sqrt(ag + eps) * g
+            ad = rho * ad + (1 - rho) * delta * delta
+            return w - lr * delta, ag, ad
+
+        new_w, new_ag, new_ad = self._kernel("adadelta", k, donate=(0, 2, 3))(
+            weight._arr, grad._arr, acc_g._arr, acc_delta._arr,
+            _np.float32(lr), _np.float32(wd), _np.float32(self.rho),
+            _np.float32(self.epsilon))
+        weight._set_arr(new_w)
+        acc_g._set_arr(new_ag)
+        acc_delta._set_arr(new_ad)
+
+
+class _AdamBase(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype))
+
+
+@register
+class Adam(_AdamBase):
+    """≙ optimizer/adam.py (adam_update kernel, optimizer_op.cc)."""
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+        mean, var = state
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * (coef2 ** 0.5) / coef1
+
+        def k(w, g, m, v, lr, wd, b1, b2, eps):
+            g = self._preprocess(g, wd) + wd * w
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            return w - lr * m / (jnp.sqrt(v) + eps), m, v
+
+        new_w, new_m, new_v = self._kernel("adam", k, donate=(0, 2, 3))(
+            weight._arr, grad._arr, mean._arr, var._arr, _np.float32(lr_t),
+            _np.float32(wd), _np.float32(self.beta1), _np.float32(self.beta2),
+            _np.float32(self.epsilon))
+        weight._set_arr(new_w)
+        mean._set_arr(new_m)
+        var._set_arr(new_v)
+
+
+@register
+class AdamW(_AdamBase):
+    """Decoupled weight decay (≙ contrib/adamw.cc multi_adamw)."""
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+        mean, var = state
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * (coef2 ** 0.5) / coef1
+
+        def k(w, g, m, v, lr, base_lr, wd, b1, b2, eps):
+            g = self._preprocess(g, 0.0)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            return w - lr * m / (jnp.sqrt(v) + eps) - base_lr * wd * w, m, v
+
+        new_w, new_m, new_v = self._kernel("adamw", k, donate=(0, 2, 3))(
+            weight._arr, grad._arr, mean._arr, var._arr, _np.float32(lr_t),
+            _np.float32(lr), _np.float32(wd), _np.float32(self.beta1),
+            _np.float32(self.beta2), _np.float32(self.epsilon))
+        weight._set_arr(new_w)
+        mean._set_arr(new_m)
+        var._set_arr(new_v)
+
+
+@register
+class Adamax(_AdamBase):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, beta1=beta1, beta2=beta2,
+                         **kwargs)
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+        mean, u = state
+        t = self._index_update_count[index]
+        lr_t = lr / (1.0 - self.beta1 ** t)
+
+        def k(w, g, m, u, lr, wd, b1, b2, eps):
+            g = self._preprocess(g, wd) + wd * w
+            m = b1 * m + (1 - b1) * g
+            u = jnp.maximum(b2 * u, jnp.abs(g))
+            return w - lr * m / (u + eps), m, u
+
+        new_w, new_m, new_u = self._kernel("adamax", k, donate=(0, 2, 3))(
+            weight._arr, grad._arr, mean._arr, u._arr, _np.float32(lr_t),
+            _np.float32(wd), _np.float32(self.beta1), _np.float32(self.beta2),
+            _np.float32(self.epsilon))
+        weight._set_arr(new_w)
+        mean._set_arr(new_m)
+        u._set_arr(new_u)
+
+
+@register
+class Nadam(_AdamBase):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, **kwargs)
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+        mean, var = state
+        t = self._index_update_count[index]
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+
+        def k(w, g, m, v, lr, wd, b1, b2, eps, mt, ms, msn, t):
+            g = self._preprocess(g, wd) + wd * w
+            g_prime = g / (1.0 - ms)
+            m = b1 * m + (1 - b1) * g
+            m_prime = m / (1.0 - msn)
+            v = b2 * v + (1 - b2) * g * g
+            v_prime = v / (1.0 - b2 ** t)
+            m_bar = (1.0 - mt) * g_prime + mt * m_prime
+            return w - lr * m_bar / (jnp.sqrt(v_prime) + eps), m, v
+
+        new_w, new_m, new_v = self._kernel("nadam", k, donate=(0, 2, 3))(
+            weight._arr, grad._arr, mean._arr, var._arr, _np.float32(lr),
+            _np.float32(wd), _np.float32(self.beta1), _np.float32(self.beta2),
+            _np.float32(self.epsilon), _np.float32(momentum_t),
+            _np.float32(self.m_schedule), _np.float32(m_schedule_next),
+            _np.float32(t))
+        weight._set_arr(new_w)
+        mean._set_arr(new_m)
+        var._set_arr(new_v)
+
+
+@register
+class AdaBelief(_AdamBase):
+    """≙ contrib/adabelief.cc."""
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+        mean, var = state
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * (coef2 ** 0.5) / coef1
+
+        def k(w, g, m, v, lr, wd, b1, b2, eps):
+            g = self._preprocess(g, wd) + wd * w
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * (g - m) * (g - m) + eps
+            return w - lr * m / (jnp.sqrt(v) + eps), m, v
+
+        new_w, new_m, new_v = self._kernel("adabelief", k, donate=(0, 2, 3))(
+            weight._arr, grad._arr, mean._arr, var._arr, _np.float32(lr_t),
+            _np.float32(wd), _np.float32(self.beta1), _np.float32(self.beta2),
+            _np.float32(self.epsilon))
+        weight._set_arr(new_w)
+        mean._set_arr(new_m)
+        var._set_arr(new_v)
+
+
+@register
+class FTML(Optimizer):
+    """≙ optimizer/ftml.py (ftml_update kernel)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),  # d
+                zeros(weight.shape, dtype=weight.dtype),  # v
+                zeros(weight.shape, dtype=weight.dtype))  # z
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+        d, v, z = state
+        t = self._index_update_count[index]
+
+        def k(w, g, d, v, z, lr, wd, b1, b2, eps, t):
+            g = self._preprocess(g, wd) + wd * w
+            v = b2 * v + (1 - b2) * g * g
+            d_t = (1 - b1 ** t) / lr * (jnp.sqrt(v / (1 - b2 ** t)) + eps)
+            sigma = d_t - b1 * d
+            z = b1 * z + (1 - b1) * g - sigma * w
+            return -z / d_t, d_t, v, z
+
+        new_w, new_d, new_v, new_z = self._kernel("ftml", k, donate=(0, 2, 3, 4))(
+            weight._arr, grad._arr, d._arr, v._arr, z._arr, _np.float32(lr),
+            _np.float32(wd), _np.float32(self.beta1), _np.float32(self.beta2),
+            _np.float32(self.epsilon), _np.float32(t))
+        weight._set_arr(new_w)
+        d._set_arr(new_d)
+        v._set_arr(new_v)
+        z._set_arr(new_z)
+
+
+@register
+class FTRL(Optimizer):
+    """≙ optimizer/ftrl.py (ftrl_update kernel)."""
+
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),  # z
+                zeros(weight.shape, dtype=weight.dtype))  # n
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+        z, n = state
+
+        def k(w, g, z, n, lr, wd, lamda1, beta):
+            g = self._preprocess(g, wd)
+            sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
+            z = z + g - sigma * w
+            n = n + g * g
+            w = ((jnp.sign(z) * lamda1 - z)
+                 / ((beta + jnp.sqrt(n)) / lr + wd)
+                 * (jnp.abs(z) > lamda1))
+            return w, z, n
+
+        new_w, new_z, new_n = self._kernel("ftrl", k, donate=(0, 2, 3))(
+            weight._arr, grad._arr, z._arr, n._arr, _np.float32(lr),
+            _np.float32(wd), _np.float32(self.lamda1), _np.float32(self.beta))
+        weight._set_arr(new_w)
+        z._set_arr(new_z)
+        n._set_arr(new_n)
+
+
+@register
+class RMSProp(Optimizer):
+    """≙ optimizer/rmsprop.py (rmsprop_update / rmspropalex_update)."""
+
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho = rho
+        self.momentum = momentum
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, dtype=weight.dtype),  # n
+                    zeros(weight.shape, dtype=weight.dtype),  # g
+                    zeros(weight.shape, dtype=weight.dtype))  # delta
+        return (zeros(weight.shape, dtype=weight.dtype),)
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+
+        if not self.centered:
+            (n,) = state
+
+            def k(w, g, n, lr, wd, rho, eps):
+                g = self._preprocess(g, wd) + wd * w
+                n = rho * n + (1 - rho) * g * g
+                w = w - lr * g / (jnp.sqrt(n) + eps)
+                return w, n
+
+            new_w, new_n = self._kernel("rmsprop", k, donate=(0, 2))(
+                weight._arr, grad._arr, n._arr, _np.float32(lr),
+                _np.float32(wd), _np.float32(self.rho),
+                _np.float32(self.epsilon))
+            weight._set_arr(new_w)
+            n._set_arr(new_n)
+        else:
+            n, gbar, delta = state
+
+            def k(w, g, n, gb, d, lr, wd, rho, mom, eps):
+                g = self._preprocess(g, wd) + wd * w
+                n = rho * n + (1 - rho) * g * g
+                gb = rho * gb + (1 - rho) * g
+                d = mom * d - lr * g / (jnp.sqrt(n - gb * gb) + eps)
+                return w + d, n, gb, d
+
+            new_w, new_n, new_g, new_d = self._kernel("rmspropalex", k, donate=(0, 2, 3, 4))(
+                weight._arr, grad._arr, n._arr, gbar._arr, delta._arr,
+                _np.float32(lr), _np.float32(wd), _np.float32(self.rho),
+                _np.float32(self.momentum), _np.float32(self.epsilon))
+            if self.clip_weights:
+                new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
+            weight._set_arr(new_w)
+            n._set_arr(new_n)
+            gbar._set_arr(new_g)
+            delta._set_arr(new_d)
+
+
+# ---------------------------------------------------------------------------
+# layer-wise adaptive (large-batch) family
+# ---------------------------------------------------------------------------
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (≙ optimizer/lars.py)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+
+        def k(w, g, mom, lr, wd, momentum, eta, eps):
+            g = self._preprocess(g, wd)
+            w_norm = jnp.sqrt(jnp.sum(w * w))
+            g_norm = jnp.sqrt(jnp.sum(g * g))
+            trust = jnp.where((w_norm > 0) & (g_norm > 0),
+                              eta * w_norm / (g_norm + wd * w_norm + eps),
+                              1.0)
+            scaled_lr = lr * trust
+            g = g + wd * w
+            mom = momentum * mom + scaled_lr * g
+            return w - mom, mom
+
+        mom = state if state is not None else zeros(weight.shape,
+                                                    dtype=weight.dtype)
+        new_w, new_m = self._kernel("lars", k, donate=(0, 2))(
+            weight._arr, grad._arr, mom._arr, _np.float32(lr),
+            _np.float32(wd), _np.float32(self.momentum), _np.float32(self.eta),
+            _np.float32(self.epsilon))
+        weight._set_arr(new_w)
+        if state is not None:
+            state._set_arr(new_m)
+
+
+@register
+class LAMB(_AdamBase):
+    """≙ optimizer/lamb.py (lamb_update_phase1/2, contrib/multi_lamb.cc)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, **kwargs)
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+        mean, var = state
+        t = self._index_update_count[index]
+
+        def k(w, g, m, v, lr, wd, b1, b2, eps, t):
+            g = self._preprocess(g, wd)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            if self.bias_correction:
+                mhat = m / (1 - b1 ** t)
+                vhat = v / (1 - b2 ** t)
+            else:
+                mhat, vhat = m, v
+            r = mhat / (jnp.sqrt(vhat) + eps) + wd * w
+            w_norm = jnp.sqrt(jnp.sum(w * w))
+            r_norm = jnp.sqrt(jnp.sum(r * r))
+            if self.lower_bound is not None:
+                w_norm = jnp.maximum(w_norm, self.lower_bound)
+            if self.upper_bound is not None:
+                w_norm = jnp.minimum(w_norm, self.upper_bound)
+            ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+            return w - lr * ratio * r, m, v
+
+        new_w, new_m, new_v = self._kernel("lamb", k, donate=(0, 2, 3))(
+            weight._arr, grad._arr, mean._arr, var._arr, _np.float32(lr),
+            _np.float32(wd), _np.float32(self.beta1), _np.float32(self.beta2),
+            _np.float32(self.epsilon), _np.float32(t))
+        weight._set_arr(new_w)
+        mean._set_arr(new_m)
+        var._set_arr(new_v)
+
+
+@register
+class LANS(LAMB):
+    """Nesterov LAMB (≙ contrib/multi_lans.cc)."""
+
+    def step_one(self, index, weight, grad, state, lr, wd):
+        import jax.numpy as jnp
+        mean, var = state
+        t = self._index_update_count[index]
+
+        def k(w, g, m, v, lr, wd, b1, b2, eps, t):
+            g = self._preprocess(g, wd)
+            # grad normalization (LANS)
+            g_norm = jnp.sqrt(jnp.sum(g * g))
+            g = jnp.where(g_norm > 0, g / g_norm, g)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            rm = mhat / (jnp.sqrt(vhat) + eps) + wd * w
+            rg = g / (jnp.sqrt(vhat) + eps) + wd * w
+            w_norm = jnp.sqrt(jnp.sum(w * w))
+            rm_norm = jnp.sqrt(jnp.sum(rm * rm))
+            rg_norm = jnp.sqrt(jnp.sum(rg * rg))
+            ratio_m = jnp.where((w_norm > 0) & (rm_norm > 0),
+                                w_norm / rm_norm, 1.0)
+            ratio_g = jnp.where((w_norm > 0) & (rg_norm > 0),
+                                w_norm / rg_norm, 1.0)
+            w = w - lr * (b1 * ratio_m * rm + (1 - b1) * ratio_g * rg)
+            return w, m, v
+
+        new_w, new_m, new_v = self._kernel("lans", k, donate=(0, 2, 3))(
+            weight._arr, grad._arr, mean._arr, var._arr, _np.float32(lr),
+            _np.float32(wd), _np.float32(self.beta1), _np.float32(self.beta2),
+            _np.float32(self.epsilon), _np.float32(t))
+        weight._set_arr(new_w)
+        mean._set_arr(new_m)
+        var._set_arr(new_v)
+
+
+# ---------------------------------------------------------------------------
+# Updater (≙ python/mxnet/optimizer/updater.py — state serialization for
+# kvstore-side optimizers)
+# ---------------------------------------------------------------------------
+class Updater:
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            index, grad, weight = [index], [grad], [weight]
+        for i, g, w in zip(index, grad, weight):
+            if i not in self.states:
+                self.states[i] = self.optimizer.create_state_multi_precision(i, w)
+            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        state = {}
+        for i, s in self.states.items():
+            state[i] = _state_to_numpy(s)
+        payload = (state, self.optimizer) if dump_optimizer else state
+        return pickle.dumps(payload)
+
+    def set_states(self, states):
+        import pickle
+        data = pickle.loads(states)
+        if isinstance(data, tuple):
+            data, self.optimizer = data
+        from ..ndarray import array
+        self.states = {i: _state_from_numpy(s) for i, s in data.items()}
+
+
+def _state_to_numpy(s):
+    if s is None:
+        return None
+    if isinstance(s, tuple):
+        return tuple(_state_to_numpy(x) for x in s)
+    return s.asnumpy()
+
+
+def _state_from_numpy(s):
+    from ..ndarray import array
+    if s is None:
+        return None
+    if isinstance(s, tuple):
+        return tuple(_state_from_numpy(x) for x in s)
+    return array(s)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,219 @@
+"""mx.profiler — Chrome-trace profiling (≙ python/mxnet/profiler.py:34-363 +
+src/profiler/profiler.h:264).
+
+TPU-native: two layers.
+  1. Framework events: set_config/start/stop record Python-side op invokes +
+     user Task/Frame/Counter objects into an in-process buffer, dumped as
+     Chrome tracing JSON (`dump`) or an aggregate table (`dumps`) — the
+     reference's lock-free per-thread ProfileObject buffers ≙ a list guarded
+     by the GIL here, since op dispatch is not the hot path (XLA is).
+  2. Device traces: profile via jax.profiler (XLA's own instrumentation)
+     writing TensorBoard/perfetto data when `profile_device=True` — replacing
+     the reference's per-worker device lanes.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+
+from .base import MXNetError, get_env
+
+__all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
+           "state", "Task", "Frame", "Event", "Counter", "Domain", "Marker",
+           "profiler_scope", "scope"]
+
+_lock = threading.Lock()
+_events = []          # chrome trace events
+_state = {"running": False, "config": {}, "jax_trace_dir": None,
+          "t0": None}
+
+
+def _now_us():
+    return time.perf_counter() * 1e6
+
+
+def set_config(**kwargs):
+    """≙ profiler.set_config(profile_all=, profile_symbolic=, filename=...)."""
+    _state["config"].update(kwargs)
+
+
+def start(profile_process="worker"):
+    """≙ profiler.set_state('run')."""
+    _state["running"] = True
+    if _state["t0"] is None:
+        _state["t0"] = _now_us()
+    if _state["config"].get("profile_device") or \
+            _state["config"].get("profile_all"):
+        import jax
+        import tempfile
+        d = _state["config"].get("device_trace_dir") or tempfile.mkdtemp(
+            prefix="mx_device_trace_")
+        try:
+            jax.profiler.start_trace(d)
+            _state["jax_trace_dir"] = d
+        except Exception:
+            _state["jax_trace_dir"] = None
+
+
+def stop(profile_process="worker"):
+    """≙ profiler.set_state('stop')."""
+    _state["running"] = False
+    if _state["jax_trace_dir"]:
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _state["jax_trace_dir"] = None
+
+
+def pause(profile_process="worker"):
+    _state["running"] = False
+
+
+def resume(profile_process="worker"):
+    _state["running"] = True
+
+
+def state():
+    return "run" if _state["running"] else "stop"
+
+
+def is_running():
+    return _state["running"]
+
+
+def record_event(name, category, dur_us, ts_us=None, args=None):
+    """Internal hook: ops.registry calls this when profiling is on."""
+    if not _state["running"]:
+        return
+    with _lock:
+        _events.append({
+            "name": name, "cat": category, "ph": "X",
+            "ts": ts_us if ts_us is not None else _now_us(),
+            "dur": dur_us, "pid": 0,
+            "tid": threading.get_ident() % 100000,
+            "args": args or {},
+        })
+
+
+def dump(finished=True, profile_process="worker", filename=None):
+    """Write Chrome tracing JSON (≙ profiler.dump)."""
+    fname = filename or _state["config"].get("filename", "profile.json")
+    with _lock:
+        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    with open(fname, "w") as f:
+        json.dump(payload, f)
+    return fname
+
+
+def dumps(reset=False, format="table"):
+    """Aggregate stats table (≙ profiler.dumps / aggregate_stats.cc)."""
+    with _lock:
+        agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+        for e in _events:
+            a = agg[e["name"]]
+            a[0] += 1
+            a[1] += e["dur"]
+            a[2] = min(a[2], e["dur"])
+            a[3] = max(a[3], e["dur"])
+        if reset:
+            _events.clear()
+    lines = [f"{'Name':<40}{'Calls':>8}{'Total(us)':>14}{'Min(us)':>12}"
+             f"{'Max(us)':>12}",
+             "-" * 86]
+    for name, (calls, total, mn, mx) in sorted(agg.items(),
+                                               key=lambda kv: -kv[1][1]):
+        lines.append(f"{name:<40}{calls:>8}{total:>14.1f}{mn:>12.1f}"
+                     f"{mx:>12.1f}")
+    return "\n".join(lines)
+
+
+class Domain:
+    """≙ profiler.Domain."""
+
+    def __init__(self, name):
+        self.name = name
+
+
+class _Timed:
+    def __init__(self, name, domain=None):
+        self.name = name
+        self.domain = domain
+        self._start = None
+
+    def start(self):
+        self._start = _now_us()
+
+    def stop(self):
+        if self._start is not None:
+            record_event(self.name, type(self).__name__.lower(),
+                         _now_us() - self._start, ts_us=self._start)
+            self._start = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_Timed):
+    """≙ profiler.Task."""
+
+
+class Frame(_Timed):
+    """≙ profiler.Frame."""
+
+
+class Event(_Timed):
+    """≙ profiler.Event."""
+
+
+class Counter:
+    """≙ profiler.Counter."""
+
+    def __init__(self, domain, name, value=0):
+        self.name = name
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+        record_event(self.name, "counter", 0,
+                     args={"value": value})
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+
+class Marker:
+    """≙ profiler.Marker (instant event)."""
+
+    def __init__(self, domain, name):
+        self.name = name
+
+    def mark(self, scope="process"):
+        record_event(self.name, "marker", 0)
+
+
+class profiler_scope:
+    """with profiler.scope('name'): annotate a region."""
+
+    def __init__(self, name):
+        self._task = Task(name)
+
+    def __enter__(self):
+        self._task.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._task.stop()
+
+
+scope = profiler_scope

@@ -1,0 +1,54 @@
+"""mx.runtime — feature introspection (≙ python/mxnet/runtime.py +
+src/libinfo.cc). Features reflect the TPU-native build: what the reference
+gated at compile time (CUDA/CUDNN/MKLDNN/...) is replaced by runtime facts
+about the jax/XLA stack."""
+from __future__ import annotations
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+def _detect():
+    import jax
+    feats = {
+        "TPU": any(d.platform != "cpu" for d in jax.devices()),
+        "CPU": True,
+        "XLA": True,
+        "PALLAS": True,
+        "BF16": True,
+        "F16C": True,
+        "INT64_TENSOR_SIZE": True,
+        "SPMD": True,
+        "DIST_KVSTORE": True,
+        "PROFILER": True,
+        # reference features with no TPU equivalent:
+        "CUDA": False, "CUDNN": False, "NCCL": False, "TENSORRT": False,
+        "MKLDNN": False, "OPENCV": False, "OPENMP": True,
+        "SIGNAL_HANDLER": False, "DEBUG": False, "TVM_OP": False,
+    }
+    return feats
+
+
+class Features(dict):
+    """≙ mx.runtime.Features()."""
+
+    def __init__(self):
+        super().__init__({k: Feature(k, v) for k, v in _detect().items()})
+
+    def is_enabled(self, name):
+        return self[name.upper()].enabled
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+
+def feature_list():
+    return list(Features().values())

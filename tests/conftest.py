@@ -7,11 +7,17 @@ mesh in-process).
 """
 import os
 
-# Must happen before jax initializes.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must happen before jax initializes. The axon sitecustomize pre-registers a
+# TPU backend and rewrites JAX_PLATFORMS, so force the platform through the
+# config API, not the env var.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as _np
 import pytest

@@ -1,0 +1,272 @@
+"""AMP, gluon.data, mx.io, recordio, profiler, runtime tests
+(≙ reference tests/python/gpu/test_amp.py, unittest/test_gluon_data.py,
+test_io.py, test_recordio.py, test_profiler.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+
+
+# ---------------------------------------------------------------------------
+# AMP
+# ---------------------------------------------------------------------------
+def test_amp_autocast_matmul_bf16():
+    from incubator_mxnet_tpu import amp
+    a = mx.np.ones((8, 8))
+    b = mx.np.ones((8, 8))
+    with amp.autocast():
+        out = mx.np.matmul(a, b)
+    assert str(out.dtype) == "bfloat16"
+    out2 = mx.np.matmul(a, b)
+    assert str(out2.dtype) == "float32"
+
+
+def test_amp_fp32_ops_stay_fp32():
+    from incubator_mxnet_tpu import amp, npx
+    x = mx.np.ones((4, 4), dtype="bfloat16")
+    with amp.autocast():
+        out = npx.softmax(x)
+    assert str(out.dtype) == "float32"
+
+
+def test_all_finite():
+    from incubator_mxnet_tpu import amp
+    good = [mx.np.ones((3,)), mx.np.zeros((2, 2))]
+    assert bool(amp.all_finite(good).asnumpy())
+    bad = [mx.np.array(np.array([1.0, np.inf], np.float32))]
+    assert not bool(amp.all_finite(bad).asnumpy())
+
+
+def test_loss_scaler_dynamics():
+    from incubator_mxnet_tpu.amp import LossScaler
+    from incubator_mxnet_tpu.gluon import nn
+    s = LossScaler(init_scale=4.0, scale_factor=2.0, scale_window=2)
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    params = list(net.collect_params().values())
+    x = mx.np.ones((1, 1))
+    with mx.autograd.record():
+        net(x).sum().backward()
+    assert not s.has_overflow(params)
+    assert not s.has_overflow(params)
+    assert s.loss_scale == 8.0  # grew after window
+    # force overflow
+    net.weight.data().grad[:] = np.inf
+    assert s.has_overflow(params)
+    assert s.loss_scale == 4.0
+
+
+def test_amp_scale_loss_trainer():
+    from incubator_mxnet_tpu import amp
+    from incubator_mxnet_tpu.gluon import nn
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(init="ones")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0})
+    amp.init_trainer(trainer)
+    x = mx.np.ones((2, 2))
+    with mx.autograd.record():
+        loss = net(x).sum()
+        with amp.scale_loss(loss, trainer) as scaled:
+            scaled.backward()
+    trainer.step(2)
+    # effective update must equal unscaled: grad [2,2]/2=1 -> w = 0
+    np.testing.assert_allclose(net.weight.data().asnumpy(),
+                               np.zeros((1, 2)), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# gluon.data
+# ---------------------------------------------------------------------------
+def test_array_dataset_dataloader():
+    from incubator_mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.random.randn(10, 3).astype(np.float32)
+    Y = np.arange(10).astype(np.int32)
+    ds = ArrayDataset(X, Y)
+    assert len(ds) == 10
+    loader = DataLoader(ds, batch_size=4, shuffle=False, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 3
+    xb, yb = batches[0]
+    assert xb.shape == (4, 3)
+    np.testing.assert_array_equal(yb.asnumpy(), [0, 1, 2, 3])
+
+
+def test_dataloader_threaded_matches_serial():
+    from incubator_mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.arange(32, dtype=np.float32).reshape(16, 2)
+    ds = ArrayDataset(X)
+    serial = [b.asnumpy() for b in DataLoader(ds, 4)]
+    threaded = [b.asnumpy() for b in DataLoader(ds, 4, num_workers=2)]
+    for a, b in zip(serial, threaded):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_dataset_transform_shard():
+    from incubator_mxnet_tpu.gluon.data import SimpleDataset
+    ds = SimpleDataset(list(range(10)))
+    t = ds.transform(lambda x: x * 2)
+    assert t[3] == 6
+    sh = ds.shard(3, 0)
+    assert len(sh) == 4  # 10 = 4+3+3
+
+
+def test_batch_sampler_modes():
+    from incubator_mxnet_tpu.gluon.data import (SequentialSampler,
+                                                BatchSampler)
+    bs = BatchSampler(SequentialSampler(10), 3, "discard")
+    assert len(list(bs)) == 3
+    bs = BatchSampler(SequentialSampler(10), 3, "keep")
+    assert len(list(bs)) == 4
+
+
+# ---------------------------------------------------------------------------
+# recordio
+# ---------------------------------------------------------------------------
+def test_recordio_roundtrip(tmp_path):
+    from incubator_mxnet_tpu import recordio
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"world" * 100, b"x"]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio_and_pack(tmp_path):
+    from incubator_mxnet_tpu import recordio
+    rec = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(5):
+        header = recordio.IRHeader(0, float(i), i, 0)
+        w.write_idx(i, recordio.pack(header, bytes([i]) * 10))
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    h, payload = recordio.unpack(r.read_idx(3))
+    assert h.label == 3.0
+    assert payload == bytes([3]) * 10
+    r.close()
+
+
+def test_recordio_magic_in_payload(tmp_path):
+    """Payload containing the magic bytes must round-trip (chunked cflag)."""
+    import struct
+    from incubator_mxnet_tpu import recordio
+    path = str(tmp_path / "m.rec")
+    payload = b"A" * 5 + struct.pack("<I", 0x3ed7230a) + b"B" * 7
+    w = recordio.MXRecordIO(path, "w")
+    w.write(payload)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == payload
+
+
+# ---------------------------------------------------------------------------
+# mx.io
+# ---------------------------------------------------------------------------
+def test_ndarray_iter():
+    from incubator_mxnet_tpu.io import NDArrayIter
+    X = np.random.randn(10, 4).astype(np.float32)
+    Y = np.arange(10)
+    it = NDArrayIter(X, Y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_discard():
+    from incubator_mxnet_tpu.io import NDArrayIter
+    it = NDArrayIter(np.zeros((10, 2)), np.zeros(10), batch_size=3,
+                     last_batch_handle="discard")
+    assert len(list(it)) == 3
+
+
+# ---------------------------------------------------------------------------
+# profiler / runtime / engine / util
+# ---------------------------------------------------------------------------
+def test_profiler_events_and_dump(tmp_path):
+    from incubator_mxnet_tpu import profiler
+    profiler.set_config(filename=str(tmp_path / "prof.json"))
+    profiler.start()
+    with profiler.Task("my_task"):
+        mx.np.ones((4, 4)).wait_to_read()
+    profiler.record_event("custom", "op", 12.5)
+    profiler.stop()
+    f = profiler.dump()
+    import json
+    data = json.load(open(f))
+    names = [e["name"] for e in data["traceEvents"]]
+    assert "my_task" in names and "custom" in names
+    table = profiler.dumps()
+    assert "my_task" in table
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert not feats.is_enabled("CUDA")
+
+
+def test_engine_facade():
+    from incubator_mxnet_tpu import engine
+    with engine.bulk(16):
+        assert engine.current_bulk_size() == 16
+    assert engine.current_bulk_size() == 0
+    engine.wait_for_all()
+
+
+def test_test_utils():
+    from incubator_mxnet_tpu import test_utils as tu
+    tu.assert_almost_equal(np.ones(3), np.ones(3) + 1e-7)
+    a = tu.rand_ndarray((3, 4))
+    assert a.shape == (3, 4)
+    tu.check_numeric_gradient(lambda x: (x * x).sum(),
+                              [np.random.randn(3).astype(np.float64)])
+
+
+def test_amp_backward_not_autocast():
+    """Regression: gradient accumulation under AMP must stay f32 — an
+    accumulated grad of 513 x4 would collapse to 2048 in bf16."""
+    from incubator_mxnet_tpu import amp
+    x = mx.np.array(np.array([1.0], np.float32))
+    x.attach_grad(grad_req="add")
+    amp.init()
+    try:
+        for _ in range(4):
+            with mx.autograd.record():
+                # true_divide is FP32-listed → exact f32 per-step grad of 513;
+                # if the accumulation add ran under autocast (bf16) the sum
+                # would collapse to 2048 instead of 2052
+                y = mx.np.true_divide(x, 1.0 / 513.0)
+            y.backward()
+    finally:
+        amp.uninit()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4 * 513.0], rtol=1e-6)
+
+
+def test_amp_autocast_nesting():
+    """Regression: autocast(True) inside autocast(False) must re-enable."""
+    from incubator_mxnet_tpu import amp
+    amp.init()
+    try:
+        with amp.autocast(False):
+            assert not amp.is_active()
+            with amp.autocast(True):
+                assert amp.is_active()
+            assert not amp.is_active()
+        assert amp.is_active()
+    finally:
+        amp.uninit()
+    assert not amp.is_active()

@@ -227,3 +227,33 @@ def test_finite_difference_check():
         xm[i] -= eps
         num[i] = ((onp.tanh(xp) * xp).sum() - (onp.tanh(xm) * xm).sum()) / (2 * eps)
     assert onp.allclose(x.grad.asnumpy(), num, atol=1e-2)
+
+
+def test_grad_wrt_intermediate():
+    """Regression: grad() w.r.t. a tape-connected non-leaf must return the
+    true cotangent, not zeros."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd as ag
+    x = mx.np.array(np.array([2.0, 3.0], np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        z = y * y
+    g = ag.grad(z, y)
+    np.testing.assert_allclose(g.asnumpy(), 2 * (2 * x.asnumpy()), rtol=1e-6)
+
+
+def test_bfloat16_autograd_taped():
+    """Regression: bf16 outputs must be taped (ml_dtypes bfloat16 is not a
+    np.floating subtype)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    x = mx.np.ones((3,), dtype="bfloat16")
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(np.asarray(x.grad.asnumpy(), np.float32),
+                               2 * np.ones(3), rtol=1e-2)

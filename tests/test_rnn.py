@@ -1,0 +1,102 @@
+"""gluon.rnn tests (≙ reference tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.gluon import rnn
+
+
+def test_lstm_cell_shapes():
+    cell = rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    x = mx.np.array(np.random.randn(2, 4).astype(np.float32))
+    out, states = cell(x, cell.begin_state(2))
+    assert out.shape == (2, 8)
+    assert len(states) == 2
+
+
+def test_gru_rnn_cells():
+    for cell in (rnn.GRUCell(6, input_size=3), rnn.RNNCell(6, input_size=3)):
+        cell.initialize()
+        x = mx.np.array(np.random.randn(2, 3).astype(np.float32))
+        out, states = cell(x, cell.begin_state(2))
+        assert out.shape == (2, 6)
+        assert len(states) == 1
+
+
+def test_unroll_merge():
+    cell = rnn.GRUCell(5, input_size=3)
+    cell.initialize()
+    seq = mx.np.array(np.random.randn(2, 7, 3).astype(np.float32))
+    merged, states = cell.unroll(7, seq, layout="NTC")
+    assert merged.shape == (2, 7, 5)
+    outs, _ = cell.unroll(7, seq, layout="NTC", merge_outputs=False)
+    assert len(outs) == 7 and outs[0].shape == (2, 5)
+
+
+def test_sequential_cell():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(4, input_size=3))
+    stack.add(rnn.LSTMCell(5, input_size=4))
+    stack.initialize()
+    x = mx.np.array(np.random.randn(2, 3).astype(np.float32))
+    out, states = stack(x, stack.begin_state(2))
+    assert out.shape == (2, 5)
+    assert len(states) == 4
+
+
+def test_residual_dropout_cells():
+    cell = rnn.ResidualCell(rnn.GRUCell(3, input_size=3))
+    cell.initialize()
+    x = mx.np.array(np.random.randn(2, 3).astype(np.float32))
+    out, _ = cell(x, cell.begin_state(2))
+    assert out.shape == (2, 3)
+
+    d = rnn.DropoutCell(0.5)
+    out2, _ = d(x, [])
+    assert out2.shape == x.shape
+
+
+def test_fused_lstm_fwd_bwd():
+    lstm = rnn.LSTM(16, num_layers=2)
+    lstm.initialize()
+    seq = mx.np.array(np.random.randn(5, 3, 6).astype(np.float32))
+    with mx.autograd.record():
+        out = lstm(seq)
+        out.sum().backward()
+    assert out.shape == (5, 3, 16)
+    g = lstm.l0_i2h_weight.grad()
+    assert np.isfinite(g.asnumpy()).all() and abs(g.asnumpy()).sum() > 0
+
+
+def test_fused_bidirectional_states():
+    lstm = rnn.LSTM(8, bidirectional=True, layout="NTC")
+    lstm.initialize()
+    seq = mx.np.array(np.random.randn(3, 5, 4).astype(np.float32))
+    out, states = lstm(seq, lstm.begin_state(3))
+    assert out.shape == (3, 5, 16)
+    assert states[0].shape == (2, 3, 8)
+    assert states[1].shape == (2, 3, 8)
+
+
+def test_fused_vs_cell_unroll_match():
+    """Fused GRU layer must match the composable GRUCell scan numerically."""
+    gru = rnn.GRU(4, input_size=3)
+    gru.initialize()
+    cell = rnn.GRUCell(4, input_size=3)
+    cell.initialize()
+    cell.i2h_weight.set_data(gru.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(gru.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(gru.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(gru.l0_h2h_bias.data())
+    seq = mx.np.array(np.random.randn(6, 2, 3).astype(np.float32))
+    fused = gru(seq).asnumpy()
+    merged, _ = cell.unroll(6, seq, layout="TNC")
+    np.testing.assert_allclose(fused, merged.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_relu_mode():
+    net = rnn.RNN(8, activation="relu")
+    net.initialize()
+    seq = mx.np.array(np.random.randn(4, 2, 3).astype(np.float32))
+    assert net(seq).shape == (4, 2, 8)
