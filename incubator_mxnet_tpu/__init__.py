@@ -37,7 +37,8 @@ from . import ops
 # builds during bring-up.
 _LAZY = ("gluon", "optimizer", "kvstore", "parallel", "amp", "profiler",
          "initializer", "lr_scheduler", "metric", "test_utils", "util",
-         "runtime", "io", "image", "engine", "context")
+         "runtime", "io", "image", "engine", "context", "recordio",
+         "checkpoint", "visualization", "models", "native")
 
 
 def __getattr__(name):

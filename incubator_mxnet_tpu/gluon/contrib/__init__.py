@@ -1,0 +1,2 @@
+"""gluon.contrib (≙ python/mxnet/gluon/contrib): estimator + extras."""
+from . import estimator

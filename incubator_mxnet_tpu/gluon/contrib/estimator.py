@@ -1,0 +1,330 @@
+"""Estimator — Keras-like fit loop with event handlers.
+
+Reference: python/mxnet/gluon/contrib/estimator/{estimator,event_handler}.py
+(~1.5k LoC): Estimator.fit with train/val dataflow, EventHandler taxonomy
+(TrainBegin/EpochBegin/BatchBegin/BatchEnd/EpochEnd/TrainEnd), built-ins:
+StoppingHandler, MetricHandler, ValidationHandler, LoggingHandler,
+CheckpointHandler, EarlyStoppingHandler.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import numpy as _np
+
+from ...base import MXNetError
+from .. import metric as metric_mod
+from ..trainer import Trainer
+
+__all__ = ["Estimator", "EventHandler", "TrainBegin", "TrainEnd",
+           "EpochBegin", "EpochEnd", "BatchBegin", "BatchEnd",
+           "StoppingHandler", "MetricHandler", "ValidationHandler",
+           "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler"]
+
+
+class EventHandler:
+    pass
+
+
+class TrainBegin(EventHandler):
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd(EventHandler):
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin(EventHandler):
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd(EventHandler):
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin(EventHandler):
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd(EventHandler):
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop at max_epoch/max_batch (≙ event_handler.StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            estimator.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            estimator.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset per epoch, update per batch (≙ event_handler.MetricHandler)."""
+
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, pred=None, label=None, loss=None,
+                  **kwargs):
+        for m in self.metrics:
+            if isinstance(m, metric_mod.Loss):
+                m.update(None, loss)
+            else:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation on a cadence (≙ event_handler.ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """≙ event_handler.LoggingHandler."""
+
+    def __init__(self, log_interval="epoch", metrics=None, priority=-3000):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.priority = priority
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.logger = logging.getLogger("estimator")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.logger.info("Training done in %.1fs",
+                         time.time() - self.train_start)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.batch_index = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.batch_index += 1
+        if self.log_interval != "epoch" and \
+                self.batch_index % int(self.log_interval) == 0:
+            self._log()
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        self._log()
+
+    def _log(self):
+        msgs = [f"[epoch {self.current_epoch} batch {self.batch_index}]"]
+        for m in self.metrics:
+            name, value = m.get()
+            msgs.append(f"{name}={value:.4f}"
+                        if isinstance(value, float) else f"{name}={value}")
+        self.logger.info(" ".join(msgs))
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Periodic + best-only checkpointing (≙ event_handler.CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.current_epoch = 0
+        self.current_batch = 0
+        if mode == "auto":
+            mode = "min" if monitor is not None and \
+                "loss" in monitor.get()[0] else "max"
+        self.mode = mode
+        self.best = _np.inf if mode == "min" else -_np.inf
+
+    def train_begin(self, estimator, *args, **kwargs):
+        os.makedirs(self.model_dir, exist_ok=True)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self._save(estimator, f"batch{self.current_batch}")
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self._save(estimator, f"epoch{self.current_epoch}")
+
+    def _save(self, estimator, tag):
+        if self.save_best and self.monitor is not None:
+            _, value = self.monitor.get()
+            improved = (value < self.best if self.mode == "min"
+                        else value > self.best)
+            if improved:
+                self.best = value
+                estimator.net.save_parameters(os.path.join(
+                    self.model_dir, f"{self.model_prefix}-best.params.npz"))
+        path = os.path.join(self.model_dir,
+                            f"{self.model_prefix}-{tag}.params.npz")
+        estimator.net.save_parameters(path)
+        if estimator.trainer is not None:
+            estimator.trainer.save_states(path + ".states")
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """≙ event_handler.EarlyStoppingHandler."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        if mode == "auto":
+            mode = "min" if "loss" in monitor.get()[0] else "max"
+        self.mode = mode
+        self.baseline = baseline
+        self.wait = 0
+        self.best = _np.inf if mode == "min" else -_np.inf
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        _, value = self.monitor.get()
+        if not isinstance(value, (int, float)) or _np.isnan(value):
+            return
+        improved = (value < self.best - self.min_delta if self.mode == "min"
+                    else value > self.best + self.min_delta)
+        if improved:
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                estimator.stop_training = True
+
+
+class Estimator:
+    """≙ gluon.contrib.estimator.Estimator."""
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None, device=None,
+                 evaluation_loss=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = train_metrics or [metric_mod.Accuracy()]
+        if not isinstance(self.train_metrics, (list, tuple)):
+            self.train_metrics = [self.train_metrics]
+        self.train_metrics = list(self.train_metrics)
+        self.train_metrics.append(metric_mod.Loss("train_loss"))
+        self.val_metrics = val_metrics or [
+            metric_mod.create(type(m).__name__.lower())
+            for m in self.train_metrics[:-1]]
+        self.trainer = trainer or Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 1e-3})
+        self.evaluation_loss = evaluation_loss or loss
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def evaluate(self, val_data):
+        from ... import autograd
+        for m in self.val_metrics:
+            m.reset()
+        for batch in val_data:
+            x, y = batch[0], batch[1]
+            with autograd.predict_mode():
+                pred = self.net(x)
+            for m in self.val_metrics:
+                m.update(y, pred)
+        return {m.get()[0]: m.get()[1] for m in self.val_metrics}
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        from ... import autograd
+        if epochs is None and batches is None:
+            epochs = 1
+        handlers = list(event_handlers or [])
+        handlers.append(StoppingHandler(epochs, batches))
+        handlers.append(MetricHandler(self.train_metrics))
+        if val_data is not None:
+            handlers.append(ValidationHandler(
+                val_data, self.evaluate))
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
+        self.stop_training = False
+
+        def emit(kind, **kw):
+            for h in handlers:
+                fn = getattr(h, kind, None)
+                if fn is not None:
+                    fn(self, **kw)
+
+        emit("train_begin")
+        while not self.stop_training:
+            emit("epoch_begin")
+            for batch in train_data:
+                if self.stop_training:
+                    break
+                x, y = batch[0], batch[1]
+                emit("batch_begin")
+                with autograd.record():
+                    pred = self.net(x)
+                    loss = self.loss(pred, y)
+                    loss_scalar = loss.mean()
+                loss_scalar.backward()
+                batch_size = x.shape[batch_axis]
+                self.trainer.step(batch_size)
+                emit("batch_end", pred=pred, label=y, loss=loss_scalar)
+            emit("epoch_end")
+        emit("train_end")
+        return self

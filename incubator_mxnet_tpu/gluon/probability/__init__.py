@@ -1,0 +1,14 @@
+"""gluon.probability (≙ python/mxnet/gluon/probability/ ~8k LoC).
+
+Distributions with log_prob/sample/entropy/mean/variance, a KL-divergence
+registry, and StochasticBlock. Sampling uses the framework's functional PRNG
+(mx.random key plumbing), densities lower to jax.scipy — each distribution
+is a thin declarative layer instead of the reference's per-distribution
+C++ sampler ops (src/operator/random/*).
+"""
+from .distributions import (Distribution, Normal, Bernoulli, Categorical,
+                            Uniform, Exponential, Gamma, Poisson, Laplace,
+                            Beta, Dirichlet, StudentT, HalfNormal, Cauchy,
+                            Geometric, Binomial, MultivariateNormal,
+                            kl_divergence, register_kl)
+from .stochastic_block import StochasticBlock, StochasticSequential

@@ -1,0 +1,662 @@
+"""Distribution zoo (≙ gluon/probability/distributions/*)."""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from ...base import MXNetError
+from ...ndarray import NDArray, _as_nd, _wrap
+from ...ops.registry import invoke
+from ... import random as _random
+
+__all__ = ["Distribution", "Normal", "Bernoulli", "Categorical", "Uniform",
+           "Exponential", "Gamma", "Poisson", "Laplace", "Beta", "Dirichlet",
+           "StudentT", "HalfNormal", "Cauchy", "Geometric", "Binomial",
+           "MultivariateNormal", "kl_divergence", "register_kl"]
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator registering a KL(p||q) implementation (≙ register_kl)."""
+    def _reg(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return _reg
+
+
+def kl_divergence(p, q):
+    """≙ mx.gluon.probability.kl_divergence."""
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise MXNetError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+def _jx():
+    import jax
+    return jax
+
+
+def _call(fn, *arrays):
+    return invoke(fn, tuple(_as_nd(a) for a in arrays), name="prob")
+
+
+def _sample_shape(size, batch_shape):
+    if size is None:
+        return tuple(batch_shape)
+    if isinstance(size, int):
+        size = (size,)
+    return tuple(size) + tuple(batch_shape)
+
+
+class Distribution:
+    """Base distribution (≙ probability.Distribution)."""
+
+    has_grad = True
+
+    def __init__(self, **params):
+        self._params = {k: _as_nd(v) if not isinstance(v, NDArray) else v
+                        for k, v in params.items() if v is not None}
+        for k, v in self._params.items():
+            setattr(self, k, v)
+
+    @property
+    def batch_shape(self):
+        shapes = [p.shape for p in self._params.values()]
+        if not shapes:
+            return ()
+        return _np.broadcast_shapes(*shapes)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ... import numpy as mxnp
+        return mxnp.exp(self.log_prob(value))
+
+    def sample(self, size=None):
+        raise NotImplementedError
+
+    def sample_n(self, size=None):
+        return self.sample(size)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        from ... import numpy as mxnp
+        return mxnp.sqrt(self.variance)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({list(self._params)})"
+
+
+class Normal(Distribution):
+    def __init__(self, loc=0.0, scale=1.0):
+        super().__init__(loc=loc, scale=scale)
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            import jax.numpy as jnp
+            var = scale ** 2
+            return (-((v - loc) ** 2) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+        return _call(f, value, self.loc, self.scale)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(loc, scale):
+            import jax
+            return loc + scale * jax.random.normal(key, shape)
+        return _call(f, self.loc, self.scale)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale ** 2
+
+    def entropy(self):
+        from ... import numpy as mxnp
+        return 0.5 + 0.5 * math.log(2 * math.pi) + mxnp.log(self.scale)
+
+
+class HalfNormal(Normal):
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            import jax.numpy as jnp
+            var = scale ** 2
+            lp = (-((v - loc) ** 2) / (2 * var) - jnp.log(scale)
+                  - 0.5 * math.log(2 * math.pi) + math.log(2.0))
+            return jnp.where(v >= loc, lp, -_np.inf)
+        return _call(f, value, self.loc, self.scale)
+
+    def sample(self, size=None):
+        from ... import numpy as mxnp
+        return self.loc + mxnp.abs(super().sample(size) - self.loc)
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * math.sqrt(2 / math.pi)
+
+    @property
+    def variance(self):
+        return (self.scale ** 2) * (1 - 2 / math.pi)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc=0.0, scale=1.0):
+        super().__init__(loc=loc, scale=scale)
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            import jax.numpy as jnp
+            return -jnp.abs(v - loc) / scale - jnp.log(2 * scale)
+        return _call(f, value, self.loc, self.scale)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(loc, scale):
+            import jax
+            return loc + scale * jax.random.laplace(key, shape)
+        return _call(f, self.loc, self.scale)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2 * self.scale ** 2
+
+    def entropy(self):
+        from ... import numpy as mxnp
+        return 1 + mxnp.log(2 * self.scale)
+
+
+class Cauchy(Distribution):
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0):
+        super().__init__(loc=loc, scale=scale)
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            import jax.numpy as jnp
+            return (-math.log(math.pi) - jnp.log(scale)
+                    - jnp.log1p(((v - loc) / scale) ** 2))
+        return _call(f, value, self.loc, self.scale)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(loc, scale):
+            import jax
+            return loc + scale * jax.random.cauchy(key, shape)
+        return _call(f, self.loc, self.scale)
+
+
+class Uniform(Distribution):
+    def __init__(self, low=0.0, high=1.0):
+        super().__init__(low=low, high=high)
+
+    def log_prob(self, value):
+        def f(v, low, high):
+            import jax.numpy as jnp
+            inside = (v >= low) & (v <= high)
+            return jnp.where(inside, -jnp.log(high - low), -_np.inf)
+        return _call(f, value, self.low, self.high)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(low, high):
+            import jax
+            return low + (high - low) * jax.random.uniform(key, shape)
+        return _call(f, self.low, self.high)
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2
+
+    @property
+    def variance(self):
+        return ((self.high - self.low) ** 2) / 12
+
+    def entropy(self):
+        from ... import numpy as mxnp
+        return mxnp.log(self.high - self.low)
+
+
+class Exponential(Distribution):
+    def __init__(self, scale=1.0):
+        super().__init__(scale=scale)
+
+    def log_prob(self, value):
+        def f(v, scale):
+            import jax.numpy as jnp
+            return jnp.where(v >= 0, -v / scale - jnp.log(scale), -_np.inf)
+        return _call(f, value, self.scale)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(scale):
+            import jax
+            return scale * jax.random.exponential(key, shape)
+        return _call(f, self.scale)
+
+    @property
+    def mean(self):
+        return self.scale
+
+    @property
+    def variance(self):
+        return self.scale ** 2
+
+    def entropy(self):
+        from ... import numpy as mxnp
+        return 1 + mxnp.log(self.scale)
+
+
+class Gamma(Distribution):
+    def __init__(self, shape=1.0, scale=1.0):
+        super().__init__(shape_param=shape, scale=scale)
+
+    def log_prob(self, value):
+        def f(v, a, s):
+            import jax
+            import jax.numpy as jnp
+            return ((a - 1) * jnp.log(v) - v / s
+                    - jax.scipy.special.gammaln(a) - a * jnp.log(s))
+        return _call(f, value, self.shape_param, self.scale)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(a, s):
+            import jax
+            return s * jax.random.gamma(key, a, shape)
+        return _call(f, self.shape_param, self.scale)
+
+    @property
+    def mean(self):
+        return self.shape_param * self.scale
+
+    @property
+    def variance(self):
+        return self.shape_param * self.scale ** 2
+
+
+class Beta(Distribution):
+    def __init__(self, alpha=1.0, beta=1.0):
+        super().__init__(alpha=alpha, beta=beta)
+
+    def log_prob(self, value):
+        def f(v, a, b):
+            import jax
+            import jax.numpy as jnp
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+        return _call(f, value, self.alpha, self.beta)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(a, b):
+            import jax
+            return jax.random.beta(key, a, b, shape)
+        return _call(f, self.alpha, self.beta)
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, alpha):
+        super().__init__(alpha=alpha)
+
+    def log_prob(self, value):
+        def f(v, a):
+            import jax
+            import jax.numpy as jnp
+            lnorm = (jnp.sum(jax.scipy.special.gammaln(a), -1)
+                     - jax.scipy.special.gammaln(jnp.sum(a, -1)))
+            return jnp.sum((a - 1) * jnp.log(v), -1) - lnorm
+        return _call(f, value, self.alpha)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.alpha.shape[:-1])
+
+        def f(a):
+            import jax
+            return jax.random.dirichlet(key, a, shape)
+        return _call(f, self.alpha)
+
+    @property
+    def mean(self):
+        return self.alpha / self.alpha.sum(axis=-1, keepdims=True)
+
+
+class Poisson(Distribution):
+    has_grad = False
+
+    def __init__(self, rate=1.0):
+        super().__init__(rate=rate)
+
+    def log_prob(self, value):
+        def f(v, rate):
+            import jax
+            import jax.numpy as jnp
+            return v * jnp.log(rate) - rate - jax.scipy.special.gammaln(v + 1)
+        return _call(f, value, self.rate)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(rate):
+            import jax
+            return jax.random.poisson(key, rate, shape).astype("float32")
+        return _call(f, self.rate)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+
+class Bernoulli(Distribution):
+    has_grad = False
+
+    def __init__(self, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("pass exactly one of prob/logit")
+        if logit is not None:
+            from ... import numpy_extension as npx
+            prob = npx.sigmoid(_as_nd(logit))
+        super().__init__(prob=prob)
+
+    def log_prob(self, value):
+        def f(v, p):
+            import jax.numpy as jnp
+            eps = 1e-12
+            return v * jnp.log(p + eps) + (1 - v) * jnp.log1p(-p + eps)
+        return _call(f, value, self.prob)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(p):
+            import jax
+            return jax.random.bernoulli(key, p, shape).astype("float32")
+        return _call(f, self.prob)
+
+    @property
+    def mean(self):
+        return self.prob
+
+    @property
+    def variance(self):
+        return self.prob * (1 - self.prob)
+
+    def entropy(self):
+        from ... import numpy as mxnp
+        p = self.prob
+        eps = 1e-12
+        return -(p * mxnp.log(p + eps) + (1 - p) * mxnp.log1p(-p + eps))
+
+
+class Geometric(Distribution):
+    has_grad = False
+
+    def __init__(self, prob):
+        super().__init__(prob=prob)
+
+    def log_prob(self, value):
+        def f(v, p):
+            import jax.numpy as jnp
+            return v * jnp.log1p(-p) + jnp.log(p)
+        return _call(f, value, self.prob)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(p):
+            import jax
+            import jax.numpy as jnp
+            u = jax.random.uniform(key, shape, minval=1e-7)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+        return _call(f, self.prob)
+
+    @property
+    def mean(self):
+        return (1 - self.prob) / self.prob
+
+
+class Binomial(Distribution):
+    has_grad = False
+
+    def __init__(self, n=1, prob=0.5):
+        self.n = int(n)
+        super().__init__(prob=prob)
+
+    def log_prob(self, value):
+        n = self.n
+
+        def f(v, p):
+            import jax
+            import jax.numpy as jnp
+            logc = (jax.scipy.special.gammaln(n + 1.0)
+                    - jax.scipy.special.gammaln(v + 1.0)
+                    - jax.scipy.special.gammaln(n - v + 1.0))
+            return logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+        return _call(f, value, self.prob)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+        n = self.n
+
+        def f(p):
+            import jax
+            return jax.random.binomial(key, n, p, shape=shape)
+        return _call(f, self.prob)
+
+    @property
+    def mean(self):
+        return self.n * self.prob
+
+    @property
+    def variance(self):
+        return self.n * self.prob * (1 - self.prob)
+
+
+class Categorical(Distribution):
+    has_grad = False
+
+    def __init__(self, num_events=None, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("pass exactly one of prob/logit")
+        if logit is None:
+            from ... import numpy as mxnp
+            logit = mxnp.log(_as_nd(prob) + 1e-12)
+        super().__init__(logit=logit)
+        self.num_events = num_events or self.logit.shape[-1]
+
+    @property
+    def prob(self):
+        from ... import numpy_extension as npx
+        return npx.softmax(self.logit, axis=-1)
+
+    def log_prob(self, value):
+        def f(v, logit):
+            import jax
+            import jax.numpy as jnp
+            logp = jax.nn.log_softmax(logit, axis=-1)
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+        return _call(f, value, self.logit)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape[:-1])
+
+        def f(logit):
+            import jax
+            return jax.random.categorical(key, logit, shape=shape)
+        return _call(f, self.logit)
+
+    def entropy(self):
+        def f(logit):
+            import jax
+            import jax.numpy as jnp
+            logp = jax.nn.log_softmax(logit, axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return _call(f, self.logit)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0):
+        super().__init__(df=df, loc=loc, scale=scale)
+
+    def log_prob(self, value):
+        def f(v, df, loc, scale):
+            import jax
+            import jax.numpy as jnp
+            z = (v - loc) / scale
+            return (jax.scipy.special.gammaln((df + 1) / 2)
+                    - jax.scipy.special.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(scale)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+        return _call(f, value, self.df, self.loc, self.scale)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(df, loc, scale):
+            import jax
+            return loc + scale * jax.random.t(key, df, shape)
+        return _call(f, self.df, self.loc, self.scale)
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, cov=None, scale_tril=None):
+        if (cov is None) == (scale_tril is None):
+            raise MXNetError("pass exactly one of cov/scale_tril")
+        if scale_tril is None:
+            def chol(c):
+                import jax.numpy as jnp
+                return jnp.linalg.cholesky(c)
+            scale_tril = _call(chol, cov)
+        super().__init__(loc=loc, scale_tril=scale_tril)
+
+    def log_prob(self, value):
+        def f(v, loc, L):
+            import jax
+            import jax.numpy as jnp
+            d = loc.shape[-1]
+            diff = v - loc
+            sol = jax.scipy.linalg.solve_triangular(L, diff[..., None],
+                                                    lower=True)[..., 0]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            return (-0.5 * jnp.sum(sol * sol, -1) - logdet
+                    - 0.5 * d * math.log(2 * math.pi))
+        return _call(f, value, self.loc, self.scale_tril)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(loc, L):
+            import jax
+            import jax.numpy as jnp
+            eps = jax.random.normal(key, shape)
+            return loc + jnp.einsum("...ij,...j->...i", L, eps)
+        return _call(f, self.loc, self.scale_tril)
+
+    @property
+    def mean(self):
+        return self.loc
+
+
+# ---------------------------------------------------------------------------
+# KL divergences (≙ probability KL registry)
+# ---------------------------------------------------------------------------
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    from ... import numpy as mxnp
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - mxnp.log(var_ratio))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    from ... import numpy as mxnp
+    eps = 1e-12
+    a, b = p.prob, q.prob
+    return (a * (mxnp.log(a + eps) - mxnp.log(b + eps))
+            + (1 - a) * (mxnp.log1p(-a + eps) - mxnp.log1p(-b + eps)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    def f(lp, lq):
+        import jax
+        import jax.numpy as jnp
+        a = jax.nn.log_softmax(lp, -1)
+        b = jax.nn.log_softmax(lq, -1)
+        return jnp.sum(jnp.exp(a) * (a - b), -1)
+    return _call(f, p.logit, q.logit)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    from ... import numpy as mxnp
+    rate_ratio = q.scale / p.scale
+    return mxnp.log(rate_ratio) + 1.0 / rate_ratio - 1.0
+
+
+@register_kl(Uniform, Uniform)
+def _kl_unif_unif(p, q):
+    from ... import numpy as mxnp
+    return mxnp.log((q.high - q.low) / (p.high - p.low))

@@ -1,0 +1,49 @@
+"""StochasticBlock (≙ gluon/probability/block/stochastic_block.py):
+a HybridBlock that collects auxiliary losses (e.g. KL terms) added during
+forward via add_loss."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn import HybridSequential
+
+__all__ = ["StochasticBlock", "StochasticSequential"]
+
+
+class StochasticBlock(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self._losses = []
+        self._flag = False
+
+    def add_loss(self, loss):
+        self._flag = True
+        self._losses.append(loss)
+
+    @property
+    def losses(self):
+        return self._losses
+
+    def __call__(self, *args, **kwargs):
+        self._losses = []
+        return super().__call__(*args, **kwargs)
+
+
+class StochasticSequential(StochasticBlock):
+    """≙ probability.StochasticSequential."""
+
+    def __init__(self, *blocks):
+        super().__init__()
+        for b in blocks:
+            self.add(b)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+            if isinstance(block, StochasticBlock):
+                self._losses.extend(block.losses)
+        return x

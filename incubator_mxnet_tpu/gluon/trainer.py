@@ -130,25 +130,32 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        items = []
         for i, p in enumerate(self._params):
-            if p.grad_req == "null":
-                continue
-            if p._data is None:
+            if p.grad_req == "null" or p._data is None:
                 continue
             var = p._data._var
-            if not ignore_stale_grad and var is not None and not var.fresh:
+            if var is not None and not var.fresh:
+                if ignore_stale_grad:
+                    continue  # skip params whose grad was not refreshed
                 raise MXNetError(
                     f"gradient of parameter {p.name} has not been updated by "
                     "backward since the last step; set ignore_stale_grad=True "
-                    "to suppress (≙ trainer.py stale-grad check)")
+                    "to skip such parameters (≙ trainer.py stale-grad check)")
             if not self._states_created[i]:
                 self._states[i] = \
                     self._optimizer.create_state_multi_precision(i, p.data())
                 self._states_created[i] = True
-            self._optimizer.update_multi_precision(i, p.data(), p.grad(),
-                                                   self._states[i])
-            if var is not None:
-                var.fresh = False
+            items.append((i, p.data(), p.grad(), self._states[i]))
+        # one fused XLA computation for all params when the rule supports it
+        # (≙ multi_sgd_update etc.); falls back to per-param kernels
+        if not self._optimizer.fused_update_all(items):
+            for i, w, g, s in items:
+                self._optimizer.update_multi_precision(i, w, g, s)
+        # only mark grads consumed once the updates have been issued
+        for i, w, g, s in items:
+            if w._var is not None:
+                w._var.fresh = False
 
     def _mark_consumed(self):
         for p in self._params:

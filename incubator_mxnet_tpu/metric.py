@@ -1,0 +1,4 @@
+"""mx.metric — alias of gluon.metric (the reference exposes both
+mx.gluon.metric and the legacy mx.metric namespace)."""
+from .gluon.metric import *  # noqa: F401,F403
+from .gluon.metric import create, EvalMetric, CompositeEvalMetric  # noqa: F401

@@ -1,0 +1,118 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Reference equivalents: the C++ IO stack (src/io/, dmlc recordio) and the
+prefetch pipeline. Built on demand with g++ (cached under native/_build);
+every consumer degrades to the pure-Python path when a toolchain is missing,
+so the framework never hard-requires the native layer.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_LOCK = threading.Lock()
+_LIB = {"recordio": None, "tried": False}
+
+
+def _compile(src, out):
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           src, "-o", out]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load_recordio():
+    """Load (building if needed) the native recordio library; None if the
+    toolchain is unavailable."""
+    with _LOCK:
+        if _LIB["tried"]:
+            return _LIB["recordio"]
+        _LIB["tried"] = True
+        src = os.path.join(_HERE, "recordio.cc")
+        out = os.path.join(_BUILD_DIR, "librecordio.so")
+        try:
+            if (not os.path.exists(out)
+                    or os.path.getmtime(out) < os.path.getmtime(src)):
+                _compile(src, out)
+            lib = ctypes.CDLL(out)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        lib.rr_open.restype = ctypes.c_void_p
+        lib.rr_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.rr_close.argtypes = [ctypes.c_void_p]
+        lib.rr_count.restype = ctypes.c_int64
+        lib.rr_count.argtypes = [ctypes.c_void_p]
+        lib.rr_record_len.restype = ctypes.c_int64
+        lib.rr_record_len.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.rr_read.restype = ctypes.c_int64
+        lib.rr_read.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                ctypes.POINTER(ctypes.c_uint8),
+                                ctypes.c_int64]
+        lib.rr_read_batch.restype = ctypes.c_int
+        lib.rr_read_batch.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_int64),
+                                      ctypes.c_int64,
+                                      ctypes.POINTER(ctypes.c_uint8),
+                                      ctypes.c_int64]
+        lib.rr_version.restype = ctypes.c_char_p
+        _LIB["recordio"] = lib
+        return lib
+
+
+class NativeRecordFile:
+    """Random-access .rec reader over the C++ library (≙ the C++
+    RecordFileDataset fast path, src/io/dataset.cc)."""
+
+    def __init__(self, path, num_threads=4):
+        import numpy as np
+        self._np = np
+        self._lib = load_recordio()
+        if self._lib is None:
+            raise RuntimeError("native recordio library unavailable")
+        self._h = self._lib.rr_open(path.encode(), num_threads)
+        if not self._h:
+            raise IOError(f"cannot open/parse record file {path}")
+
+    def __len__(self):
+        return int(self._lib.rr_count(self._h))
+
+    def read(self, idx):
+        n = int(self._lib.rr_record_len(self._h, idx))
+        if n < 0:
+            raise IndexError(idx)
+        buf = self._np.empty(n, dtype=self._np.uint8)
+        w = self._lib.rr_read(
+            self._h, idx,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n)
+        if w < 0:
+            raise IOError(f"read failed for record {idx}")
+        return buf.tobytes()
+
+    def read_batch(self, indices, stride):
+        """Gather len(indices) fixed-stride payloads in parallel into one
+        contiguous (n, stride) uint8 array (the DataLoader fast path)."""
+        np = self._np
+        idx = np.asarray(indices, dtype=np.int64)
+        out = np.empty((len(idx), stride), dtype=np.uint8)
+        rc = self._lib.rr_read_batch(
+            self._h, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(idx), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            stride)
+        if rc != 0:
+            raise IOError("batch read failed (bad index?)")
+        return out
+
+    def close(self):
+        if self._h:
+            self._lib.rr_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,144 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Reference contrast: MXNet's attention kernels are fused strided-batch-GEMMs
+(`_contrib_interleaved_matmul_selfatt_*`, src/operator/contrib/
+transformer.cc:676-869) that materialize the full (T, T) score matrix. This
+kernel is the TPU-first replacement: blockwise online-softmax attention
+(flash attention) that keeps O(block_q x block_k) tiles in VMEM, never
+materializing the score matrix — the HBM-bandwidth win that matters at long
+sequence length (SURVEY §5.7: the capability gap this framework fills).
+
+Layout: q,k,v are (batch*heads, T, head_dim). Grid = (bh, nq, nk) with the
+k loop innermost; accumulators (m, l, acc) persist in VMEM scratch across
+the nk steps (TPU grids iterate sequentially).
+
+Falls back to the jnp composition off-TPU (tests run interpret=True or the
+fallback — same math, tolerances in tests/test_attention.py).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as _np
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale,
+            causal, block_q, block_k, nk):
+    import jax
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)          # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)          # (block_k, d)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_ref[:]                          # (block_q, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # (block_q, block_k)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    if causal:
+        # skip fully-masked k blocks (block above the diagonal)
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        import jax.numpy as jnp
+        denom = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _reference(q, k, v, scale, causal):
+    import jax
+    import jax.numpy as jnp
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
+                    block_k=512, interpret=False):
+    """Blockwise attention. q: (bh, Tq, d), k/v: (bh, Tk, d) raw jax arrays.
+
+    Uses the Pallas kernel on TPU (or interpret=True anywhere); falls back
+    to the fused-einsum composition on other backends.
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    on_tpu = any(dev.platform != "cpu" for dev in jax.devices())
+    if not (on_tpu or interpret):
+        return _reference(q, k, v, scale, causal)
+
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        # ragged tails: fall back (padding support comes with masked loads)
+        return _reference(q, k, v, scale, causal)
+    nq = tq // block_q
+    nk = tk // block_k
+
+    grid = (bh, nq, nk)
+    kernel = functools.partial(_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, nk=nk)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # m (running max)
+            pltpu.VMEM((block_q, 1), jnp.float32),   # l (running denom)
+            pltpu.VMEM((block_q, d), jnp.float32),   # acc
+        ],
+        interpret=interpret,
+    )(q, k, v)

@@ -48,6 +48,13 @@ def create(name, **kwargs):
     return _REGISTRY[name](**kwargs)
 
 
+def _f32(x):
+    """Cast a host float OR traced scalar to f32 (kernels accept both, so
+    traced lr/wd never force a retrace)."""
+    import jax.numpy as jnp
+    return jnp.asarray(x, jnp.float32)
+
+
 def _jit_update(fn, donate=()):
     """Jit an update kernel donating weight+state buffers so XLA aliases
     them in place (≙ the reference's in-place FCompute updates)."""
@@ -173,16 +180,129 @@ class Optimizer:
         for i, w, g, s in zip(indices, weights, grads, states):
             self.update(i, w, g, s)
 
+    # True for rules with no per-step host-side scalars (Adam-family bakes
+    # the bias-correction step count into the trace, so fusing would retrace
+    # every step) — those use the per-param path until t is made traceable.
+    _fused_safe = False
+
+    def _hyper_fingerprint(self):
+        """Scalar hyperparameters baked into fused traces (momentum, rho,
+        epsilon, ...): changing any of them must miss the jit cache."""
+        skip = {"lr", "wd", "num_update", "rescale_grad"}
+        return tuple(sorted(
+            (k, v) for k, v in self.__dict__.items()
+            if not k.startswith("_") and k not in skip
+            and isinstance(v, (int, float, bool))))
+
+    def fused_update_all(self, items):
+        """Multi-tensor fused update (≙ multi_sgd/multi_mp_sgd ops,
+        optimizer_op.cc:353-493, preloaded_multi_sgd.cc): ONE jitted XLA
+        computation updates every parameter. `items` is a list of
+        (index, weight, grad, state) with all weights initialized.
+
+        lr/wd enter as traced scalars so lr schedules never retrace; the
+        cache is keyed on everything the trace bakes in (hyperparams,
+        rescale/clip, item count).
+
+        Returns True if the fused path ran; False → caller falls back to
+        per-param updates.
+        """
+        import jax
+        if not self._fused_safe or not items or self.multi_precision:
+            return False
+        # subclasses overriding update()/update_multi_precision() (the MXNet
+        # extension point) must keep getting called per-param
+        if (type(self).update is not Optimizer.update
+                or type(self).update_multi_precision
+                is not Optimizer.update_multi_precision):
+            return False
+        for index, _, _, _ in items:
+            self._update_count(index)
+        lrs = [_np.float32(self._get_lr(i)) for i, _, _, _ in items]
+        wds = [_np.float32(self._get_wd(i)) for i, _, _, _ in items]
+        opt = self
+        indices = tuple(i for i, _, _, _ in items)
+
+        key = ("fused_all", indices, self.clip_gradient,
+               self._hyper_fingerprint())
+        cached = self._jitted.get(key)
+        if cached is None:
+            def f(wbufs, gbufs, sbufs, lr_args, wd_args, rescale):
+                # expose the traced rescale to step_one's _preprocess; the
+                # inner kernel cache detects the tracer and keys on "traced"
+                prev = opt.rescale_grad
+                opt.rescale_grad = rescale
+                try:
+                    new_w, new_s = [], []
+                    for idx, wb, gb, sb, lr, wd in zip(
+                            indices, wbufs, gbufs, sbufs, lr_args, wd_args):
+                        w = _wrap(wb)
+                        g = _wrap(gb)
+                        st = _wrap_state(sb)
+                        opt.step_one(idx, w, g, st, lr, wd)
+                        new_w.append(w._arr)
+                        new_s.append(_state_bufs(st))
+                    return new_w, new_s
+                finally:
+                    opt.rescale_grad = prev
+
+            cached = jax.jit(f, donate_argnums=(0, 2))
+            self._jitted[key] = cached
+
+        wbufs = [w._arr for _, w, _, _ in items]
+        gbufs = [g._arr for _, _, g, _ in items]
+        sbufs = [_state_bufs(s) for _, _, _, s in items]
+        new_w, new_s = cached(wbufs, gbufs, sbufs, lrs, wds,
+                              _np.float32(self.rescale_grad))
+        for (idx, w_nd, g_nd, state), wb, sb in zip(items, new_w, new_s):
+            w_nd._set_arr(wb)
+            _state_restore(state, sb)
+        return True
+
     def _kernel(self, name, fn, donate=()):
         # rescale_grad/clip_gradient are closed over by the kernel body, so
         # the compiled fn is only valid for their current values — key the
         # cache on them (Trainer.step rewrites rescale_grad per batch size).
-        key = (name, self.rescale_grad, self.clip_gradient)
+        # Inside a fused trace rescale_grad is a tracer: return the raw fn
+        # so it inlines into the enclosing jit — caching a nested jit whose
+        # closure captured an outer tracer would leak it across traces.
+        rs = self.rescale_grad
+        if not isinstance(rs, (int, float)):
+            return fn
+        key = (name, rs, self.clip_gradient)
         k = self._jitted.get(key)
         if k is None:
             k = _jit_update(fn, donate)
             self._jitted[key] = k
         return k
+
+
+def _state_bufs(state):
+    """Extract raw buffers from a (possibly nested-tuple) NDArray state."""
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_state_bufs(s) for s in state)
+    return state._arr
+
+
+def _wrap_state(bufs):
+    from ..ndarray import _wrap
+    if bufs is None:
+        return None
+    if isinstance(bufs, tuple):
+        return tuple(_wrap_state(b) for b in bufs)
+    return _wrap(bufs)
+
+
+def _state_restore(state, bufs):
+    if state is None:
+        return
+    if isinstance(state, tuple):
+        for s, b in zip(state, bufs):
+            _state_restore(s, b)
+        return
+    state._set_arr(bufs)
 
 
 # ---------------------------------------------------------------------------
@@ -192,6 +312,8 @@ class Optimizer:
 class SGD(Optimizer):
     """SGD + momentum (≙ optimizer/sgd.py; kernel optimizer_op.cc sgd_update/
     sgd_mom_update)."""
+
+    _fused_safe = True
 
     def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
                  **kwargs):
@@ -218,17 +340,19 @@ class SGD(Optimizer):
         if state is not None:
             new_w, new_m = self._kernel("mom", k_mom, donate=(0, 2))(
                 weight._arr, grad._arr, state._arr,
-                _np.float32(lr), _np.float32(wd), _np.float32(self.momentum))
+                _f32(lr), _f32(wd), _f32(self.momentum))
             weight._set_arr(new_w)
             state._set_arr(new_m)
         else:
             weight._set_arr(self._kernel("plain", k_plain, donate=(0,))(
-                weight._arr, grad._arr, _np.float32(lr), _np.float32(wd)))
+                weight._arr, grad._arr, _f32(lr), _f32(wd)))
 
 
 @register
 class Signum(Optimizer):
     """≙ optimizer/signum.py (signsgd/signum kernels)."""
+
+    _fused_safe = True
 
     def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -255,15 +379,15 @@ class Signum(Optimizer):
 
         if state is not None:
             new_w, new_m = self._kernel("signum", k, donate=(0, 2))(
-                weight._arr, grad._arr, state._arr, _np.float32(lr),
-                _np.float32(wd), _np.float32(self.momentum),
-                _np.float32(self.wd_lh))
+                weight._arr, grad._arr, state._arr, _f32(lr),
+                _f32(wd), _f32(self.momentum),
+                _f32(self.wd_lh))
             weight._set_arr(new_w)
             state._set_arr(new_m)
         else:
             weight._set_arr(self._kernel("signsgd", k_sign, donate=(0,))(
-                weight._arr, grad._arr, _np.float32(lr), _np.float32(wd),
-                _np.float32(self.wd_lh)))
+                weight._arr, grad._arr, _f32(lr), _f32(wd),
+                _f32(self.wd_lh)))
 
 
 @register
@@ -285,7 +409,7 @@ class SGLD(Optimizer):
             return w - lr / 2 * g + noise
 
         weight._set_arr(self._kernel("sgld", k, donate=(0,))(
-            weight._arr, grad._arr, key, _np.float32(lr), _np.float32(wd)))
+            weight._arr, grad._arr, key, _f32(lr), _f32(wd)))
 
 
 @register
@@ -319,14 +443,14 @@ class DCASGD(Optimizer):
 
         if mom is not None:
             new_w, new_m, new_prev = self._kernel("dcasgd_m", k_mom, donate=(0, 2, 3))(
-                weight._arr, grad._arr, mom._arr, prev._arr, _np.float32(lr),
-                _np.float32(wd), _np.float32(self.momentum),
-                _np.float32(self.lamda))
+                weight._arr, grad._arr, mom._arr, prev._arr, _f32(lr),
+                _f32(wd), _f32(self.momentum),
+                _f32(self.lamda))
             mom._set_arr(new_m)
         else:
             new_w, new_prev = self._kernel("dcasgd", k, donate=(0, 2))(
-                weight._arr, grad._arr, prev._arr, _np.float32(lr),
-                _np.float32(wd), _np.float32(self.lamda))
+                weight._arr, grad._arr, prev._arr, _f32(lr),
+                _f32(wd), _f32(self.lamda))
         weight._set_arr(new_w)
         prev._set_arr(new_prev)
 
@@ -334,6 +458,8 @@ class DCASGD(Optimizer):
 @register
 class NAG(Optimizer):
     """Nesterov accelerated SGD (≙ optimizer/nag.py, nag_mom_update)."""
+
+    _fused_safe = True
 
     def __init__(self, learning_rate=0.01, momentum=0.9, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -349,8 +475,8 @@ class NAG(Optimizer):
             return w - lr * (g + momentum * mom), mom
 
         new_w, new_m = self._kernel("nag", k, donate=(0, 2))(
-            weight._arr, grad._arr, state._arr, _np.float32(lr),
-            _np.float32(wd), _np.float32(self.momentum))
+            weight._arr, grad._arr, state._arr, _f32(lr),
+            _f32(wd), _f32(self.momentum))
         weight._set_arr(new_w)
         state._set_arr(new_m)
 
@@ -360,6 +486,8 @@ class NAG(Optimizer):
 # ---------------------------------------------------------------------------
 @register
 class AdaGrad(Optimizer):
+    _fused_safe = True
+
     def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.epsilon = epsilon
@@ -376,14 +504,16 @@ class AdaGrad(Optimizer):
             return w - lr * g / (jnp.sqrt(hist) + eps), hist
 
         new_w, new_h = self._kernel("adagrad", k, donate=(0, 2))(
-            weight._arr, grad._arr, state._arr, _np.float32(lr),
-            _np.float32(wd), _np.float32(self.epsilon))
+            weight._arr, grad._arr, state._arr, _f32(lr),
+            _f32(wd), _f32(self.epsilon))
         weight._set_arr(new_w)
         state._set_arr(new_h)
 
 
 @register
 class AdaDelta(Optimizer):
+    _fused_safe = True
+
     def __init__(self, learning_rate=1.0, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.rho = rho
@@ -406,8 +536,8 @@ class AdaDelta(Optimizer):
 
         new_w, new_ag, new_ad = self._kernel("adadelta", k, donate=(0, 2, 3))(
             weight._arr, grad._arr, acc_g._arr, acc_delta._arr,
-            _np.float32(lr), _np.float32(wd), _np.float32(self.rho),
-            _np.float32(self.epsilon))
+            _f32(lr), _f32(wd), _f32(self.rho),
+            _f32(self.epsilon))
         weight._set_arr(new_w)
         acc_g._set_arr(new_ag)
         acc_delta._set_arr(new_ad)
@@ -445,9 +575,9 @@ class Adam(_AdamBase):
             return w - lr * m / (jnp.sqrt(v) + eps), m, v
 
         new_w, new_m, new_v = self._kernel("adam", k, donate=(0, 2, 3))(
-            weight._arr, grad._arr, mean._arr, var._arr, _np.float32(lr_t),
-            _np.float32(wd), _np.float32(self.beta1), _np.float32(self.beta2),
-            _np.float32(self.epsilon))
+            weight._arr, grad._arr, mean._arr, var._arr, _f32(lr_t),
+            _f32(wd), _f32(self.beta1), _f32(self.beta2),
+            _f32(self.epsilon))
         weight._set_arr(new_w)
         mean._set_arr(new_m)
         var._set_arr(new_v)
@@ -472,9 +602,9 @@ class AdamW(_AdamBase):
             return w - lr * m / (jnp.sqrt(v) + eps) - base_lr * wd * w, m, v
 
         new_w, new_m, new_v = self._kernel("adamw", k, donate=(0, 2, 3))(
-            weight._arr, grad._arr, mean._arr, var._arr, _np.float32(lr_t),
-            _np.float32(lr), _np.float32(wd), _np.float32(self.beta1),
-            _np.float32(self.beta2), _np.float32(self.epsilon))
+            weight._arr, grad._arr, mean._arr, var._arr, _f32(lr_t),
+            _f32(lr), _f32(wd), _f32(self.beta1),
+            _f32(self.beta2), _f32(self.epsilon))
         weight._set_arr(new_w)
         mean._set_arr(new_m)
         var._set_arr(new_v)
@@ -499,9 +629,9 @@ class Adamax(_AdamBase):
             return w - lr * m / (u + eps), m, u
 
         new_w, new_m, new_u = self._kernel("adamax", k, donate=(0, 2, 3))(
-            weight._arr, grad._arr, mean._arr, u._arr, _np.float32(lr_t),
-            _np.float32(wd), _np.float32(self.beta1), _np.float32(self.beta2),
-            _np.float32(self.epsilon))
+            weight._arr, grad._arr, mean._arr, u._arr, _f32(lr_t),
+            _f32(wd), _f32(self.beta1), _f32(self.beta2),
+            _f32(self.epsilon))
         weight._set_arr(new_w)
         mean._set_arr(new_m)
         u._set_arr(new_u)
@@ -536,11 +666,11 @@ class Nadam(_AdamBase):
             return w - lr * m_bar / (jnp.sqrt(v_prime) + eps), m, v
 
         new_w, new_m, new_v = self._kernel("nadam", k, donate=(0, 2, 3))(
-            weight._arr, grad._arr, mean._arr, var._arr, _np.float32(lr),
-            _np.float32(wd), _np.float32(self.beta1), _np.float32(self.beta2),
-            _np.float32(self.epsilon), _np.float32(momentum_t),
-            _np.float32(self.m_schedule), _np.float32(m_schedule_next),
-            _np.float32(t))
+            weight._arr, grad._arr, mean._arr, var._arr, _f32(lr),
+            _f32(wd), _f32(self.beta1), _f32(self.beta2),
+            _f32(self.epsilon), _f32(momentum_t),
+            _f32(self.m_schedule), _f32(m_schedule_next),
+            _f32(t))
         weight._set_arr(new_w)
         mean._set_arr(new_m)
         var._set_arr(new_v)
@@ -565,9 +695,9 @@ class AdaBelief(_AdamBase):
             return w - lr * m / (jnp.sqrt(v) + eps), m, v
 
         new_w, new_m, new_v = self._kernel("adabelief", k, donate=(0, 2, 3))(
-            weight._arr, grad._arr, mean._arr, var._arr, _np.float32(lr_t),
-            _np.float32(wd), _np.float32(self.beta1), _np.float32(self.beta2),
-            _np.float32(self.epsilon))
+            weight._arr, grad._arr, mean._arr, var._arr, _f32(lr_t),
+            _f32(wd), _f32(self.beta1), _f32(self.beta2),
+            _f32(self.epsilon))
         weight._set_arr(new_w)
         mean._set_arr(new_m)
         var._set_arr(new_v)
@@ -603,9 +733,9 @@ class FTML(Optimizer):
             return -z / d_t, d_t, v, z
 
         new_w, new_d, new_v, new_z = self._kernel("ftml", k, donate=(0, 2, 3, 4))(
-            weight._arr, grad._arr, d._arr, v._arr, z._arr, _np.float32(lr),
-            _np.float32(wd), _np.float32(self.beta1), _np.float32(self.beta2),
-            _np.float32(self.epsilon), _np.float32(t))
+            weight._arr, grad._arr, d._arr, v._arr, z._arr, _f32(lr),
+            _f32(wd), _f32(self.beta1), _f32(self.beta2),
+            _f32(self.epsilon), _f32(t))
         weight._set_arr(new_w)
         d._set_arr(new_d)
         v._set_arr(new_v)
@@ -615,6 +745,8 @@ class FTML(Optimizer):
 @register
 class FTRL(Optimizer):
     """≙ optimizer/ftrl.py (ftrl_update kernel)."""
+
+    _fused_safe = True
 
     def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -640,8 +772,8 @@ class FTRL(Optimizer):
             return w, z, n
 
         new_w, new_z, new_n = self._kernel("ftrl", k, donate=(0, 2, 3))(
-            weight._arr, grad._arr, z._arr, n._arr, _np.float32(lr),
-            _np.float32(wd), _np.float32(self.lamda1), _np.float32(self.beta))
+            weight._arr, grad._arr, z._arr, n._arr, _f32(lr),
+            _f32(wd), _f32(self.lamda1), _f32(self.beta))
         weight._set_arr(new_w)
         z._set_arr(new_z)
         n._set_arr(new_n)
@@ -650,6 +782,8 @@ class FTRL(Optimizer):
 @register
 class RMSProp(Optimizer):
     """≙ optimizer/rmsprop.py (rmsprop_update / rmspropalex_update)."""
+
+    _fused_safe = True
 
     def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
                  epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
@@ -680,9 +814,9 @@ class RMSProp(Optimizer):
                 return w, n
 
             new_w, new_n = self._kernel("rmsprop", k, donate=(0, 2))(
-                weight._arr, grad._arr, n._arr, _np.float32(lr),
-                _np.float32(wd), _np.float32(self.rho),
-                _np.float32(self.epsilon))
+                weight._arr, grad._arr, n._arr, _f32(lr),
+                _f32(wd), _f32(self.rho),
+                _f32(self.epsilon))
             weight._set_arr(new_w)
             n._set_arr(new_n)
         else:
@@ -697,8 +831,8 @@ class RMSProp(Optimizer):
 
             new_w, new_n, new_g, new_d = self._kernel("rmspropalex", k, donate=(0, 2, 3, 4))(
                 weight._arr, grad._arr, n._arr, gbar._arr, delta._arr,
-                _np.float32(lr), _np.float32(wd), _np.float32(self.rho),
-                _np.float32(self.momentum), _np.float32(self.epsilon))
+                _f32(lr), _f32(wd), _f32(self.rho),
+                _f32(self.momentum), _f32(self.epsilon))
             if self.clip_weights:
                 new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
             weight._set_arr(new_w)
@@ -713,6 +847,8 @@ class RMSProp(Optimizer):
 @register
 class LARS(Optimizer):
     """Layer-wise adaptive rate scaling (≙ optimizer/lars.py)."""
+
+    _fused_safe = True
 
     def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
                  epsilon=1e-8, **kwargs):
@@ -744,9 +880,9 @@ class LARS(Optimizer):
         mom = state if state is not None else zeros(weight.shape,
                                                     dtype=weight.dtype)
         new_w, new_m = self._kernel("lars", k, donate=(0, 2))(
-            weight._arr, grad._arr, mom._arr, _np.float32(lr),
-            _np.float32(wd), _np.float32(self.momentum), _np.float32(self.eta),
-            _np.float32(self.epsilon))
+            weight._arr, grad._arr, mom._arr, _f32(lr),
+            _f32(wd), _f32(self.momentum), _f32(self.eta),
+            _f32(self.epsilon))
         weight._set_arr(new_w)
         if state is not None:
             state._set_arr(new_m)
@@ -790,9 +926,9 @@ class LAMB(_AdamBase):
             return w - lr * ratio * r, m, v
 
         new_w, new_m, new_v = self._kernel("lamb", k, donate=(0, 2, 3))(
-            weight._arr, grad._arr, mean._arr, var._arr, _np.float32(lr),
-            _np.float32(wd), _np.float32(self.beta1), _np.float32(self.beta2),
-            _np.float32(self.epsilon), _np.float32(t))
+            weight._arr, grad._arr, mean._arr, var._arr, _f32(lr),
+            _f32(wd), _f32(self.beta1), _f32(self.beta2),
+            _f32(self.epsilon), _f32(t))
         weight._set_arr(new_w)
         mean._set_arr(new_m)
         var._set_arr(new_v)
@@ -829,9 +965,9 @@ class LANS(LAMB):
             return w, m, v
 
         new_w, new_m, new_v = self._kernel("lans", k, donate=(0, 2, 3))(
-            weight._arr, grad._arr, mean._arr, var._arr, _np.float32(lr),
-            _np.float32(wd), _np.float32(self.beta1), _np.float32(self.beta2),
-            _np.float32(self.epsilon), _np.float32(t))
+            weight._arr, grad._arr, mean._arr, var._arr, _f32(lr),
+            _f32(wd), _f32(self.beta1), _f32(self.beta2),
+            _f32(self.epsilon), _f32(t))
         weight._set_arr(new_w)
         mean._set_arr(new_m)
         var._set_arr(new_v)

@@ -1,0 +1,42 @@
+"""mx.visualization (≙ python/mxnet/visualization.py: print_summary,
+plot_network). Graph rendering without graphviz: text tree + optional dot
+source emission."""
+from __future__ import annotations
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(block, *inputs):
+    """≙ mx.viz.print_summary — per-layer table (delegates Block.summary)."""
+    return block.summary(*inputs)
+
+
+def plot_network(block, title="network", save_path=None):
+    """Emit graphviz dot source for the block tree (≙ mx.viz.plot_network;
+    rendering requires graphviz, which is not bundled — the dot text is
+    returned/saved instead)."""
+    lines = [f'digraph "{title}" {{', "  rankdir=TB;",
+             '  node [shape=box, style="rounded,filled", '
+             'fillcolor="#e8f0fe"];']
+    counter = [0]
+
+    def walk(blk, parent=None):
+        nid = f"n{counter[0]}"
+        counter[0] += 1
+        label = type(blk).__name__
+        params = sum(1 for _ in blk._reg_params)
+        if params:
+            label += f"\\n({params} params)"
+        lines.append(f'  {nid} [label="{label}"];')
+        if parent is not None:
+            lines.append(f"  {parent} -> {nid};")
+        for child in blk._children.values():
+            walk(child, nid)
+
+    walk(block)
+    lines.append("}")
+    dot = "\n".join(lines)
+    if save_path:
+        with open(save_path, "w") as f:
+            f.write(dot)
+    return dot

@@ -423,3 +423,125 @@ def test_npx_cond_with_ndarray_inputs():
     out = npx.cond(mx.np.array(np.array(True)),
                    lambda v: v + 1, lambda v: v - 1, inputs=x)
     np.testing.assert_allclose(out.asnumpy(), [2.0, 3.0])
+
+
+def test_fused_update_matches_per_param():
+    """Multi-tensor fused update must equal per-param kernels exactly."""
+    def build():
+        mx.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+        net.initialize()
+        return net
+
+    def run(net, force_per_param):
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+        if force_per_param:
+            tr._optimizer._fused_safe = False
+        x = mx.np.array(np.ones((4, 4), np.float32))
+        for _ in range(3):
+            with mx.autograd.record():
+                (net(x) ** 2).sum().backward()
+            tr.step(4)
+        return {k: p.data().asnumpy() for k, p in net.collect_params().items()}
+
+    w_fused = run(build(), False)
+    w_plain = run(build(), True)
+    for k in w_fused:
+        np.testing.assert_allclose(w_fused[k], w_plain[k], rtol=1e-6,
+                                   atol=1e-7)
+
+
+def test_fused_update_honors_hyperparam_change():
+    """Regression: mutating momentum mid-training must affect the fused path
+    (hyperparams are part of the jit cache key)."""
+    def run(drop_momentum_at, force_per_param=False):
+        mx.seed(11)
+        net = nn.Dense(4, in_units=3, use_bias=False)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+        if force_per_param:
+            tr._optimizer._fused_safe = False
+        x = mx.np.array(np.ones((2, 3), np.float32))
+        for step in range(4):
+            if step == drop_momentum_at:
+                tr._optimizer.momentum = 0.0
+            with mx.autograd.record():
+                (net(x) ** 2).sum().backward()
+            tr.step(2)
+        return net.weight.data().asnumpy()
+
+    w_fused = run(2)
+    w_plain = run(2, force_per_param=True)
+    np.testing.assert_allclose(w_fused, w_plain, rtol=1e-6, atol=1e-7)
+
+
+def test_fused_update_lr_schedule_no_retrace():
+    """Regression: a per-step lr schedule must reuse ONE fused executable
+    (lr is a traced arg, not a cache-key component)."""
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.5, base_lr=0.4)
+    net = nn.Dense(2, in_units=2, use_bias=False)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"lr_scheduler": sched})
+    x = mx.np.array(np.ones((2, 2), np.float32))
+    for _ in range(5):
+        with mx.autograd.record():
+            net(x).sum().backward()
+        tr.step(2)
+    fused_keys = [k for k in tr._optimizer._jitted
+                  if isinstance(k, tuple) and k[0] == "fused_all"]
+    assert len(fused_keys) == 1, fused_keys
+
+
+def test_fused_update_rescale_no_retrace_and_correct():
+    """Regression: varying batch size must neither retrace the fused update
+    nor apply a stale rescale."""
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(init="zeros")
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 1.0})
+    for bs in (4, 8, 4, 16):
+        x = mx.np.array(np.ones((bs, 2), np.float32))
+        with mx.autograd.record():
+            net(x).sum().backward()
+        tr.step(bs)  # each step: grad [bs,bs]/bs -> -1 per element
+    np.testing.assert_allclose(net.weight.data().asnumpy(),
+                               [[-4.0, -4.0]], rtol=1e-6)
+    fused_keys = [k for k in tr._optimizer._jitted
+                  if isinstance(k, tuple) and k[0] == "fused_all"]
+    assert len(fused_keys) == 1, fused_keys
+
+
+def test_ignore_stale_grad_skips():
+    """Regression: stale-grad params must be SKIPPED, not re-updated."""
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(init="ones")
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 1.0})
+    x = mx.np.array(np.ones((2, 2), np.float32))
+    with mx.autograd.record():
+        net(x).sum().backward()
+    tr.step(2)
+    w_after = net.weight.data().asnumpy().copy()
+    tr.step(2, ignore_stale_grad=True)  # no new backward: must be a no-op
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w_after)
+
+
+def test_custom_optimizer_override_not_fused():
+    """Subclasses overriding update() must keep the per-param path."""
+    calls = []
+
+    class MySGD(mx.optimizer.SGD):
+        def update(self, index, weight, grad, state):
+            calls.append(index)
+            super().update(index, weight, grad, state)
+
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), MySGD(learning_rate=0.1))
+    x = mx.np.array(np.ones((2, 2), np.float32))
+    with mx.autograd.record():
+        net(x).sum().backward()
+    tr.step(2)
+    assert calls  # the override actually ran
