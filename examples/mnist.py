@@ -1,0 +1,94 @@
+"""LeNet-5-style MNIST training (≙ example/gluon/mnist/mnist.py — the
+reference's minimum end-to-end config, BASELINE ladder #1).
+
+Runs against local idx-ubyte files if present, else a synthetic stand-in so
+the script is always executable in zero-egress environments:
+
+    python examples/mnist.py [--epochs 3] [--batch-size 64] [--hybridize]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import argparse
+import os
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+
+def build_lenet():
+    net = nn.HybridSequential()
+    net.add(
+        nn.Conv2D(6, kernel_size=5, padding=2, activation="tanh"),
+        nn.AvgPool2D(2, 2),
+        nn.Conv2D(16, kernel_size=5, activation="tanh"),
+        nn.AvgPool2D(2, 2),
+        nn.Flatten(),
+        nn.Dense(120, activation="tanh"),
+        nn.Dense(84, activation="tanh"),
+        nn.Dense(10),
+    )
+    return net
+
+
+def load_data(batch_size):
+    root = os.path.expanduser(os.path.join("~", ".mxnet", "datasets", "mnist"))
+    try:
+        from incubator_mxnet_tpu.gluon.data.vision import MNIST
+        train = MNIST(root=root, train=True)
+        X = np.stack([train[i][0].asnumpy() for i in range(len(train))])
+        Y = np.array([train[i][1] for i in range(len(train))], np.int32)
+        print(f"loaded MNIST from {root}: {len(Y)} images")
+    except mx.MXNetError:
+        print("MNIST files not found; using synthetic digits")
+        rng = np.random.default_rng(0)
+        Y = rng.integers(0, 10, 4096).astype(np.int32)
+        X = rng.normal(0, 0.2, (4096, 28, 28, 1)).astype(np.float32)
+        for i, y in enumerate(Y):  # one bright row per class: learnable
+            X[i, 2 * y + 3, :, 0] += 2.0
+    X = X.astype(np.float32).reshape(-1, 1, 28, 28) / 255.0 \
+        if X.max() > 2 else X.astype(np.float32).transpose(0, 3, 1, 2)
+    return DataLoader(ArrayDataset(X, Y), batch_size=batch_size, shuffle=True,
+                      num_workers=2)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--hybridize", action="store_true", default=True)
+    args = ap.parse_args()
+
+    net = build_lenet()
+    net.initialize(init="xavier")
+    if args.hybridize:
+        net.hybridize()
+    loader = load_data(args.batch_size)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    metric = gluon.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        for x, y in loader:
+            with mx.autograd.record():
+                out = net(x)
+                L = loss_fn(out, y).mean()
+            L.backward()
+            trainer.step(x.shape[0])
+            metric.update(y, out)
+        print(f"epoch {epoch}: accuracy={metric.get()[1]:.4f} "
+              f"loss={float(L.asnumpy()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

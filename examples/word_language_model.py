@@ -1,0 +1,126 @@
+"""LSTM word language model (≙ example/gluon/word_language_model/train.py —
+BASELINE ladder config #4: LSTM LM through the recurrent path).
+
+Trains a 2-layer LSTM LM with truncated BPTT on a local text corpus (or a
+synthetic Zipf corpus when none is given):
+
+    python examples/word_language_model.py [--data file.txt] [--epochs 2]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import argparse
+import math
+import time
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn, rnn
+
+
+class RNNModel(gluon.HybridBlock):
+    """≙ the reference example's RNNModel (embed → LSTM → tied dense)."""
+
+    def __init__(self, vocab_size, embed_size=200, hidden_size=200,
+                 num_layers=2, dropout=0.2, tie_weights=False):
+        super().__init__()
+        self.drop = nn.Dropout(dropout)
+        self.encoder = nn.Embedding(vocab_size, embed_size)
+        self.rnn = rnn.LSTM(hidden_size, num_layers, dropout=dropout,
+                            input_size=embed_size)
+        self.decoder = nn.Dense(vocab_size, in_units=hidden_size)
+        self.hidden_size = hidden_size
+
+    def forward(self, inputs, h, c):
+        emb = self.drop(self.encoder(inputs))          # (T, N, E)
+        output, state = self.rnn(emb, [h, c])
+        output = self.drop(output)
+        decoded = self.decoder(output.reshape((-1, self.hidden_size)))
+        return decoded, state[0], state[1]
+
+    def begin_state(self, batch_size):
+        return self.rnn.begin_state(batch_size)
+
+
+def batchify(ids, batch_size):
+    nbatch = len(ids) // batch_size
+    data = np.asarray(ids[:nbatch * batch_size], np.int32)
+    return data.reshape(batch_size, nbatch).T  # (T, N)
+
+
+def get_corpus(path):
+    if path:
+        with open(path) as f:
+            words = f.read().replace("\n", " <eos> ").split()
+    else:
+        print("no --data given; generating synthetic Zipf corpus")
+        rng = np.random.default_rng(0)
+        vocab = 2000
+        p = 1.0 / np.arange(1, vocab + 1)
+        p /= p.sum()
+        # inject learnable bigram structure
+        ids = [0]
+        for _ in range(200000):
+            ids.append(int((ids[-1] * 31 + rng.choice(vocab, p=p)) % vocab))
+        return np.asarray(ids, np.int32), vocab
+    uniq = sorted(set(words))
+    index = {w: i for i, w in enumerate(uniq)}
+    return np.asarray([index[w] for w in words], np.int32), len(uniq)
+
+
+def detach(state):
+    return [s.detach() for s in state]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--bptt", type=int, default=35)
+    ap.add_argument("--lr", type=float, default=1.0)
+    ap.add_argument("--clip", type=float, default=0.25)
+    args = ap.parse_args()
+
+    ids, vocab = get_corpus(args.data)
+    data = batchify(ids, args.batch_size)
+    print(f"corpus: {len(ids)} tokens, vocab {vocab}, "
+          f"{data.shape[0]} time steps")
+
+    model = RNNModel(vocab)
+    model.initialize(init="xavier")
+    model.hybridize()   # one XLA executable per (T, N) signature
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+
+    for epoch in range(args.epochs):
+        h, c = model.begin_state(args.batch_size)
+        total_loss, n_batches = 0.0, 0
+        t0 = time.time()
+        for i in range(0, data.shape[0] - 1 - args.bptt, args.bptt):
+            x = mx.np.array(data[i:i + args.bptt])
+            y = mx.np.array(data[i + 1:i + 1 + args.bptt].reshape(-1))
+            h, c = h.detach(), c.detach()
+            with mx.autograd.record():
+                out, h, c = model(x, h, c)
+                L = loss_fn(out, y).mean()
+            L.backward()
+            grads = [p.grad() for p in model.collect_params().values()
+                     if p.grad_req != "null"]
+            mx.npx.clip_by_global_norm(grads, args.clip * args.batch_size)
+            trainer.step(args.batch_size)
+            total_loss += float(L.asnumpy())
+            n_batches += 1
+        ppl = math.exp(total_loss / max(n_batches, 1))
+        tok_s = n_batches * args.bptt * args.batch_size / (time.time() - t0)
+        print(f"epoch {epoch}: perplexity={ppl:.1f} ({tok_s:.0f} tokens/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+"""mx.contrib — quantization + contrib op surface."""
+from . import quantization

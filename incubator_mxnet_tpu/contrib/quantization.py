@@ -1,0 +1,315 @@
+"""INT8 quantization (≙ python/mxnet/contrib/quantization.py:383,755 +
+src/operator/quantization/*: quantize_v2/dequantize/requantize ops, min-max
+& KL-entropy calibration, quantize_net graph conversion).
+
+TPU-native: symmetric per-tensor int8. `quantize_net` swaps Dense/Conv2D
+children for Int8 wrappers whose forward runs an int8×int8→int32 matmul/conv
+(XLA lowers to the MXU's integer path) with f32 rescale — the oneDNN int8
+subgraph fusion collapses into XLA fusion. Calibration: run sample batches
+through `CalibrationCollector` hooks, min-max or entropy (KL) thresholds.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, _as_nd, _wrap
+from ..ops.registry import invoke
+
+__all__ = ["quantize", "dequantize", "quantize_v2", "requantize",
+           "quantize_net", "calibrate_net", "CalibrationCollector",
+           "Int8Dense", "Int8Conv2D"]
+
+
+def quantize_v2(data, min_calib_range=None, max_calib_range=None):
+    """f32 -> (int8, min, max) symmetric (≙ _contrib_quantize_v2)."""
+    data = _as_nd(data)
+    if min_calib_range is None or max_calib_range is None:
+        amax = float(abs(data.asnumpy()).max() or 1.0)
+        min_calib_range, max_calib_range = -amax, amax
+    scale = 127.0 / max(abs(min_calib_range), abs(max_calib_range), 1e-12)
+
+    def f(x):
+        import jax.numpy as jnp
+        q = jnp.clip(jnp.round(x * scale), -127, 127).astype(jnp.int8)
+        return q
+    q = invoke(f, (data,), name="quantize_v2")
+    return q, min_calib_range, max_calib_range
+
+
+quantize = quantize_v2
+
+
+def dequantize(qdata, min_range, max_range):
+    """int8 -> f32 (≙ _contrib_dequantize)."""
+    scale = max(abs(min_range), abs(max_range)) / 127.0
+
+    def f(q):
+        import jax.numpy as jnp
+        return q.astype(jnp.float32) * scale
+    return invoke(f, (_as_nd(qdata),), name="dequantize")
+
+
+def requantize(qdata32, min_range, max_range):
+    """int32 accum -> int8 with new range (≙ _contrib_requantize)."""
+    arr = _as_nd(qdata32)
+    amax = float(abs(arr.asnumpy()).max() or 1.0)
+
+    def f(q):
+        import jax.numpy as jnp
+        scale = 127.0 / amax
+        return jnp.clip(jnp.round(q.astype(jnp.float32) * scale),
+                        -127, 127).astype(jnp.int8)
+    return invoke(f, (arr,), name="requantize"), -amax, amax
+
+
+# ---------------------------------------------------------------------------
+# calibration (≙ quantization.py _LayerOutputCollector / KL calibration)
+# ---------------------------------------------------------------------------
+class CalibrationCollector:
+    """Collects per-layer activation ranges via forward hooks."""
+
+    def __init__(self, mode="naive", num_bins=2048):
+        if mode not in ("naive", "entropy"):
+            raise MXNetError("calib mode must be 'naive' (min-max) or "
+                             "'entropy' (KL)")
+        self.mode = mode
+        self.num_bins = num_bins
+        self.stats = {}   # name -> dict
+        self._handles = []
+
+    def attach(self, net):
+        for name, child in _iter_named_blocks(net):
+            h = child.register_forward_hook(self._make_hook(name))
+            self._handles.append(h)
+        return self
+
+    def detach(self):
+        for h in self._handles:
+            h.detach()
+        self._handles = []
+
+    def _make_hook(self, name):
+        def hook(block, inputs, output):
+            x = inputs[0]
+            if not isinstance(x, NDArray):
+                return
+            a = x.asnumpy()
+            st = self.stats.setdefault(
+                name, {"amax": 0.0, "hist": _np.zeros(self.num_bins)})
+            amax = float(_np.abs(a).max() or 0.0)
+            st["amax"] = max(st["amax"], amax)
+            if self.mode == "entropy" and amax > 0:
+                h, _ = _np.histogram(_np.abs(a), bins=self.num_bins,
+                                     range=(0, st["amax"]))
+                if len(st["hist"]) == self.num_bins:
+                    st["hist"] = st["hist"] + h
+        return hook
+
+    def threshold(self, name):
+        st = self.stats.get(name)
+        if st is None or st["amax"] == 0:
+            return None
+        if self.mode == "naive":
+            return st["amax"]
+        return _kl_threshold(st["hist"], st["amax"])
+
+
+def _kl_threshold(hist, amax, target_bins=128):
+    """KL-divergence-minimizing clip threshold (≙ calibrate.cc entropy)."""
+    hist = hist.astype(_np.float64)
+    total = hist.sum()
+    if total == 0:
+        return amax
+    n = len(hist)
+    best_kl, best_i = _np.inf, n
+    for i in range(target_bins, n + 1, max((n - target_bins) // 32, 1)):
+        p = hist[:i].copy()
+        p[-1] += hist[i:].sum()  # clip outliers into the last bin
+        p /= p.sum()
+        # quantize the i bins down to target_bins
+        factor = i / target_bins
+        q = _np.zeros(i)
+        for j in range(target_bins):
+            lo, hi = int(j * factor), max(int((j + 1) * factor), int(j * factor) + 1)
+            mass = hist[lo:hi].sum()
+            nz = (hist[lo:hi] > 0).sum()
+            if nz:
+                q[lo:hi] = _np.where(hist[lo:hi] > 0, mass / nz, 0)
+        qs = q.sum()
+        if qs == 0:
+            continue
+        q /= qs
+        mask = p > 0
+        kl = float(_np.sum(p[mask] * _np.log(p[mask] / _np.maximum(q[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return amax * best_i / n
+
+
+# ---------------------------------------------------------------------------
+# int8 layers + net conversion (≙ quantize_net)
+# ---------------------------------------------------------------------------
+class Int8Dense:
+    """Quantized Dense: int8 weights, int8 activations, int32 accumulate."""
+
+    def __init__(self, dense, act_amax=None):
+        import jax.numpy as jnp
+        w = dense.weight.data().asnumpy()
+        self._w_amax = float(_np.abs(w).max() or 1.0)
+        wq = _np.clip(_np.round(w * 127.0 / self._w_amax), -127, 127
+                      ).astype(_np.int8)
+        self._wq = _wrap(jnp.asarray(wq))
+        self._bias = dense.bias.data() if dense.bias is not None else None
+        self._act_amax = act_amax
+        self._flatten = dense._flatten
+        self._act_type = dense._act_type
+
+    def __call__(self, x):
+        x = _as_nd(x)
+        act_amax = self._act_amax or float(abs(x.asnumpy()).max() or 1.0)
+        w_scale = self._w_amax / 127.0
+        a_scale = act_amax / 127.0
+        flatten = self._flatten
+
+        def f(xr, wq, *maybe_bias):
+            import jax
+            import jax.numpy as jnp
+            if flatten and xr.ndim > 2:
+                xr = xr.reshape(xr.shape[0], -1)
+            xq = jnp.clip(jnp.round(xr / a_scale), -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, wq, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * (w_scale * a_scale)
+            if maybe_bias:
+                y = y + maybe_bias[0]
+            return y
+
+        args = (x, self._wq) + (() if self._bias is None else (self._bias,))
+        y = invoke(f, args, name="int8_dense")
+        if self._act_type:
+            from .. import numpy_extension as npx
+            y = npx.activation(y, act_type=self._act_type)
+        return y
+
+
+class Int8Conv2D:
+    """Quantized Conv2D (int8 conv, int32 accumulate, f32 rescale)."""
+
+    def __init__(self, conv, act_amax=None):
+        import jax.numpy as jnp
+        w = conv.weight.data().asnumpy()
+        self._w_amax = float(_np.abs(w).max() or 1.0)
+        wq = _np.clip(_np.round(w * 127.0 / self._w_amax), -127, 127
+                      ).astype(_np.int8)
+        self._wq = _wrap(jnp.asarray(wq))
+        self._bias = conv.bias.data() if conv.bias is not None else None
+        self._conv = conv
+        self._act_amax = act_amax
+
+    def __call__(self, x):
+        from ..ops import nn as _nn
+        x = _as_nd(x)
+        act_amax = self._act_amax or float(abs(x.asnumpy()).max() or 1.0)
+        w_scale = self._w_amax / 127.0
+        a_scale = act_amax / 127.0
+        conv = self._conv
+
+        def f(xr, wq, *maybe_bias):
+            import jax.numpy as jnp
+            xq = jnp.clip(jnp.round(xr / a_scale), -127, 127).astype(jnp.int8)
+            # integer conv accumulates in int32 on the MXU integer path
+            y = _nn.conv(xq.astype(jnp.int32), wq.astype(jnp.int32),
+                         None, stride=conv._strides, padding=conv._padding,
+                         dilation=conv._dilation, groups=conv._groups,
+                         layout=conv._layout)
+            y = y.astype(jnp.float32) * (w_scale * a_scale)
+            if maybe_bias:
+                b = maybe_bias[0]
+                y = y + b.reshape((1, -1) + (1,) * (y.ndim - 2))
+            return y
+
+        args = (x, self._wq) + (() if self._bias is None else (self._bias,))
+        y = invoke(f, args, name="int8_conv")
+        if conv._act_type:
+            from .. import numpy_extension as npx
+            y = npx.activation(y, act_type=conv._act_type)
+        return y
+
+
+def _iter_named_blocks(net, prefix=""):
+    for name, child in net._children.items():
+        full = f"{prefix}{name}"
+        yield full, child
+        yield from _iter_named_blocks(child, full + ".")
+
+
+def calibrate_net(net, calib_data, mode="naive", num_batches=10):
+    """Run calibration batches, return {layer_name: threshold}."""
+    collector = CalibrationCollector(mode).attach(net)
+    from .. import autograd
+    for i, batch in enumerate(calib_data):
+        if i >= num_batches:
+            break
+        x = batch[0] if isinstance(batch, (list, tuple)) else batch
+        with autograd.predict_mode():
+            net(x)
+    collector.detach()
+    return {name: collector.threshold(name)
+            for name in collector.stats}
+
+
+def quantize_net(net, calib_data=None, calib_mode="naive", num_batches=10,
+                 exclude_layers=None):
+    """≙ contrib.quantization.quantize_net: swap Dense/Conv2D children for
+    int8 versions (in place), calibrating activation ranges if data given."""
+    from ..gluon import nn
+    exclude = set(exclude_layers or [])
+    thresholds = {}
+    if calib_data is not None:
+        thresholds = calibrate_net(net, calib_data, calib_mode, num_batches)
+
+    def convert(block, prefix=""):
+        for name, child in list(block._children.items()):
+            full = f"{prefix}{name}"
+            if full in exclude:
+                continue
+            amax = thresholds.get(full)
+            if isinstance(child, nn.Dense) and child.weight._data is not None:
+                block._children[name] = _BlockAdapter(Int8Dense(child, amax))
+            elif type(child) is nn.Conv2D and child.weight._data is not None:
+                block._children[name] = _BlockAdapter(Int8Conv2D(child, amax))
+            else:
+                convert(child, full + ".")
+
+    convert(net)
+    if hasattr(net, "reset_cache"):
+        net.reset_cache()
+    return net
+
+
+class _BlockAdapter:
+    """Minimal Block-like wrapper so converted children slot into the tree."""
+
+    def __init__(self, impl):
+        self._impl = impl
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = {}
+        self._forward_pre_hooks = {}
+
+    def __call__(self, x, *args):
+        return self._impl(x)
+
+    def hybridize(self, *a, **kw):
+        pass
+
+    def _iter_params(self, prefix):
+        return iter(())
+
+    def apply(self, fn):
+        fn(self)
+
+    def __repr__(self):
+        return f"Int8({type(self._impl).__name__})"

@@ -66,6 +66,11 @@ class Trainer:
         else:
             kv = kv_create(arg) if isinstance(arg, str) else arg
             self._kvstore = kv
+            if self._compression_params:
+                # unconditional (≙ reference trainer.py:266): a store without
+                # compression support must fail loudly, not silently train
+                # uncompressed
+                kv.set_gradient_compression(self._compression_params)
             u = self._update_on_kvstore_arg
             if u is None:
                 u = kv.type.startswith("dist") if hasattr(kv, "type") else False

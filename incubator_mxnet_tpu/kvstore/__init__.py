@@ -79,6 +79,12 @@ class KVStore(KVStoreBase):
         self._updater = None
         self._optimizer = None
         self._opt_states = {}
+        self._compression = None
+
+    def set_gradient_compression(self, compression_params):
+        """≙ KVStore::SetGradientCompression (gradient_compression.cc)."""
+        from .gradient_compression import GradientCompression
+        self._compression = GradientCompression(**compression_params)
 
     @staticmethod
     def is_capable(capability):
@@ -118,6 +124,10 @@ class KVStore(KVStoreBase):
     def push(self, key, value, priority=0):
         keys, values = _pairs(key, value)
         for k, v in zip(keys, values):
+            if self._compression is not None:
+                vs = v if isinstance(v, (list, tuple)) else [v]
+                v = [self._compression.compress((k, i), g)
+                     for i, g in enumerate(vs)]
             agg = _aggregate(v)
             if self._updater is not None:
                 if k not in self._store:

@@ -103,7 +103,7 @@ _JNP_NAMES = [
     "logaddexp", "logaddexp2", "sin", "cos", "tan", "arcsin", "arccos",
     "arctan", "arctan2", "sinh", "cosh", "tanh", "arcsinh", "arccosh",
     "arctanh", "hypot", "deg2rad", "rad2deg", "degrees", "radians", "ceil",
-    "floor", "trunc", "round", "around", "fix", "clip", "maximum", "minimum",
+    "floor", "trunc", "round", "around", "clip", "maximum", "minimum",
     "fmax", "fmin", "heaviside", "nan_to_num", "real", "imag", "conj",
     "conjugate", "angle", "ldexp", "frexp", "copysign", "nextafter", "spacing",
     "gcd", "lcm", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
@@ -173,6 +173,15 @@ for _name in _missing:
         _host_fallback.__name__ = _name
         globals()[_name] = _host_fallback
         __all__.append(_name)
+
+
+def fix(x):
+    """Round toward zero (mx.np.fix; jnp.fix is deprecated — trunc is the
+    same operation)."""
+    return globals()["trunc"](x)
+
+
+__all__.append("fix")
 
 
 def astype(a, dtype):

@@ -1,0 +1,143 @@
+"""mx.operator — user-defined operators.
+
+Reference: python/mxnet/operator.py (CustomOp/CustomOpProp executed on
+dedicated C++ worker threads with GIL re-entry, src/operator/custom/
+custom-inl.h:52-198). TPU-native: a custom op is just a Python callable on
+NDArrays taped through autograd.Function — no worker-thread machinery is
+needed because eager dispatch is already async under PJRT. The CustomOpProp
+registration surface is preserved so reference-style code runs unchanged:
+
+    @mx.operator.register("sigmoid2")
+    class Sigmoid2Prop(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            return Sigmoid2()
+
+    out = mx.operator.invoke("sigmoid2", x)
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .autograd import Function
+from .ndarray import NDArray, _as_nd, zeros_like
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "invoke", "get_all_registered"]
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """≙ mx.operator.CustomOp: forward/backward with assign()."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """≙ CustomOp.assign honoring grad_req."""
+        src = _as_nd(src)
+        if req in ("write", "inplace", None):
+            dst._set_arr(src._arr)
+        elif req == "add":
+            dst._set_arr((dst + src)._arr)
+        elif req == "null":
+            pass
+        else:
+            raise MXNetError(f"invalid req {req!r}")
+
+
+class CustomOpProp:
+    """≙ mx.operator.CustomOpProp: shape/type inference + operator factory."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """≙ mx.operator.register decorator."""
+    def _reg(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register() expects a CustomOpProp subclass")
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return _reg
+
+
+def get_all_registered():
+    return dict(_REGISTRY)
+
+
+class _CustomFunction(Function):
+    """Bridges the CustomOp protocol onto the autograd tape."""
+
+    def __init__(self, op, n_out):
+        super().__init__()
+        self._op = op
+        self._n_out = n_out
+
+    def forward(self, *inputs):
+        from . import autograd
+        outs = [zeros_like(x) if i < len(inputs) else None
+                for i, x in enumerate(inputs)]
+        # allocate outputs via infer on first use: delegate to op
+        out_data = [None] * self._n_out
+        holder = _OutHolder(self._n_out)
+        self._op.forward(autograd.is_training(), ["write"] * self._n_out,
+                         list(inputs), holder.slots, [])
+        self._inputs = inputs
+        self._outputs = tuple(holder.get())
+        return self._outputs if self._n_out > 1 else self._outputs[0]
+
+    def backward(self, *output_grads):
+        n_in = len(self._inputs)
+        grads = [zeros_like(x) for x in self._inputs]
+        self._op.backward(["write"] * n_in, list(output_grads),
+                          list(self._inputs), list(self._outputs), grads, [])
+        return grads if n_in > 1 else grads[0]
+
+
+class _OutHolder:
+    """Output slots for CustomOp.forward: op calls assign(out_data[i],...)"""
+
+    def __init__(self, n):
+        from .ndarray import array
+        self.slots = [array(_np.zeros(1, _np.float32)) for _ in range(n)]
+
+    def get(self):
+        return self.slots
+
+
+def invoke(op_name, *inputs, ctx=None, **kwargs):
+    """Run a registered custom op eagerly (≙ the Custom op node)."""
+    if op_name not in _REGISTRY:
+        raise MXNetError(f"custom op {op_name!r} is not registered")
+    prop = _REGISTRY[op_name](**kwargs)
+    inputs = [_as_nd(x) for x in inputs]
+    in_shapes = [list(x.shape) for x in inputs]
+    in_types = [x.dtype for x in inputs]
+    prop.infer_shape(in_shapes)
+    op = prop.create_operator(ctx, in_shapes, in_types)
+    fn = _CustomFunction(op, len(prop.list_outputs()))
+    return fn(*inputs)

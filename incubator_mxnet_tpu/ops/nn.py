@@ -167,9 +167,10 @@ def pooling(x, kernel, pool_type="max", stride=None, padding=0,
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, window, strides, pads)
     if pool_type in ("avg", "sum"):
-        s = lax.reduce_window(x, 0.0 if not jnp.issubdtype(x.dtype, jnp.floating)
-                              else jnp.array(0, x.dtype),
-                              lax.add, window, strides, pads)
+        # init must be a python literal: an array init breaks reverse-mode
+        # linearization of reduce_window under jit (jax 0.9)
+        zero = 0.0 if jnp.issubdtype(x.dtype, jnp.floating) else 0
+        s = lax.reduce_window(x, zero, lax.add, window, strides, pads)
         if pool_type == "sum":
             return s
         if count_include_pad or all(lo == 0 and hi == 0
@@ -177,8 +178,7 @@ def pooling(x, kernel, pool_type="max", stride=None, padding=0,
             denom = _np.prod(kernel)
             return s / _np.asarray(denom, dtype=_np.float32).astype(x.dtype)
         ones = jnp.ones_like(x)
-        denom = lax.reduce_window(ones, jnp.array(0, x.dtype), lax.add,
-                                  window, strides, pads)
+        denom = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
         return s / denom
     raise ValueError(f"unknown pool_type {pool_type!r}")
 

@@ -32,7 +32,23 @@ __all__ = [
     "allreduce", "allgather", "reduce_scatter", "broadcast", "ppermute",
     "axis_is_bound", "shard", "replicate", "shard_map", "num_devices",
     "local_rank", "rank", "world_size", "DataParallel", "split_and_load",
+    "ring_attention", "pipeline_apply",
 ]
+
+
+def __getattr__(name):
+    # lazy so `import parallel` stays light; the submodule is named `ring`
+    # (not `ring_attention`) so this binding can never be shadowed by a
+    # submodule import
+    if name == "ring_attention":
+        from .ring import ring_attention
+        globals()[name] = ring_attention
+        return ring_attention
+    if name == "pipeline_apply":
+        from .pipeline import pipeline_apply
+        globals()[name] = pipeline_apply
+        return pipeline_apply
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 _tls = threading.local()
 

@@ -1,0 +1,70 @@
+"""Pipeline parallelism over the 'pp' mesh axis (GPipe-style).
+
+Green-field capability (SURVEY §2.3: pipeline parallelism is ABSENT in the
+reference — its only "model parallelism" is manual per-device placement with
+cross-device copies). Here: each pp rank holds one stage's parameters;
+microbatches stream through the ring, activations hop stages via
+`lax.ppermute` over ICI, and every device stays busy once the pipeline
+fills. Differentiable end-to-end (ppermute has a transpose rule), so
+jax.grad through `pipeline_apply` gives pipeline-parallel training.
+
+Schedule (classic GPipe, loop length M + S - 1):
+
+    step t: stage s processes microbatch (t - s) when 0 <= t-s < M
+            then activations rotate +1 around the ring
+
+Use inside shard_map with params sharded on 'pp' (one stage per rank) and
+the microbatched input on rank 0.
+"""
+from __future__ import annotations
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(stage_fn, stage_params, x_microbatches, axis_name="pp"):
+    """Run S pipeline stages over M microbatches.
+
+    stage_fn(params, x) -> y          one stage's computation (same shape)
+    stage_params                      this rank's stage parameters (pytree)
+    x_microbatches (M, B, ...)        full input, meaningful on rank 0
+                                      (other ranks pass same-shaped zeros)
+
+    Returns (M, B, ...) outputs, meaningful on the LAST rank (rank S-1);
+    other ranks return zeros. All ranks must call collectively.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    M = x_microbatches.shape[0]
+    mb_shape = x_microbatches.shape[1:]
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+
+    def step(carry, t):
+        state, outputs = carry
+        # microbatch index this stage works on at step t
+        mb = t - rank
+        active = (mb >= 0) & (mb < M)
+        # stage 0 ingests a fresh microbatch from local input
+        feed = jax.lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(mb, 0, M - 1), axis=0, keepdims=False)
+        state_in = jnp.where(rank == 0, feed, state)
+        y = stage_fn(stage_params, state_in)
+        y = jnp.where(active, y, state)
+        # last stage banks its finished microbatch
+        outputs = jax.lax.cond(
+            active & (rank == S - 1),
+            lambda o: jax.lax.dynamic_update_index_in_dim(
+                o, y, jnp.clip(mb, 0, M - 1), axis=0),
+            lambda o: o,
+            outputs)
+        # rotate activations to the next stage
+        state_next = jax.lax.ppermute(y, axis_name, perm_fwd)
+        return (state_next, outputs), None
+
+    state0 = jnp.zeros(mb_shape, x_microbatches.dtype)
+    outputs0 = jnp.zeros((M,) + mb_shape, x_microbatches.dtype)
+    (state, outputs), _ = jax.lax.scan(
+        step, (state0, outputs0), jnp.arange(M + S - 1))
+    return outputs

@@ -48,7 +48,7 @@ def test_ring_attention_matches_full(causal):
     import jax
     from jax.sharding import Mesh, PartitionSpec as P
     from incubator_mxnet_tpu import parallel
-    from incubator_mxnet_tpu.parallel.ring_attention import ring_attention
+    from incubator_mxnet_tpu.parallel import ring_attention
 
     rng = np.random.default_rng(2)
     B, T, D = 2, 64, 32
@@ -73,7 +73,7 @@ def test_ring_attention_long_sequence_grad():
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
     from incubator_mxnet_tpu import parallel
-    from incubator_mxnet_tpu.parallel.ring_attention import ring_attention
+    from incubator_mxnet_tpu.parallel import ring_attention
 
     rng = np.random.default_rng(3)
     q = rng.standard_normal((1, 32, 16), np.float32)

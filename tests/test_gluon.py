@@ -545,3 +545,16 @@ def test_custom_optimizer_override_not_fused():
         net(x).sum().backward()
     tr.step(2)
     assert calls  # the override actually ran
+
+
+def test_avgpool_hybrid_backward():
+    """Regression: vjp through a jitted avg-pool (reduce_window with array
+    init broke linearization in jax 0.9 — init must be a literal)."""
+    pool = nn.AvgPool2D(2, 2)
+    pool.hybridize()
+    x = mx.np.array(np.random.randn(2, 1, 8, 8).astype(np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        pool(x).sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               0.25 * np.ones((2, 1, 8, 8)), rtol=1e-6)

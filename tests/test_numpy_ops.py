@@ -1,0 +1,204 @@
+"""Broad mx.np coverage vs host NumPy (≙ tests/python/unittest/
+test_numpy_op.py ~10k LoC of per-op numeric checks — here a parametrized
+sweep over the generated wrapper surface plus targeted semantics checks)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+mxnp = mx.np
+
+
+def _ref(name):
+    return getattr(onp, name)
+
+
+_UNARY = ["negative", "absolute", "sign", "rint", "square", "sqrt", "exp",
+          "expm1", "log", "log2", "log10", "log1p", "sin", "cos", "tan",
+          "arcsin", "arctan", "sinh", "cosh", "tanh", "arcsinh",
+          "arctanh", "ceil", "floor", "trunc", "reciprocal", "cbrt",
+          "deg2rad", "rad2deg"]
+
+_BINARY = ["add", "subtract", "multiply", "true_divide", "power", "maximum",
+           "minimum", "hypot", "arctan2", "logaddexp", "copysign",
+           "fmod", "floor_divide"]
+
+_REDUCE = ["sum", "prod", "mean", "std", "var", "min", "max", "argmin",
+           "argmax", "cumsum", "cumprod"]
+
+_LOGIC = ["equal", "not_equal", "less", "less_equal", "greater",
+          "greater_equal", "logical_and", "logical_or", "logical_xor"]
+
+
+@pytest.mark.parametrize("name", _UNARY)
+def test_unary_matches_numpy(name):
+    x = onp.random.uniform(0.1, 0.9, (3, 4)).astype(onp.float32)
+    got = getattr(mxnp, name)(mxnp.array(x)).asnumpy()
+    want = _ref(name)(x.astype(onp.float64)).astype(onp.float32)
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("name", _BINARY)
+def test_binary_matches_numpy(name):
+    a = onp.random.uniform(0.1, 2.0, (3, 4)).astype(onp.float32)
+    b = onp.random.uniform(0.1, 2.0, (4,)).astype(onp.float32)  # broadcast
+    got = getattr(mxnp, name)(mxnp.array(a), mxnp.array(b)).asnumpy()
+    want = _ref(name)(a.astype(onp.float64), b.astype(onp.float64))
+    onp.testing.assert_allclose(got, want.astype(onp.float32), rtol=2e-5,
+                                atol=2e-6)
+
+
+@pytest.mark.parametrize("name", _REDUCE)
+def test_reduce_matches_numpy(name):
+    x = onp.random.uniform(-1, 1, (3, 5)).astype(onp.float32)
+    got = getattr(mxnp, name)(mxnp.array(x), axis=1).asnumpy()
+    want = _ref(name)(x.astype(onp.float64), axis=1)
+    onp.testing.assert_allclose(got, onp.asarray(want, got.dtype), rtol=2e-5,
+                                atol=1e-5)
+
+
+@pytest.mark.parametrize("name", _LOGIC)
+def test_logic_matches_numpy(name):
+    a = onp.random.randint(0, 3, (4, 4)).astype(onp.float32)
+    b = onp.random.randint(0, 3, (4, 4)).astype(onp.float32)
+    got = getattr(mxnp, name)(mxnp.array(a), mxnp.array(b)).asnumpy()
+    want = _ref(name)(a, b)
+    onp.testing.assert_array_equal(got, want)
+
+
+def test_manipulation_family():
+    x = onp.arange(24, dtype=onp.float32).reshape(2, 3, 4)
+    nd = mxnp.array(x)
+    onp.testing.assert_array_equal(mxnp.transpose(nd, (2, 0, 1)).asnumpy(),
+                                   x.transpose(2, 0, 1))
+    onp.testing.assert_array_equal(mxnp.moveaxis(nd, 0, -1).asnumpy(),
+                                   onp.moveaxis(x, 0, -1))
+    onp.testing.assert_array_equal(
+        mxnp.concatenate([nd, nd], axis=1).asnumpy(),
+        onp.concatenate([x, x], axis=1))
+    onp.testing.assert_array_equal(mxnp.stack([nd, nd]).asnumpy(),
+                                   onp.stack([x, x]))
+    onp.testing.assert_array_equal(mxnp.flip(nd, axis=2).asnumpy(),
+                                   onp.flip(x, axis=2))
+    onp.testing.assert_array_equal(mxnp.roll(nd, 2, axis=2).asnumpy(),
+                                   onp.roll(x, 2, axis=2))
+    onp.testing.assert_array_equal(mxnp.tile(nd, (1, 2, 1)).asnumpy(),
+                                   onp.tile(x, (1, 2, 1)))
+    parts = mxnp.split(nd, 2, axis=2)
+    onp.testing.assert_array_equal(parts[0].asnumpy(),
+                                   onp.split(x, 2, axis=2)[0])
+    onp.testing.assert_array_equal(mxnp.pad(nd, ((0, 0), (1, 1), (0, 0))).asnumpy(),
+                                   onp.pad(x, ((0, 0), (1, 1), (0, 0))))
+
+
+def test_linalg_family():
+    a = onp.random.randn(3, 4).astype(onp.float32)
+    b = onp.random.randn(4, 5).astype(onp.float32)
+    onp.testing.assert_allclose(
+        mxnp.matmul(mxnp.array(a), mxnp.array(b)).asnumpy(), a @ b,
+        rtol=2e-5, atol=1e-5)
+    onp.testing.assert_allclose(
+        mxnp.einsum("ij,jk->ik", mxnp.array(a), mxnp.array(b)).asnumpy(),
+        onp.einsum("ij,jk->ik", a, b), rtol=2e-5, atol=1e-5)
+    onp.testing.assert_allclose(
+        mxnp.tensordot(mxnp.array(a), mxnp.array(b), axes=1).asnumpy(),
+        onp.tensordot(a, b, axes=1), rtol=2e-5, atol=1e-5)
+    sq = onp.random.randn(4, 4).astype(onp.float32) + 4 * onp.eye(4, dtype=onp.float32)
+    onp.testing.assert_allclose(mxnp.trace(mxnp.array(sq)).asnumpy(),
+                                onp.trace(sq), rtol=1e-6)
+
+
+def test_np_linalg_submodule():
+    from incubator_mxnet_tpu.numpy import linalg
+    sq = onp.random.randn(4, 4).astype(onp.float32)
+    spd = sq @ sq.T + 4 * onp.eye(4, dtype=onp.float32)
+    L = linalg.cholesky(mxnp.array(spd)).asnumpy()
+    onp.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    inv = linalg.inv(mxnp.array(spd)).asnumpy()
+    onp.testing.assert_allclose(inv @ spd, onp.eye(4), rtol=1e-3, atol=1e-3)
+    n = linalg.norm(mxnp.array(sq)).asnumpy()
+    onp.testing.assert_allclose(n, onp.linalg.norm(sq), rtol=1e-5)
+    w = linalg.svd(mxnp.array(sq))
+    assert len(w) == 3
+
+
+def test_indexing_family():
+    x = onp.arange(20, dtype=onp.float32).reshape(4, 5)
+    nd = mxnp.array(x)
+    idx = mxnp.array(onp.array([0, 2]))
+    onp.testing.assert_array_equal(mxnp.take(nd, idx, axis=0).asnumpy(),
+                                   onp.take(x, [0, 2], axis=0))
+    onp.testing.assert_array_equal(
+        mxnp.where(nd > 10, nd, mxnp.zeros(())).asnumpy(),
+        onp.where(x > 10, x, 0))
+    onp.testing.assert_array_equal(mxnp.argsort(nd, axis=1).asnumpy(),
+                                   onp.argsort(x, axis=1))
+    onp.testing.assert_array_equal(mxnp.sort(-nd, axis=1).asnumpy(),
+                                   onp.sort(-x, axis=1))
+    u = mxnp.unique(mxnp.array(onp.array([3, 1, 3, 2])))
+    onp.testing.assert_array_equal(u.asnumpy(), [1, 2, 3])
+
+
+def test_ndarray_advanced_indexing():
+    x = mxnp.array(onp.arange(12, dtype=onp.float32).reshape(3, 4))
+    # boolean mask
+    m = x > 5
+    assert (x.asnumpy()[x.asnumpy() > 5] == x[m].asnumpy()).all()
+    # integer array indexing
+    got = x[mxnp.array(onp.array([0, 2]))].asnumpy()
+    onp.testing.assert_array_equal(got, x.asnumpy()[[0, 2]])
+    # setitem with slice
+    x[1:3, 0] = -1
+    assert (x.asnumpy()[1:3, 0] == -1).all()
+
+
+def test_view_write_through():
+    """Basic-index views write through to the base (≙ reference zero-copy
+    Slice views, ndarray.h)."""
+    x = mxnp.zeros((4, 4))
+    v = x[1]
+    v[:] = 7
+    assert (x.asnumpy()[1] == 7).all()
+    x[2] = 3  # base write visible through fresh views
+    assert (x[2].asnumpy() == 3).all()
+
+
+def test_random_family():
+    mx.seed(0)
+    r = mxnp.random
+    s = r.normal(0, 1, size=(10000,))
+    assert abs(float(s.asnumpy().mean())) < 0.05
+    u = r.uniform(2, 3, size=(1000,)).asnumpy()
+    assert u.min() >= 2 and u.max() <= 3
+    ri = r.randint(0, 10, size=(1000,)).asnumpy()
+    assert ri.min() >= 0 and ri.max() < 10
+    c = r.choice(5, size=(100,)).asnumpy()
+    assert set(c.astype(int)) <= set(range(5))
+    sh = mxnp.array(onp.arange(10, dtype=onp.float32))
+    p = r.permutation(sh).asnumpy()
+    assert sorted(p.tolist()) == list(range(10))
+
+
+def test_custom_operator():
+    """mx.operator.CustomOp protocol (≙ python/mxnet/operator.py)."""
+    from incubator_mxnet_tpu import operator as op_mod
+
+    class Square(op_mod.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+
+    @op_mod.register("square_custom")
+    class SquareProp(op_mod.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            return Square()
+
+    x = mxnp.array(onp.array([1.0, 2.0, 3.0], onp.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = op_mod.invoke("square_custom", x)
+    y.backward()
+    onp.testing.assert_allclose(y.asnumpy(), [1, 4, 9], rtol=1e-6)
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6], rtol=1e-6)

@@ -1,0 +1,109 @@
+"""INT8 quantization + gradient compression tests (≙ reference
+tests/python/quantization/ + tests/nightly/dist_sync_kvstore.py:232-372)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.contrib import quantization as q
+
+
+def test_quantize_dequantize_roundtrip():
+    x = mx.np.array(np.random.randn(4, 8).astype(np.float32))
+    qd, mn, mxr = q.quantize_v2(x)
+    assert str(qd.dtype) == "int8"
+    back = q.dequantize(qd, mn, mxr)
+    step = max(abs(mn), mxr) / 127
+    assert float(abs(back.asnumpy() - x.asnumpy()).max()) <= step * 1.01
+
+
+def test_quantize_with_calib_range():
+    x = mx.np.array(np.array([0.1, 5.0, -0.2], np.float32))
+    qd, mn, mxr = q.quantize_v2(x, -1.0, 1.0)
+    a = qd.asnumpy()
+    assert a[1] == 127  # clipped at calibrated range
+
+
+def test_quantize_net_dense_close_to_fp32():
+    from incubator_mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=16),
+            nn.Dense(8, in_units=32))
+    net.initialize()
+    x = mx.np.array(np.random.randn(4, 16).astype(np.float32))
+    ref = net(x).asnumpy()
+    calib = DataLoader(ArrayDataset(x.asnumpy()), batch_size=4)
+    q.quantize_net(net, calib_data=calib)
+    got = net(x).asnumpy()
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_quantize_net_conv():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1, in_channels=2))
+    net.initialize()
+    x = mx.np.array(np.random.randn(1, 2, 8, 8).astype(np.float32))
+    ref = net(x).asnumpy()
+    q.quantize_net(net)
+    got = net(x).asnumpy()
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_kl_threshold_reasonable():
+    hist = np.zeros(2048)
+    hist[:1024] = 100  # mass concentrated in lower half
+    hist[2047] = 1     # single outlier
+    thr = q._kl_threshold(hist, amax=8.0)
+    assert 2.0 < thr <= 8.0  # clipped well below the outlier
+
+
+def test_gradient_compression_2bit():
+    from incubator_mxnet_tpu.kvstore.gradient_compression import \
+        GradientCompression
+    gc = GradientCompression("2bit", threshold=0.5)
+    g = mx.np.array(np.array([1.0, 0.2, -0.7, 0.0], np.float32))
+    out = gc.compress("k", g)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, 0.0, -0.5, 0.0])
+    # error feedback: residual accumulates toward eventual transmission
+    out2 = gc.compress("k", g)
+    np.testing.assert_allclose(out2.asnumpy(), [0.5, 0.0, -0.5, 0.0])
+    out3 = gc.compress("k", g)
+    # after 3 pushes of 0.2, residual 0.6 > threshold → fires
+    assert out3.asnumpy()[1] == 0.5
+
+
+def test_gradient_compression_1bit():
+    from incubator_mxnet_tpu.kvstore.gradient_compression import \
+        GradientCompression
+    gc = GradientCompression("1bit", threshold=0.25)
+    g = mx.np.array(np.array([0.9, -0.1], np.float32))
+    out = gc.compress("k", g)
+    np.testing.assert_allclose(out.asnumpy(), [0.25, -0.25])
+
+
+def test_kvstore_compression_integration():
+    kv = mx.kvstore.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", mx.np.zeros((3,)))
+    kv.push("w", mx.np.array(np.array([2.0, 0.1, -3.0], np.float32)))
+    out = mx.np.zeros((3,))
+    kv.pull("w", out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, 0.0, -0.5])
+
+
+def test_compression_convergence_preserved():
+    """Error feedback ⇒ mean of compressed grads ≈ mean of true grads."""
+    from incubator_mxnet_tpu.kvstore.gradient_compression import \
+        GradientCompression
+    gc = GradientCompression("2bit", threshold=0.1)
+    rng = np.random.default_rng(0)
+    true_sum = np.zeros(16, np.float32)
+    sent_sum = np.zeros(16, np.float32)
+    for _ in range(200):
+        g = rng.normal(0, 0.05, 16).astype(np.float32)
+        true_sum += g
+        sent_sum += gc.compress("k", mx.np.array(g)).asnumpy()
+    np.testing.assert_allclose(sent_sum, true_sum, atol=0.25)
