@@ -46,6 +46,7 @@ def load_data(batch_size):
         X = np.stack([train[i][0].asnumpy() for i in range(len(train))])
         Y = np.array([train[i][1] for i in range(len(train))], np.int32)
         print(f"loaded MNIST from {root}: {len(Y)} images")
+        X = X.astype(np.float32).transpose(0, 3, 1, 2) / 255.0  # HWC u8→CHW
     except mx.MXNetError:
         print("MNIST files not found; using synthetic digits")
         rng = np.random.default_rng(0)
@@ -53,8 +54,7 @@ def load_data(batch_size):
         X = rng.normal(0, 0.2, (4096, 28, 28, 1)).astype(np.float32)
         for i, y in enumerate(Y):  # one bright row per class: learnable
             X[i, 2 * y + 3, :, 0] += 2.0
-    X = X.astype(np.float32).reshape(-1, 1, 28, 28) / 255.0 \
-        if X.max() > 2 else X.astype(np.float32).transpose(0, 3, 1, 2)
+        X = X.transpose(0, 3, 1, 2)
     return DataLoader(ArrayDataset(X, Y), batch_size=batch_size, shuffle=True,
                       num_workers=2)
 
@@ -64,7 +64,8 @@ def main():
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--lr", type=float, default=0.02)
-    ap.add_argument("--hybridize", action="store_true", default=True)
+    ap.add_argument("--hybridize", action=argparse.BooleanOptionalAction,
+                    default=True)
     args = ap.parse_args()
 
     net = build_lenet()

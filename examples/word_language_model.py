@@ -34,6 +34,10 @@ class RNNModel(gluon.HybridBlock):
         self.rnn = rnn.LSTM(hidden_size, num_layers, dropout=dropout,
                             input_size=embed_size)
         self.decoder = nn.Dense(vocab_size, in_units=hidden_size)
+        if tie_weights:
+            if embed_size != hidden_size:
+                raise ValueError("tie_weights needs embed_size == hidden_size")
+            self.decoder.weight = self.encoder.weight  # shared Parameter
         self.hidden_size = hidden_size
 
     def forward(self, inputs, h, c):
@@ -71,10 +75,6 @@ def get_corpus(path):
     uniq = sorted(set(words))
     index = {w: i for i, w in enumerate(uniq)}
     return np.asarray([index[w] for w in words], np.int32), len(uniq)
-
-
-def detach(state):
-    return [s.detach() for s in state]
 
 
 def main():

@@ -51,14 +51,17 @@ def dequantize(qdata, min_range, max_range):
 
 
 def requantize(qdata32, min_range, max_range):
-    """int32 accum -> int8 with new range (≙ _contrib_requantize)."""
+    """int32 accum -> int8 using the CALIBRATED real-value range
+    (≙ _contrib_requantize): min/max describe the real values the int32 data
+    spans; no data-dependent host sync."""
     arr = _as_nd(qdata32)
-    amax = float(abs(arr.asnumpy()).max() or 1.0)
+    amax = max(abs(min_range), abs(max_range), 1e-12)
+    in_scale = amax / float(2 ** 31 - 1)   # real units per int32 step
 
     def f(q):
         import jax.numpy as jnp
-        scale = 127.0 / amax
-        return jnp.clip(jnp.round(q.astype(jnp.float32) * scale),
+        real = q.astype(jnp.float32) * in_scale
+        return jnp.clip(jnp.round(real * (127.0 / amax)),
                         -127, 127).astype(jnp.int8)
     return invoke(f, (arr,), name="requantize"), -amax, amax
 
@@ -98,12 +101,19 @@ class CalibrationCollector:
             st = self.stats.setdefault(
                 name, {"amax": 0.0, "hist": _np.zeros(self.num_bins)})
             amax = float(_np.abs(a).max() or 0.0)
+            if amax > st["amax"] and st["amax"] > 0 and self.mode == "entropy":
+                # rebin the accumulated histogram onto the widened range so
+                # bin widths stay consistent across batches
+                old_edges = _np.linspace(0, st["amax"], self.num_bins + 1)
+                centers = (old_edges[:-1] + old_edges[1:]) / 2
+                st["hist"], _ = _np.histogram(
+                    centers, bins=self.num_bins, range=(0, amax),
+                    weights=st["hist"])
             st["amax"] = max(st["amax"], amax)
-            if self.mode == "entropy" and amax > 0:
+            if self.mode == "entropy" and st["amax"] > 0:
                 h, _ = _np.histogram(_np.abs(a), bins=self.num_bins,
                                      range=(0, st["amax"]))
-                if len(st["hist"]) == self.num_bins:
-                    st["hist"] = st["hist"] + h
+                st["hist"] = st["hist"] + h
         return hook
 
     def threshold(self, name):
@@ -167,9 +177,8 @@ class Int8Dense:
 
     def __call__(self, x):
         x = _as_nd(x)
-        act_amax = self._act_amax or float(abs(x.asnumpy()).max() or 1.0)
         w_scale = self._w_amax / 127.0
-        a_scale = act_amax / 127.0
+        act_amax = self._act_amax  # None → dynamic in-graph quantization
         flatten = self._flatten
 
         def f(xr, wq, *maybe_bias):
@@ -177,9 +186,12 @@ class Int8Dense:
             import jax.numpy as jnp
             if flatten and xr.ndim > 2:
                 xr = xr.reshape(xr.shape[0], -1)
+            a_scale = (act_amax / 127.0 if act_amax is not None
+                       else jnp.maximum(jnp.max(jnp.abs(xr)), 1e-6) / 127.0)
             xq = jnp.clip(jnp.round(xr / a_scale), -127, 127).astype(jnp.int8)
+            # contract the LAST input axis (matches fp32 dense: x @ W.T)
             acc = jax.lax.dot_general(
-                xq, wq, (((1,), (1,)), ((), ())),
+                xq, wq, (((xq.ndim - 1,), (1,)), ((), ())),
                 preferred_element_type=jnp.int32)
             y = acc.astype(jnp.float32) * (w_scale * a_scale)
             if maybe_bias:
@@ -205,36 +217,47 @@ class Int8Conv2D:
                       ).astype(_np.int8)
         self._wq = _wrap(jnp.asarray(wq))
         self._bias = conv.bias.data() if conv.bias is not None else None
-        self._conv = conv
+        # copy only the conv hyperparams: keeping the block alive would pin
+        # the fp32 weights the conversion is meant to free
+        self._strides = conv._strides
+        self._padding = conv._padding
+        self._dilation = conv._dilation
+        self._groups = conv._groups
+        self._layout = conv._layout
+        self._act_type = conv._act_type
         self._act_amax = act_amax
 
     def __call__(self, x):
         from ..ops import nn as _nn
         x = _as_nd(x)
-        act_amax = self._act_amax or float(abs(x.asnumpy()).max() or 1.0)
         w_scale = self._w_amax / 127.0
-        a_scale = act_amax / 127.0
-        conv = self._conv
+        act_amax = self._act_amax
+        stride, pad, dil = self._strides, self._padding, self._dilation
+        groups, layout = self._groups, self._layout
 
         def f(xr, wq, *maybe_bias):
             import jax.numpy as jnp
+            a_scale = (act_amax / 127.0 if act_amax is not None
+                       else jnp.maximum(jnp.max(jnp.abs(xr)), 1e-6) / 127.0)
             xq = jnp.clip(jnp.round(xr / a_scale), -127, 127).astype(jnp.int8)
             # integer conv accumulates in int32 on the MXU integer path
             y = _nn.conv(xq.astype(jnp.int32), wq.astype(jnp.int32),
-                         None, stride=conv._strides, padding=conv._padding,
-                         dilation=conv._dilation, groups=conv._groups,
-                         layout=conv._layout)
+                         None, stride=stride, padding=pad,
+                         dilation=dil, groups=groups, layout=layout)
             y = y.astype(jnp.float32) * (w_scale * a_scale)
             if maybe_bias:
                 b = maybe_bias[0]
-                y = y + b.reshape((1, -1) + (1,) * (y.ndim - 2))
+                if layout.startswith("NC"):
+                    y = y + b.reshape((1, -1) + (1,) * (y.ndim - 2))
+                else:  # channels-last layouts (NHWC...)
+                    y = y + b
             return y
 
         args = (x, self._wq) + (() if self._bias is None else (self._bias,))
         y = invoke(f, args, name="int8_conv")
-        if conv._act_type:
+        if self._act_type:
             from .. import numpy_extension as npx
-            y = npx.activation(y, act_type=conv._act_type)
+            y = npx.activation(y, act_type=self._act_type)
         return y
 
 
@@ -246,18 +269,35 @@ def _iter_named_blocks(net, prefix=""):
 
 
 def calibrate_net(net, calib_data, mode="naive", num_batches=10):
-    """Run calibration batches, return {layer_name: threshold}."""
+    """Run calibration batches, return {layer_name: threshold}. Hybridized
+    caches are temporarily deactivated: the cached whole-graph op bypasses
+    per-child forward hooks."""
     collector = CalibrationCollector(mode).attach(net)
     from .. import autograd
-    for i, batch in enumerate(calib_data):
-        if i >= num_batches:
-            break
-        x = batch[0] if isinstance(batch, (list, tuple)) else batch
-        with autograd.predict_mode():
-            net(x)
-    collector.detach()
+    saved = []
+    for blk in _walk_blocks(net):
+        if getattr(blk, "_active", False):
+            saved.append(blk)
+            blk._active = False
+    try:
+        for i, batch in enumerate(calib_data):
+            if i >= num_batches:
+                break
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            with autograd.predict_mode():
+                net(x)
+    finally:
+        for blk in saved:
+            blk._active = True
+        collector.detach()
     return {name: collector.threshold(name)
             for name in collector.stats}
+
+
+def _walk_blocks(net):
+    yield net
+    for child in net._children.values():
+        yield from _walk_blocks(child)
 
 
 def quantize_net(net, calib_data=None, calib_mode="naive", num_batches=10,

@@ -315,8 +315,9 @@ class ZoneoutCell(_ModifierCell):
         out, new_states = self.base_cell(inputs, states)
         if autograd.is_training():
             def mask(p, like):
-                return npx.dropout(mxnp.ones_like(like), p=p) * p if False \
-                    else (npx.dropout(mxnp.ones_like(like), p=p))
+                # dropout(ones) is nonzero where the value is KEPT; zoneout
+                # keeps the new value there and the previous one elsewhere
+                return npx.dropout(mxnp.ones_like(like), p=p)
             if self._zo > 0:
                 prev = self._prev_output if self._prev_output is not None \
                     else mxnp.zeros_like(out)

@@ -61,6 +61,11 @@ class Trainer:
         """≙ trainer.py:188 — decide kvstore & update placement."""
         arg = self._kvstore_arg
         if arg is None or arg is False:
+            if self._compression_params:
+                raise MXNetError(
+                    "compression_params requires a kvstore (e.g. "
+                    "kvstore='device'); without one gradients would silently "
+                    "flow uncompressed")
             self._kvstore = None
             self._update_on_kvstore = False
         else:

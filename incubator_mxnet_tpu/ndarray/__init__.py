@@ -660,3 +660,20 @@ def load(fname):
             keys.sort(key=lambda k: int(k.split("_")[1]))
             return [array(f[k]) for k in keys]
         return {k: array(f[k]) for k in keys}
+
+
+def __getattr__(name):
+    """mx.nd.<op> delegates to the numpy frontend: the reference's legacy nd
+    namespace (hundreds of generated wrappers, python/mxnet/ndarray/) shares
+    one implementation with mx.np here."""
+    from .. import numpy as _mxnp
+    fn = getattr(_mxnp, name, None)
+    if fn is None:
+        # the legacy nd namespace also carried the NN op surface
+        from .. import numpy_extension as _mxnpx
+        fn = getattr(_mxnpx, name, None)
+    if fn is None:
+        raise AttributeError(
+            f"module 'mx.nd' has no attribute {name!r}")
+    globals()[name] = fn
+    return fn

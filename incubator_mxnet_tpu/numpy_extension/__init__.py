@@ -193,6 +193,42 @@ def pooling(data, kernel=1, pool_type="max", stride=None, pad=0,
                   (_as_nd(data),), name="pooling")
 
 
+def box_iou(lhs, rhs, format="corner"):
+    """≙ _contrib_box_iou (src/operator/contrib/bounding_box.cc)."""
+    from ..ops import contrib as _contrib
+    return invoke(functools.partial(_contrib.box_iou, fmt=format),
+                  (_as_nd(lhs), _as_nd(rhs)), name="box_iou")
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, force_suppress=False):
+    """≙ _contrib_box_nms."""
+    from ..ops import contrib as _contrib
+    return invoke(functools.partial(
+        _contrib.box_nms, overlap_thresh=overlap_thresh,
+        valid_thresh=valid_thresh, topk=topk, coord_start=coord_start,
+        score_index=score_index, id_index=id_index,
+        force_suppress=force_suppress), (_as_nd(data),), name="box_nms")
+
+
+def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=2):
+    """≙ _contrib_ROIAlign."""
+    from ..ops import contrib as _contrib
+    return invoke(functools.partial(
+        _contrib.roi_align, pooled_size=pooled_size,
+        spatial_scale=spatial_scale, sample_ratio=sample_ratio),
+        (_as_nd(data), _as_nd(rois)), name="roi_align")
+
+
+def bilinear_resize2d(data, height, width, layout="NCHW"):
+    """≙ _contrib_BilinearResize2D."""
+    from ..ops import contrib as _contrib
+    return invoke(functools.partial(_contrib.bilinear_resize2d,
+                                    height=height, width=width,
+                                    layout=layout),
+                  (_as_nd(data),), name="bilinear_resize2d")
+
+
 def smooth_l1(x, scalar=1.0):
     """reference: smooth_l1 op (src/operator/tensor/elemwise_unary_op)"""
     def f(v):

@@ -92,19 +92,17 @@ def get_all_registered():
 class _CustomFunction(Function):
     """Bridges the CustomOp protocol onto the autograd tape."""
 
-    def __init__(self, op, n_out):
+    def __init__(self, op, n_out, is_train):
         super().__init__()
         self._op = op
         self._n_out = n_out
+        # captured BEFORE Function.__call__'s pause() scope flips training
+        # off (≙ reference: is_train reflects the recording context)
+        self._is_train = is_train
 
     def forward(self, *inputs):
-        from . import autograd
-        outs = [zeros_like(x) if i < len(inputs) else None
-                for i, x in enumerate(inputs)]
-        # allocate outputs via infer on first use: delegate to op
-        out_data = [None] * self._n_out
         holder = _OutHolder(self._n_out)
-        self._op.forward(autograd.is_training(), ["write"] * self._n_out,
+        self._op.forward(self._is_train, ["write"] * self._n_out,
                          list(inputs), holder.slots, [])
         self._inputs = inputs
         self._outputs = tuple(holder.get())
@@ -138,6 +136,8 @@ def invoke(op_name, *inputs, ctx=None, **kwargs):
     in_shapes = [list(x.shape) for x in inputs]
     in_types = [x.dtype for x in inputs]
     prop.infer_shape(in_shapes)
+    from . import autograd
     op = prop.create_operator(ctx, in_shapes, in_types)
-    fn = _CustomFunction(op, len(prop.list_outputs()))
+    fn = _CustomFunction(op, len(prop.list_outputs()),
+                         autograd.is_training() or autograd.is_recording())
     return fn(*inputs)

@@ -1,0 +1,153 @@
+"""Contrib detection/vision ops (≙ src/operator/contrib: bounding_box.cc
+box_nms/box_iou, roi_align.cc, bilinear_resize.cc, multibox_*).
+
+TPU-native: everything is fixed-shape and vectorized — box_nms returns the
+standard MXNet convention (suppressed entries get score -1) with a
+lax.fori_loop greedy sweep instead of the reference's CUDA sort+mask kernel,
+so it compiles under jit with static shapes.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+__all__ = ["box_iou", "box_nms", "roi_align", "bilinear_resize2d"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def box_iou(lhs, rhs, fmt="corner"):
+    """Pairwise IoU (≙ _contrib_box_iou). lhs (..., N, 4), rhs (..., M, 4)."""
+    jnp = _jnp()
+    if fmt == "center":
+        lhs = _center_to_corner(lhs)
+        rhs = _center_to_corner(rhs)
+    lx1, ly1, lx2, ly2 = [lhs[..., :, None, i] for i in range(4)]
+    rx1, ry1, rx2, ry2 = [rhs[..., None, :, i] for i in range(4)]
+    ix1 = jnp.maximum(lx1, rx1)
+    iy1 = jnp.maximum(ly1, ry1)
+    ix2 = jnp.minimum(lx2, rx2)
+    iy2 = jnp.minimum(ly2, ry2)
+    iw = jnp.clip(ix2 - ix1, 0, None)
+    ih = jnp.clip(iy2 - iy1, 0, None)
+    inter = iw * ih
+    area_l = jnp.clip(lx2 - lx1, 0, None) * jnp.clip(ly2 - ly1, 0, None)
+    area_r = jnp.clip(rx2 - rx1, 0, None) * jnp.clip(ry2 - ry1, 0, None)
+    union = area_l + area_r - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _center_to_corner(b):
+    jnp = _jnp()
+    x, y, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, force_suppress=False):
+    """Greedy NMS (≙ _contrib_box_nms). data (..., N, K) with K >= 6:
+    [class_id, score, x1, y1, x2, y2, ...]. Suppressed/invalid entries get
+    score -1 (reference convention); order preserved by descending score."""
+    import jax
+    jnp = _jnp()
+
+    def one(batch):  # (N, K)
+        n = batch.shape[0]
+        scores = batch[:, score_index]
+        ids = batch[:, id_index] if id_index >= 0 else jnp.zeros(n)
+        boxes = jax.lax.dynamic_slice_in_dim(batch, coord_start, 4, axis=1)
+        order = jnp.argsort(-scores)
+        sorted_batch = batch[order]
+        sorted_scores = scores[order]
+        sorted_boxes = boxes[order]
+        sorted_ids = ids[order]
+        valid = sorted_scores > valid_thresh
+        if topk > 0:
+            valid = valid & (jnp.arange(n) < topk)
+        iou = box_iou(sorted_boxes, sorted_boxes)
+        same_class = (sorted_ids[:, None] == sorted_ids[None, :]) \
+            if (id_index >= 0 and not force_suppress) else jnp.ones((n, n), bool)
+
+        def body(i, keep):
+            sup = (iou[i] > overlap_thresh) & same_class[i] \
+                & (jnp.arange(n) > i) & keep[i] & valid
+            return keep & ~sup
+
+        keep = jax.lax.fori_loop(0, n, body, valid)
+        out_scores = jnp.where(keep, sorted_scores, -1.0)
+        return sorted_batch.at[:, score_index].set(out_scores)
+
+    if data.ndim == 2:
+        return one(data)
+    flat = data.reshape((-1,) + data.shape[-2:])
+    out = jax.vmap(one)(flat)
+    return out.reshape(data.shape)
+
+
+def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=2):
+    """ROI Align (≙ _contrib_ROIAlign, src/operator/contrib/roi_align.cc).
+
+    data (N, C, H, W); rois (R, 5) = [batch_idx, x1, y1, x2, y2] in image
+    coords. Returns (R, C, ph, pw). Bilinear sampling, avg over samples.
+    """
+    import jax
+    jnp = _jnp()
+    data = jnp.asarray(data)  # host arrays must not be indexed by tracers
+    rois = jnp.asarray(rois)
+    ph, pw = (pooled_size, pooled_size) if isinstance(pooled_size, int) \
+        else pooled_size
+    N, C, H, W = data.shape
+    s = sample_ratio if sample_ratio > 0 else 2
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: (ph*s, pw*s)
+        ys = y1 + (jnp.arange(ph * s) + 0.5) * (bin_h / s)
+        xs = x1 + (jnp.arange(pw * s) + 0.5) * (bin_w / s)
+        img = data[bidx]  # (C, H, W)
+        vals = _bilinear_sample(img, ys, xs)          # (C, ph*s, pw*s)
+        vals = vals.reshape(C, ph, s, pw, s)
+        return vals.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois)
+
+
+def _bilinear_sample(img, ys, xs):
+    """img (C, H, W); sample at the grid ys x xs with border clamping."""
+    jnp = _jnp()
+    C, H, W = img.shape
+    y = jnp.clip(ys, 0.0, H - 1.0)
+    x = jnp.clip(xs, 0.0, W - 1.0)
+    y0 = jnp.clip(jnp.floor(y).astype(jnp.int32), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x).astype(jnp.int32), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy = (y - y0)[:, None]
+    wx = (x - x0)[None, :]
+    v00 = img[:, y0][:, :, x0]
+    v01 = img[:, y0][:, :, x1]
+    v10 = img[:, y1][:, :, x0]
+    v11 = img[:, y1][:, :, x1]
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    return top * (1 - wy) + bot * wy
+
+
+def bilinear_resize2d(data, height, width, layout="NCHW"):
+    """≙ _contrib_BilinearResize2D (bilinear_resize.cc)."""
+    import jax
+    if layout == "NCHW":
+        shape = data.shape[:2] + (height, width)
+    else:
+        shape = (data.shape[0], height, width, data.shape[-1])
+    return jax.image.resize(data, shape, method="linear")

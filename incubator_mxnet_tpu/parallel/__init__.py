@@ -150,7 +150,16 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
     if coordinator_address is None:
         return  # single host
     num_processes = num_processes or get_env("MXNET_NUM_PROCESSES", typ=int)
-    process_id = process_id or get_env("MXNET_PROCESS_ID", typ=int)
+    process_id = process_id if process_id is not None \
+        else get_env("MXNET_PROCESS_ID", typ=int)
+    import os as _os
+    if _os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # multi-process CPU needs the gloo collectives backend to form one
+        # global device view (the DCN-emulation test path)
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
     jax.distributed.initialize(coordinator_address, num_processes, process_id)
 
 
@@ -296,17 +305,22 @@ def shard_map(fn, mesh, in_specs, out_specs, check_rep=False):
     (SyncBatchNorm) can detect their collective axes."""
     import jax
     from jax.sharding import PartitionSpec as P
-    try:
+    import inspect
+    _sm = getattr(jax, "shard_map", None)
+    if _sm is None:  # older jax
         from jax.experimental.shard_map import shard_map as _sm
-    except ImportError:  # newer jax
-        _sm = jax.shard_map
+    kw = {}
+    params = inspect.signature(_sm).parameters
+    if "check_rep" in params:
+        kw["check_rep"] = check_rep
+    elif "check_vma" in params:
+        kw["check_vma"] = check_rep
 
     names = tuple(mesh.axis_names if isinstance(mesh, Mesh)
                   else mesh.axis_names)
     jmesh = mesh.jax_mesh if isinstance(mesh, Mesh) else mesh
 
-    inner = _sm(fn, mesh=jmesh, in_specs=in_specs, out_specs=out_specs,
-                check_rep=check_rep)
+    inner = _sm(fn, mesh=jmesh, in_specs=in_specs, out_specs=out_specs, **kw)
 
     def wrapped(*args):
         with _axis_scope(list(names)):

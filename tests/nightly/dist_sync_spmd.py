@@ -1,0 +1,61 @@
+"""Multi-process SPMD correctness script (≙ tests/nightly/
+dist_sync_kvstore.py:66-101 — each worker pushes rank-dependent values, all
+assert the allreduced result).
+
+Launched by tools/launch.py (the reference's `--launcher local` pattern):
+
+    PYTHONPATH= python tools/launch.py -n 2 --env JAX_PLATFORMS=cpu \
+        --env PYTHONPATH= python tests/nightly/dist_sync_spmd.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import parallel
+
+    parallel.initialize()
+    rank, world = parallel.rank(), parallel.world_size()
+    assert world > 1, "run under tools/launch.py"
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("dp",))
+    # ≙ dist_sync push: every worker contributes (rank+1); expect sum
+    local = np.full((4,), float(rank + 1), np.float32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), local.reshape(1, 4), (world, 4))
+    total = jax.jit(lambda v: v.sum(axis=0),
+                    out_shardings=NamedSharding(mesh, P()))(arr)
+    expect = sum(r + 1 for r in range(world))
+    got = np.asarray(total.addressable_data(0))
+    np.testing.assert_allclose(got, np.full((4,), expect, np.float32))
+
+    # data-parallel gradient equivalence across processes
+    w = np.ones((4, 2), np.float32)
+    xs_local = np.full((2, 4), rank + 1.0, np.float32)
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp", None)), xs_local, (2 * world, 4))
+
+    def loss(w, x):
+        return jnp.sum((x @ w) ** 2)
+
+    g = jax.jit(jax.grad(loss),
+                out_shardings=NamedSharding(mesh, P()))(w, x)
+    x_all = np.concatenate([np.full((2, 4), r + 1.0, np.float32)
+                            for r in range(world)])
+    g_ref = 2 * x_all.T @ (x_all @ w)
+    np.testing.assert_allclose(np.asarray(g.addressable_data(0)), g_ref,
+                               rtol=1e-5)
+    print(f"rank {rank}/{world}: dist sync semantics OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

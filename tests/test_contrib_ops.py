@@ -1,0 +1,103 @@
+"""Contrib detection ops (≙ reference tests for bounding_box.cc / roi_align)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import npx
+
+
+def test_box_iou_known_values():
+    a = mx.np.array(np.array([[0, 0, 2, 2]], np.float32))
+    b = mx.np.array(np.array([[1, 1, 3, 3], [0, 0, 2, 2],
+                              [10, 10, 11, 11]], np.float32))
+    iou = npx.box_iou(a, b).asnumpy()
+    np.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], rtol=1e-5)
+
+
+def test_box_iou_center_format():
+    # both in center format: identical center boxes → IoU 1
+    a = mx.np.array(np.array([[1, 1, 2, 2]], np.float32))
+    b = mx.np.array(np.array([[1, 1, 2, 2], [2, 1, 2, 2]], np.float32))
+    iou = npx.box_iou(a, b, format="center").asnumpy()
+    np.testing.assert_allclose(iou[0], [1.0, 1 / 3], rtol=1e-5)
+
+
+def test_box_nms_suppression():
+    # [cls, score, x1, y1, x2, y2]
+    boxes = np.array([
+        [0, 0.9, 0, 0, 10, 10],
+        [0, 0.8, 1, 1, 10.5, 10.5],   # high overlap with first → suppressed
+        [0, 0.7, 20, 20, 30, 30],     # far away → kept
+        [1, 0.6, 0.5, 0.5, 10, 10],   # different class → kept (id-aware)
+    ], np.float32)
+    out = npx.box_nms(mx.np.array(boxes), overlap_thresh=0.5,
+                      id_index=0).asnumpy()
+    scores = out[:, 1]
+    assert scores[0] == pytest.approx(0.9)
+    assert scores[1] == -1.0
+    assert sorted(scores[scores > 0].tolist()) == \
+        pytest.approx([0.6, 0.7, 0.9])
+
+
+def test_box_nms_force_suppress():
+    boxes = np.array([
+        [0, 0.9, 0, 0, 10, 10],
+        [1, 0.8, 0.5, 0.5, 10, 10],
+    ], np.float32)
+    out = npx.box_nms(mx.np.array(boxes), overlap_thresh=0.5, id_index=0,
+                      force_suppress=True).asnumpy()
+    assert out[1, 1] == -1.0
+
+
+def test_box_nms_batched():
+    boxes = np.tile(np.array([[[0, 0.9, 0, 0, 10, 10],
+                               [0, 0.8, 1, 1, 10, 10]]], np.float32),
+                    (3, 1, 1))
+    out = npx.box_nms(mx.np.array(boxes), overlap_thresh=0.5).asnumpy()
+    assert out.shape == (3, 2, 6)
+    assert (out[:, 1, 1] == -1.0).all()
+
+
+def test_roi_align_matches_manual_bilinear():
+    """ROI over the whole image with 1x1 bins: each output samples the
+    bilinear value at (i+0.5, j+0.5), clamped at borders."""
+    data = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 4, 4]], np.float32)
+    out = npx.roi_align(mx.np.array(data), mx.np.array(rois),
+                        pooled_size=4, spatial_scale=1.0,
+                        sample_ratio=1).asnumpy()
+    img = data[0, 0]
+
+    def bil(y, x):
+        y = min(max(y, 0.0), 3.0)
+        x = min(max(x, 0.0), 3.0)
+        y0, x0 = int(np.floor(y)), int(np.floor(x))
+        y1, x1 = min(y0 + 1, 3), min(x0 + 1, 3)
+        wy, wx = y - y0, x - x0
+        return ((img[y0, x0] * (1 - wx) + img[y0, x1] * wx) * (1 - wy)
+                + (img[y1, x0] * (1 - wx) + img[y1, x1] * wx) * wy)
+
+    expected = np.array([[bil(i + 0.5, j + 0.5) for j in range(4)]
+                         for i in range(4)], np.float32)
+    np.testing.assert_allclose(out[0, 0], expected, rtol=1e-5)
+
+
+def test_roi_align_scale_and_grad():
+    import jax
+    from incubator_mxnet_tpu.ops import contrib as c
+    data = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 0, 16, 16], [1, 4, 4, 12, 12]], np.float32)
+    out = c.roi_align(data, rois, pooled_size=2, spatial_scale=0.5)
+    assert out.shape == (2, 3, 2, 2)
+    g = jax.grad(lambda d: c.roi_align(d, rois, 2, 0.5).sum())(data)
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).sum() > 0
+
+
+def test_bilinear_resize2d():
+    x = mx.np.array(np.random.randn(1, 3, 4, 4).astype(np.float32))
+    y = npx.bilinear_resize2d(x, 8, 8)
+    assert y.shape == (1, 3, 8, 8)
+    # corners preserved under linear resize up
+    np.testing.assert_allclose(y.asnumpy()[..., 0, 0], x.asnumpy()[..., 0, 0],
+                               rtol=1e-4)

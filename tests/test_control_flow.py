@@ -1,0 +1,188 @@
+"""Control flow ops + exception semantics (≙ reference
+tests/python/unittest/test_contrib_control_flow.py + test_exc_handling.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import npx
+
+
+def test_foreach_basic():
+    data = mx.np.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    init = mx.np.zeros((2,))
+
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    outs, final = npx.foreach(body, data, init)
+    np.testing.assert_allclose(final.asnumpy(), [6.0, 9.0])  # cumsum end
+    np.testing.assert_allclose(outs.asnumpy()[-1], [6.0, 9.0])
+    np.testing.assert_allclose(outs.asnumpy()[0], [0.0, 1.0])
+
+
+def test_foreach_multi_state():
+    data = mx.np.array(np.ones((4, 2), np.float32))
+    s0 = [mx.np.zeros((2,)), mx.np.ones((2,))]
+
+    def body(x, states):
+        a, b = states
+        return a + b, [a + x, b * 2]
+
+    outs, fin = npx.foreach(body, data, s0)
+    assert outs.shape == (4, 2)
+    np.testing.assert_allclose(fin[0].asnumpy(), [4.0, 4.0])
+    np.testing.assert_allclose(fin[1].asnumpy(), [16.0, 16.0])
+
+
+def test_foreach_grad():
+    """foreach is differentiable (lax.scan vjp) through the tape."""
+    data = mx.np.array(np.array([[1.0], [2.0], [3.0]], np.float32))
+    data.attach_grad()
+
+    def body(x, state):
+        new = state * x
+        return new, new
+
+    with mx.autograd.record():
+        outs, final = npx.foreach(body, data, mx.np.ones((1,)))
+        loss = final.sum()  # = prod(data)
+    loss.backward()
+    # d(prod)/dx_i = prod / x_i
+    np.testing.assert_allclose(data.grad.asnumpy().ravel(),
+                               [6.0, 3.0, 2.0], rtol=1e-5)
+
+
+def test_while_loop():
+    def cond(i, s):
+        return i < 5
+
+    def body(i, s):
+        return i + 1, s * 2
+
+    _, (i_fin, s_fin) = npx.while_loop(
+        cond, body, [mx.np.array(0.0), mx.np.array(1.0)])
+    assert float(i_fin.asnumpy()) == 5.0
+    assert float(s_fin.asnumpy()) == 32.0
+
+
+def test_cond():
+    x = mx.np.array(np.array([2.0], np.float32))
+    out = npx.cond(mx.np.array(True), lambda v: v * 10, lambda v: v - 10,
+                   inputs=x)
+    np.testing.assert_allclose(out.asnumpy(), [20.0])
+    out = npx.cond(mx.np.array(False), lambda v: v * 10, lambda v: v - 10,
+                   inputs=x)
+    np.testing.assert_allclose(out.asnumpy(), [-8.0])
+
+
+def test_cond_grad():
+    x = mx.np.array(np.array([3.0], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = npx.cond(mx.np.array(True), lambda v: v * v, lambda v: v,
+                     inputs=x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_foreach_in_hybrid_block():
+    """Control flow inside a hybridized block compiles into the cached op."""
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon import nn
+
+    class CumulativeNet(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.proj = nn.Dense(4, in_units=4)
+
+        def forward(self, seq):
+            def body(x, state):
+                new = state + self.proj(x)
+                return new, new
+            outs, final = npx.foreach(body, seq, mx.np.zeros((2, 4)))
+            return final
+
+    net = CumulativeNet()
+    net.initialize()
+    seq = mx.np.array(np.random.randn(5, 2, 4).astype(np.float32))
+    ref = net(seq).asnumpy()
+    net.hybridize()
+    got = net(seq).asnumpy()
+    got2 = net(seq).asnumpy()  # cached path
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ref, got2, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# error semantics (≙ test_exc_handling.py: typed errors surface in python)
+# ---------------------------------------------------------------------------
+def test_error_hierarchy():
+    assert issubclass(mx.MXNetError, RuntimeError)
+    from incubator_mxnet_tpu.base import ValueError_, TypeError_
+    assert issubclass(ValueError_, ValueError)
+    assert issubclass(ValueError_, mx.MXNetError)
+    assert issubclass(TypeError_, TypeError)
+
+
+def test_shape_error_surfaces():
+    a = mx.np.ones((2, 3))
+    b = mx.np.ones((4, 5))
+    with pytest.raises(Exception):
+        (a @ b).wait_to_read()
+
+
+def test_ambiguous_truth_raises():
+    with pytest.raises(mx.MXNetError):
+        bool(mx.np.ones((2, 2)))
+
+
+def test_backward_without_record_raises():
+    x = mx.np.ones((2,))
+    x.attach_grad()
+    y = x * 2  # not recorded
+    with pytest.raises(mx.MXNetError):
+        y.backward()
+
+
+def test_unknown_optimizer_metric_initializer():
+    with pytest.raises(mx.MXNetError):
+        mx.optimizer.create("definitely_not_real")
+    with pytest.raises(mx.MXNetError):
+        mx.metric.create("definitely_not_real")
+    from incubator_mxnet_tpu import initializer
+    with pytest.raises(mx.MXNetError):
+        initializer.create("definitely_not_real")
+
+
+def test_sync_batchnorm_cross_device_stats():
+    """SyncBatchNorm inside shard_map reduces batch stats over dp
+    (≙ contrib SyncBatchNorm's cross-device barrier semantics)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from incubator_mxnet_tpu import parallel
+    from incubator_mxnet_tpu.ops import nn as _nn
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 4)).astype(np.float32) * 3 + 1
+    gamma = np.ones(4, np.float32)
+    beta = np.zeros(4, np.float32)
+    rm = np.zeros(4, np.float32)
+    rv = np.ones(4, np.float32)
+
+    mesh = parallel.Mesh({"dp": 8})
+
+    def fn(xs):
+        out, nm, nv = _nn.batch_norm(xs, gamma, beta, rm, rv, training=True,
+                                     axis=-1, sync_axis_name="dp")
+        return out
+
+    f = parallel.shard_map(fn, mesh, in_specs=P("dp", None),
+                           out_specs=P("dp", None))
+    with mesh:
+        out_sync = np.asarray(jax.jit(f)(x))
+    # synced BN over the full batch == single-device BN on the whole batch
+    ref, _, _ = _nn.batch_norm(x, gamma, beta, rm, rv, training=True, axis=-1)
+    np.testing.assert_allclose(out_sync, np.asarray(ref), rtol=2e-4,
+                               atol=2e-4)

@@ -221,3 +221,25 @@ def test_pipeline_parallel_differentiable():
     g_ref = jax.grad(ref_loss)(Ws)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=2e-3,
                                atol=2e-4)
+
+
+def test_multiprocess_dist_sync_launcher():
+    """Spawn 2 real processes via tools/launch.py and check dist-sync
+    semantics (≙ the reference's nightly --launcher local kvstore test)."""
+    import subprocess
+    import sys
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""  # skip the axon sitecustomize: it pre-inits PJRT
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"), "-n", "2",
+         "--env", "JAX_PLATFORMS=cpu", "--env", "PYTHONPATH=",
+         sys.executable, os.path.join(repo, "tests", "nightly",
+                                      "dist_sync_spmd.py")],
+        env=env, capture_output=True, text=True, timeout=240)
+    ok = proc.stdout.count("dist sync semantics OK")
+    assert proc.returncode == 0 and ok == 2, (proc.stdout[-2000:],
+                                              proc.stderr[-2000:])

@@ -107,3 +107,107 @@ def test_compression_convergence_preserved():
         true_sum += g
         sent_sum += gc.compress("k", mx.np.array(g)).asnumpy()
     np.testing.assert_allclose(sent_sum, true_sum, atol=0.25)
+
+
+def test_int8_dense_flatten_false_3d():
+    """Regression: Int8Dense must contract the LAST axis like fp32 dense."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(6, in_units=5, flatten=False))
+    net.initialize()
+    x = mx.np.array(np.random.randn(2, 3, 5).astype(np.float32))
+    ref = net(x).asnumpy()
+    q.quantize_net(net)
+    got = net(x).asnumpy()
+    assert got.shape == ref.shape == (2, 3, 6)
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05
+
+
+def test_int8_conv_nhwc_bias():
+    """Regression: Int8Conv2D bias must follow the layout's channel axis."""
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1, in_channels=2, layout="NHWC"))
+    net.initialize()
+    x = mx.np.array(np.random.randn(1, 8, 8, 2).astype(np.float32))
+    ref = net(x).asnumpy()
+    q.quantize_net(net)
+    got = net(x).asnumpy()
+    assert got.shape == ref.shape
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05
+
+
+def test_entropy_calibration_range_growth():
+    """Regression: growing amax across batches must rebin, not mix ranges."""
+    c = q.CalibrationCollector(mode="entropy", num_bins=64)
+    hook = c._make_hook("l")
+    hook(None, (mx.np.array(np.random.uniform(0, 1, 1000).astype(np.float32)),), None)
+    hook(None, (mx.np.array(np.random.uniform(0, 10, 1000).astype(np.float32)),), None)
+    st = c.stats["l"]
+    assert st["amax"] == pytest.approx(10.0, rel=0.01)
+    assert st["hist"].sum() == pytest.approx(2000, abs=2)
+    thr = c.threshold("l")
+    assert 0 < thr <= 10.0
+
+
+def test_quantize_net_hybridized():
+    """Regression: quantize_net must work on hybridized nets (calibration
+    bypasses the cached graph; int8 layers trace cleanly under jit)."""
+    from incubator_mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8), nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    x = mx.np.array(np.random.randn(4, 8).astype(np.float32))
+    ref = net(x).asnumpy()  # build the cache first
+    calib = DataLoader(ArrayDataset(x.asnumpy()), batch_size=4)
+    q.quantize_net(net, calib_data=calib)
+    got = net(x).asnumpy()
+    got2 = net(x).asnumpy()  # second call exercises the re-built cache
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+    np.testing.assert_allclose(got, got2, rtol=1e-6)
+
+
+def test_custom_op_sees_is_train():
+    from incubator_mxnet_tpu import operator as op_mod
+    seen = []
+
+    class Probe(op_mod.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            seen.append(is_train)
+            self.assign(out_data[0], req[0], in_data[0])
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0], out_grad[0])
+
+    @op_mod.register("probe_train")
+    class ProbeProp(op_mod.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            return Probe()
+
+    x = mx.np.ones((2,))
+    op_mod.invoke("probe_train", x)
+    with mx.autograd.record():
+        op_mod.invoke("probe_train", x)
+    assert seen == [False, True]
+
+
+def test_trainer_compression_without_kvstore_raises():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          compression_params={"type": "2bit",
+                                              "threshold": 0.5})
+    x = mx.np.ones((2, 2))
+    with mx.autograd.record():
+        net(x).sum().backward()
+    with pytest.raises(mx.MXNetError):
+        tr.step(2)
+
+
+def test_requantize_uses_calibrated_range():
+    q32 = mx.np.array(np.array([2 ** 30, -(2 ** 30)], np.int64).astype(np.int32))
+    q8, mn, mxr = q.requantize(q32, -4.0, 4.0)
+    # 2^30 = half of int32 range → half of the calibrated range → ~64
+    np.testing.assert_allclose(q8.asnumpy(), [64, -64], atol=1)
