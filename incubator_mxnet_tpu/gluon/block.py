@@ -467,15 +467,44 @@ class HybridBlock(Block):
         self.hybridize(True)
         self(x, *args)
 
-    def export(self, path, epoch=0, remove_amp_cast=True):
-        """Serialize compiled graph + params (≙ HybridBlock.export,
-        block.py:1471). Saves params (.npz) + the StableHLO text of the
-        forward computation for inference deployment."""
+    def export(self, path, epoch=0, remove_amp_cast=True,
+               example_inputs=None):
+        """Serialize the compiled graph + params (≙ HybridBlock.export,
+        block.py:1471: model-symbol.json + model-0000.params).
+
+        Always saves `<path>-<epoch>.params.npz` (weights by structural
+        name); when `example_inputs` are given, additionally saves
+        `<path>-<epoch>.stablehlo.mlir` — the StableHLO module of the
+        inference forward, the portable deployment artifact replacing the
+        nnvm symbol JSON. Returns the tuple of file paths written."""
         import jax
         from ..ndarray import NDArray
-        params = [p for _, p in sorted(self.collect_params().items())]
-        self.save_parameters(f"{path}-{epoch:04d}.params.npz")
-        return f"{path}-{epoch:04d}.params.npz"
+        params_file = f"{path}-{epoch:04d}.params.npz"
+        self.save_parameters(params_file)
+        outputs = [params_file]
+        if example_inputs is not None:
+            if not isinstance(example_inputs, (list, tuple)):
+                example_inputs = (example_inputs,)
+            if self._cached_params is None:
+                self._cached_params = [p for _, p in
+                                       sorted(self.collect_params().items())]
+            params = self._cached_params
+            cached = self._cached_graph.get(False)
+            if cached is None:
+                cached = self._build_cache(False)
+                self._cached_graph[False] = cached
+            jit_fn, meta = cached
+            pbufs = tuple(p.data()._arr for p in params)
+            in_raw = tuple(a._arr if isinstance(a, NDArray) else a
+                           for a in example_inputs)
+            # constant key: export must not advance the global RNG stream
+            dummy_key = jax.random.PRNGKey(0)
+            lowered = jit_fn.lower(pbufs, dummy_key, *in_raw)
+            hlo_file = f"{path}-{epoch:04d}.stablehlo.mlir"
+            with open(hlo_file, "w") as f:
+                f.write(lowered.as_text(dialect="stablehlo"))
+            outputs.append(hlo_file)
+        return tuple(outputs)
 
     def forward(self, *args):
         raise NotImplementedError

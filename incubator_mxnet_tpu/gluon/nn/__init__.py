@@ -735,3 +735,10 @@ class ReflectionPad2D(HybridBlock):
         p = self._padding
         return mxnp.pad(x, ((0, 0), (0, 0), (p[2], p[3]), (p[0], p[1])),
                         mode="reflect")
+
+
+from .transformer import (MultiHeadAttention, TransformerEncoderCell,
+                          TransformerDecoderCell, PositionalEmbedding)
+
+__all__ += ["MultiHeadAttention", "TransformerEncoderCell",
+            "TransformerDecoderCell", "PositionalEmbedding"]

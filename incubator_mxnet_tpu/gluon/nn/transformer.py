@@ -1,0 +1,156 @@
+"""Transformer layers for gluon (MultiHeadAttention, encoder/decoder cells).
+
+Reference: the fused attention kernels GluonNLP's BERT rides on —
+`_contrib_interleaved_matmul_selfatt_qk/valatt` (src/operator/contrib/
+transformer.cc:676-869) and sliding-window attention (:888-1096). TPU-native:
+one `scaled_dot_product_attention` composition that XLA fuses onto the MXU;
+long sequences swap in the Pallas flash kernel (use_flash=True) and
+sequence-parallel meshes use parallel.ring_attention.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from ...base import MXNetError
+from ... import numpy as mxnp
+from ... import numpy_extension as npx
+from ..block import HybridBlock
+from ..parameter import Parameter
+from . import Dense, Dropout, LayerNorm
+
+__all__ = ["MultiHeadAttention", "TransformerEncoderCell",
+           "TransformerDecoderCell", "PositionalEmbedding"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Multi-head attention (≙ the interleaved_matmul_selfatt op pair).
+
+    Inputs (batch, seq, units); separate q/k/v projections + output proj.
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 use_flash=False):
+        super().__init__()
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by heads "
+                             f"{num_heads}")
+        self._units = units
+        self._heads = num_heads
+        self._use_flash = use_flash
+        self.query_proj = Dense(units, use_bias=use_bias, flatten=False,
+                                in_units=units)
+        self.key_proj = Dense(units, use_bias=use_bias, flatten=False,
+                              in_units=units)
+        self.value_proj = Dense(units, use_bias=use_bias, flatten=False,
+                                in_units=units)
+        self.out_proj = Dense(units, use_bias=use_bias, flatten=False,
+                              in_units=units)
+        self.dropout = Dropout(dropout) if dropout > 0 else None
+
+    def _split(self, x):
+        b, t, _ = x.shape
+        return x.reshape((b, t, self._heads, -1)).transpose((0, 2, 1, 3))
+
+    def forward(self, query, key=None, value=None, mask=None, causal=False):
+        key = query if key is None else key
+        value = key if value is None else value
+        q = self._split(self.query_proj(query))
+        k = self._split(self.key_proj(key))
+        v = self._split(self.value_proj(value))
+        if self._use_flash and mask is None:
+            from ...ops.pallas_attention import flash_attention
+            from ...ops.registry import invoke
+            b, h, t, d = q.shape
+            causal_ = causal
+
+            def f(qr, kr, vr):
+                o = flash_attention(qr.reshape(b * h, t, d),
+                                    kr.reshape(b * h, -1, d),
+                                    vr.reshape(b * h, -1, d), causal=causal_)
+                return o.reshape(b, h, t, d)
+            out = invoke(f, (q, k, v), name="flash_attention")
+        else:
+            out = npx.scaled_dot_product_attention(q, k, v, mask=mask,
+                                                   causal=causal)
+        b, h, t, d = out.shape
+        out = out.transpose((0, 2, 1, 3)).reshape((b, t, self._units))
+        out = self.out_proj(out)
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Pre-norm transformer encoder layer (attention + FFN)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.1,
+                 activation="gelu", use_flash=False):
+        super().__init__()
+        self.attention = MultiHeadAttention(units, num_heads, dropout,
+                                            use_flash=use_flash)
+        self.ln1 = LayerNorm(in_channels=units)
+        self.ln2 = LayerNorm(in_channels=units)
+        if activation not in ("relu", "gelu"):
+            raise MXNetError(f"unsupported activation {activation!r} "
+                             "(relu|gelu)")
+        self.ffn1 = Dense(hidden_size, flatten=False, in_units=units)
+        self.ffn2 = Dense(units, flatten=False, in_units=hidden_size)
+        self._act = activation
+        self.dropout = Dropout(dropout) if dropout > 0 else None
+
+    def forward(self, x, mask=None):
+        h = self.ln1(x)
+        x = x + self.attention(h, mask=mask)
+        h = self.ln2(x)
+        h = npx.activation(self.ffn1(h), act_type="relu") \
+            if self._act == "relu" else npx.gelu(self.ffn1(h))
+        h = self.ffn2(h)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return x + h
+
+
+class TransformerDecoderCell(HybridBlock):
+    """Pre-norm decoder layer: causal self-attn + cross-attn + FFN."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.1,
+                 use_flash=False):
+        super().__init__()
+        self.self_attention = MultiHeadAttention(units, num_heads, dropout,
+                                                 use_flash=use_flash)
+        self.cross_attention = MultiHeadAttention(units, num_heads, dropout)
+        self.ln1 = LayerNorm(in_channels=units)
+        self.ln2 = LayerNorm(in_channels=units)
+        self.ln3 = LayerNorm(in_channels=units)
+        self.ffn1 = Dense(hidden_size, flatten=False, in_units=units)
+        self.ffn2 = Dense(units, flatten=False, in_units=hidden_size)
+        self.dropout = Dropout(dropout) if dropout > 0 else None
+
+    def forward(self, x, memory, mem_mask=None):
+        x = x + self.self_attention(self.ln1(x), causal=True)
+        x = x + self.cross_attention(self.ln2(x), memory, memory,
+                                     mask=mem_mask)
+        h = npx.gelu(self.ffn1(self.ln3(x)))
+        h = self.ffn2(h)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return x + h
+
+
+class PositionalEmbedding(HybridBlock):
+    """Learned positional embedding (BERT-style)."""
+
+    def __init__(self, max_length, units):
+        super().__init__()
+        self._max_length = max_length
+        self.weight = Parameter(shape=(max_length, units), init="normal",
+                                name="weight")
+
+    def forward(self, x):
+        t = x.shape[1]
+        if t > self._max_length:
+            raise MXNetError(f"sequence length {t} exceeds max_length "
+                             f"{self._max_length}")
+        return x + self.weight.data()[:t].reshape((1, t, -1))

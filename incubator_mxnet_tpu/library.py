@@ -1,0 +1,46 @@
+"""mx.library — extension loading (≙ python/mxnet/library.py MXLoadLib +
+include/mxnet/lib_api.h custom-op ABI).
+
+The reference loads a compiled .so implementing the 1.3k-LoC C ABI. The
+TPU-native extension unit is a PYTHON module (jax kernels are Python-level;
+there is no stable C kernel ABI to target): `load(path)` imports the file
+and calls its `register_ops(mx)` hook, which registers custom ops
+(mx.operator.register), kvstores (KVStoreBase.register), optimizers
+(mx.optimizer.register) or metrics — the same extension points the
+reference exposes through lib_api.h.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+from .base import MXNetError
+
+__all__ = ["load"]
+
+_loaded = {}
+
+
+def load(path, verbose=True):
+    """Load an extension module and run its register hook."""
+    path = os.path.abspath(path)
+    if path in _loaded:
+        return _loaded[path]
+    if not os.path.exists(path):
+        raise MXNetError(f"extension not found: {path}")
+    if path.endswith(".so"):
+        raise MXNetError(
+            "binary lib_api.so extensions target the CUDA runtime ABI and "
+            "cannot run on this stack; port the extension to a python module "
+            "with a register_ops(mx) hook (see mx.library docs)")
+    spec = importlib.util.spec_from_file_location(
+        f"mx_ext_{os.path.basename(path).removesuffix('.py')}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    if hasattr(mod, "register_ops"):
+        import incubator_mxnet_tpu as mx
+        mod.register_ops(mx)
+    _loaded[path] = mod
+    if verbose:
+        print(f"loaded extension {path}")
+    return mod

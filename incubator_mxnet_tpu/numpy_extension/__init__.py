@@ -414,3 +414,46 @@ def load(fname):
 def save(fname, data):
     from ..ndarray import save as _save
     return _save(fname, data)
+
+
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0):
+    """≙ SequenceLast (src/operator/sequence_last.cc)."""
+    data = _as_nd(data)
+    if not use_sequence_length or sequence_length is None:
+        idx = data.shape[axis] - 1
+        return invoke(lambda x: _jnp.take(x, idx, axis=axis), (data,),
+                      name="sequence_last")
+
+    def f(x, lens):
+        jnp = _jnp
+        t = jnp.clip(lens.astype(jnp.int32) - 1, 0, x.shape[axis] - 1)
+        moved = jnp.moveaxis(x, axis, 0)        # (T, N, ...)
+        return jnp.take_along_axis(
+            moved, t.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0)[0]
+    return invoke(f, (data, _as_nd(sequence_length)), name="sequence_last")
+
+
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0):
+    """≙ SequenceReverse (src/operator/sequence_reverse.cc)."""
+    data = _as_nd(data)
+    if not use_sequence_length or sequence_length is None:
+        return invoke(lambda x: _jnp.flip(x, axis=axis), (data,),
+                      name="sequence_reverse")
+
+    def f(x, lens):
+        jnp = _jnp
+        moved = jnp.moveaxis(x, axis, 0)        # (T, N, ...)
+        T = moved.shape[0]
+        t_idx = jnp.arange(T)[:, None]          # (T, 1)
+        lens_i = lens.astype(jnp.int32)[None, :]
+        rev = jnp.where(t_idx < lens_i, lens_i - 1 - t_idx, t_idx)
+        out = jnp.take_along_axis(
+            moved, rev.reshape(rev.shape + (1,) * (moved.ndim - 2)), axis=0)
+        return jnp.moveaxis(out, 0, axis)
+    return invoke(f, (data, _as_nd(sequence_length)), name="sequence_reverse")
+
+
+__all__ += ["sequence_last", "sequence_reverse", "box_iou", "box_nms",
+            "roi_align", "bilinear_resize2d"]

@@ -28,7 +28,7 @@ _NEG_INF = -1e30
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale,
-            causal, block_q, block_k, nk):
+            causal, block_q, block_k, nk, causal_offset=0):
     import jax
     import jax.numpy as jnp
     import jax.experimental.pallas as pl
@@ -49,7 +49,9 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            # end-aligned (≙ tril with k = tk - tq): query i attends keys
+            # up to i + (tk - tq)
+            q_pos = qi * block_q + causal_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -66,8 +68,8 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale,
         m_ref[:] = m_new
 
     if causal:
-        # skip fully-masked k blocks (block above the diagonal)
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        # skip fully-masked k blocks (block entirely above the diagonal)
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1 + causal_offset)
         def _():
             _compute()
     else:
@@ -78,6 +80,51 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale,
         import jax.numpy as jnp
         denom = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _blockwise(q, k, v, scale, causal, block_k=512):
+    """Differentiable blockwise attention: lax.scan over k blocks with
+    online-softmax merging. Same math as the Pallas kernel, O(T·block_k)
+    memory in BOTH directions (jax AD through scan recomputes per block) —
+    this is the training path backing flash_attention's custom_vjp."""
+    import jax
+    import jax.numpy as jnp
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_k = min(block_k, tk)
+    if tk % block_k:
+        return _reference(q, k, v, scale, causal)
+    nk = tk // block_k
+    kb = k.reshape(bh, nk, block_k, d).transpose(1, 0, 2, 3)
+    vb = v.reshape(bh, nk, block_k, d).transpose(1, 0, 2, 3)
+    q32 = q.astype(jnp.float32)
+    q_pos = jnp.arange(tq)[:, None] + (tk - tq)  # end-aligned causal
+
+    def step(carry, blk):
+        m_run, l_run, acc = carry
+        k_cur, v_cur, j = blk
+        s = jnp.einsum("bqd,bkd->bqk", q32, k_cur.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = j * block_k + jnp.arange(block_k)[None, :]
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_run, m_blk)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_run - m_new)
+        l_new = alpha * l_run + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jnp.einsum(
+            "bqk,bkd->bqd", p, v_cur.astype(jnp.float32))
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((bh, tq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bh, tq, 1), jnp.float32)
+    acc0 = jnp.zeros((bh, tq, d), jnp.float32)
+    # remat: without it, AD through the scan saves the (bh, tq, block_k)
+    # probabilities of every step — O(tq*tk), defeating blockwise memory
+    (m, l, acc), _ = jax.lax.scan(
+        jax.checkpoint(step), (m0, l0, acc0), (kb, vb, jnp.arange(nk)))
+    denom = jnp.where(l == 0.0, 1.0, l)
+    return (acc / denom).astype(q.dtype)
 
 
 def _reference(q, k, v, scale, causal):
@@ -93,13 +140,8 @@ def _reference(q, k, v, scale, causal):
     return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
-                    block_k=512, interpret=False):
-    """Blockwise attention. q: (bh, Tq, d), k/v: (bh, Tk, d) raw jax arrays.
-
-    Uses the Pallas kernel on TPU (or interpret=True anywhere); falls back
-    to the fused-einsum composition on other backends.
-    """
+def _flash_forward_kernel(q, k, v, causal, scale, block_q, block_k,
+                          interpret):
     import jax
     import jax.numpy as jnp
     import jax.experimental.pallas as pl
@@ -107,24 +149,12 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
 
     bh, tq, d = q.shape
     tk = k.shape[1]
-    if scale is None:
-        scale = 1.0 / math.sqrt(d)
-
-    on_tpu = any(dev.platform != "cpu" for dev in jax.devices())
-    if not (on_tpu or interpret):
-        return _reference(q, k, v, scale, causal)
-
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
-    if tq % block_q or tk % block_k:
-        # ragged tails: fall back (padding support comes with masked loads)
-        return _reference(q, k, v, scale, causal)
     nq = tq // block_q
     nk = tk // block_k
-
     grid = (bh, nq, nk)
     kernel = functools.partial(_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k, nk=nk)
+                               block_q=block_q, block_k=block_k, nk=nk,
+                               causal_offset=tk - tq)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -142,3 +172,48 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
         ],
         interpret=interpret,
     )(q, k, v)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
+                    block_k=512, interpret=False):
+    """Blockwise attention. q: (bh, Tq, d), k/v: (bh, Tk, d) raw jax arrays.
+
+    Forward uses the Pallas kernel on TPU (or interpret=True anywhere);
+    reverse-mode AD routes through a custom_vjp whose backward differentiates
+    the blockwise lax.scan formulation — O(T·block) memory both ways.
+    Falls back to the einsum composition off-TPU / on ragged shapes.
+    """
+    import jax
+
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    on_tpu = any(dev.platform != "cpu" for dev in jax.devices())
+    if not (on_tpu or interpret):
+        return _blockwise(q, k, v, scale, causal, block_k)
+
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        # ragged tails: fall back (padding support comes with masked loads)
+        return _reference(q, k, v, scale, causal)
+
+    @jax.custom_vjp
+    def _fa(q, k, v):
+        return _flash_forward_kernel(q, k, v, causal, scale, block_q,
+                                     block_k, interpret)
+
+    def _fa_fwd(q, k, v):
+        return _fa(q, k, v), (q, k, v)
+
+    def _fa_bwd(res, ct):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda a, b, c: _blockwise(a, b, c, scale, causal, block_k),
+            q, k, v)
+        return vjp(ct)
+
+    _fa.defvjp(_fa_fwd, _fa_bwd)
+    return _fa(q, k, v)

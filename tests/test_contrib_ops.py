@@ -101,3 +101,43 @@ def test_bilinear_resize2d():
     # corners preserved under linear resize up
     np.testing.assert_allclose(y.asnumpy()[..., 0, 0], x.asnumpy()[..., 0, 0],
                                rtol=1e-4)
+
+
+def test_sequence_last_and_reverse():
+    x = np.arange(24, dtype=np.float32).reshape(4, 3, 2)  # (T, N, C)
+    lens = mx.np.array(np.array([2, 4, 1], np.float32))
+    last = npx.sequence_last(mx.np.array(x), lens, use_sequence_length=True)
+    expect = np.stack([x[1, 0], x[3, 1], x[0, 2]])
+    np.testing.assert_array_equal(last.asnumpy(), expect)
+
+    rev = npx.sequence_reverse(mx.np.array(x), lens, use_sequence_length=True)
+    r = rev.asnumpy()
+    np.testing.assert_array_equal(r[0, 0], x[1, 0])   # within len: reversed
+    np.testing.assert_array_equal(r[2, 0], x[2, 0])   # beyond len: untouched
+    np.testing.assert_array_equal(r[:, 1], x[::-1, 1])  # full reverse
+
+    plain = npx.sequence_reverse(mx.np.array(x))
+    np.testing.assert_array_equal(plain.asnumpy(), x[::-1])
+
+
+def test_library_extension(tmp_path):
+    ext = tmp_path / "my_ext.py"
+    ext.write_text('''
+def register_ops(mx):
+    import incubator_mxnet_tpu.operator as op_mod
+
+    class Twice(op_mod.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], in_data[0] * 2)
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0], out_grad[0] * 2)
+
+    @op_mod.register("twice_ext")
+    class TwiceProp(op_mod.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            return Twice()
+''')
+    from incubator_mxnet_tpu import library, operator as op_mod
+    library.load(str(ext), verbose=False)
+    out = op_mod.invoke("twice_ext", mx.np.array(np.array([3.0], np.float32)))
+    np.testing.assert_allclose(out.asnumpy(), [6.0])

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Pack an image folder into RecordIO (≙ tools/im2rec.py).
+
+    python tools/im2rec.py prefix image_root [--resize N] [--quality Q]
+
+Produces prefix.rec + prefix.idx + prefix.lst readable by
+ImageRecordDataset / the native reader. Requires PIL for encoding.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--quality", type=int, default=95)
+    args = ap.parse_args()
+
+    from incubator_mxnet_tpu import recordio
+    try:
+        from PIL import Image
+    except ImportError:
+        sys.exit("im2rec needs PIL for image encoding")
+    import io as _io
+
+    exts = (".jpg", ".jpeg", ".png", ".bmp")
+    items = []
+    classes = sorted(d for d in os.listdir(args.root)
+                     if os.path.isdir(os.path.join(args.root, d)))
+    for label, cls in enumerate(classes):
+        folder = os.path.join(args.root, cls)
+        for fname in sorted(os.listdir(folder)):
+            if fname.lower().endswith(exts):
+                items.append((os.path.join(folder, fname), label))
+
+    writer = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                        args.prefix + ".rec", "w")
+    with open(args.prefix + ".lst", "w") as lst:
+        for i, (path, label) in enumerate(items):
+            img = Image.open(path).convert("RGB")
+            if args.resize:
+                w, h = img.size
+                s = args.resize / min(w, h)
+                img = img.resize((int(w * s), int(h * s)))
+            buf = _io.BytesIO()
+            img.save(buf, format="JPEG", quality=args.quality)
+            header = recordio.IRHeader(0, float(label), i, 0)
+            writer.write_idx(i, recordio.pack(header, buf.getvalue()))
+            lst.write(f"{i}\t{label}\t{path}\n")
+    writer.close()
+    print(f"packed {len(items)} images, {len(classes)} classes -> "
+          f"{args.prefix}.rec")
+
+
+if __name__ == "__main__":
+    main()
