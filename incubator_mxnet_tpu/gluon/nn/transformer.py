@@ -128,8 +128,9 @@ class TransformerDecoderCell(HybridBlock):
         self.ffn2 = Dense(units, flatten=False, in_units=hidden_size)
         self.dropout = Dropout(dropout) if dropout > 0 else None
 
-    def forward(self, x, memory, mem_mask=None):
-        x = x + self.self_attention(self.ln1(x), causal=True)
+    def forward(self, x, memory, mem_mask=None, self_mask=None):
+        # self_mask excludes padded target positions (combined with causal)
+        x = x + self.self_attention(self.ln1(x), mask=self_mask, causal=True)
         x = x + self.cross_attention(self.ln2(x), memory, memory,
                                      mask=mem_mask)
         h = npx.gelu(self.ffn1(self.ln3(x)))

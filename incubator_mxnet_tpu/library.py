@@ -35,8 +35,16 @@ def load(path, verbose=True):
             "with a register_ops(mx) hook (see mx.library docs)")
     spec = importlib.util.spec_from_file_location(
         f"mx_ext_{os.path.basename(path).removesuffix('.py')}", path)
+    if spec is None or spec.loader is None:
+        raise MXNetError(f"not a loadable python extension: {path}")
     mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    import sys
+    sys.modules[spec.name] = mod  # required before exec (enables pickling)
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(spec.name, None)
+        raise
     if hasattr(mod, "register_ops"):
         import incubator_mxnet_tpu as mx
         mod.register_ops(mx)

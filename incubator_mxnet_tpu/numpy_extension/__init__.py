@@ -49,6 +49,10 @@ def _make_nn(fname, name=None):
     def fn(*arrays, **kwargs):
         arrs = tuple(_as_nd(a) if not isinstance(a, NDArray) else a
                      for a in arrays)
+        # array-valued kwargs (masks, lengths) close over as raw buffers —
+        # they are op attributes, not differentiated inputs
+        kwargs = {k: (v._arr if isinstance(v, NDArray) else v)
+                  for k, v in kwargs.items()}
         return invoke(functools.partial(f, **kwargs) if kwargs else f,
                       arrs, name=name or fname)
     fn.__name__ = name or fname

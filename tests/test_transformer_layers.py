@@ -110,3 +110,23 @@ def test_export_stablehlo(tmp_path):
     assert os.path.exists(hlo_file)
     text = open(hlo_file).read()
     assert "stablehlo" in text and "dot_general" in text
+
+
+def test_decoder_self_mask():
+    """Regression: decoder accepts a padding self-mask combined with causal;
+    NDArray kwargs to npx ops unwrap correctly."""
+    cell = nn.TransformerDecoderCell(16, 32, 2, dropout=0.0)
+    cell.initialize()
+    x = mx.np.array(np.random.randn(1, 4, 16).astype(np.float32))
+    mem = mx.np.array(np.random.randn(1, 6, 16).astype(np.float32))
+    mask = mx.np.array(np.ones((4, 4), bool))
+    out = cell(x, mem, self_mask=mask)
+    assert out.shape == (1, 4, 16)
+    # padding mask actually masks: zero out last position for all queries
+    pad_mask = np.ones((4, 4), bool)
+    pad_mask[:, 3] = False
+    out_masked = cell(x, mem, self_mask=mx.np.array(pad_mask)).asnumpy()
+    # first rows (which never attended pos 3 due to causal) are unchanged
+    np.testing.assert_allclose(out.asnumpy()[:, :3], out_masked[:, :3],
+                               rtol=1e-5, atol=1e-6)
+    assert not np.allclose(out.asnumpy()[:, 3], out_masked[:, 3])

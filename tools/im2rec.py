@@ -41,21 +41,30 @@ def main():
 
     writer = recordio.MXIndexedRecordIO(args.prefix + ".idx",
                                         args.prefix + ".rec", "w")
-    with open(args.prefix + ".lst", "w") as lst:
-        for i, (path, label) in enumerate(items):
-            img = Image.open(path).convert("RGB")
-            if args.resize:
-                w, h = img.size
-                s = args.resize / min(w, h)
-                img = img.resize((int(w * s), int(h * s)))
-            buf = _io.BytesIO()
-            img.save(buf, format="JPEG", quality=args.quality)
-            header = recordio.IRHeader(0, float(label), i, 0)
-            writer.write_idx(i, recordio.pack(header, buf.getvalue()))
-            lst.write(f"{i}\t{label}\t{path}\n")
-    writer.close()
-    print(f"packed {len(items)} images, {len(classes)} classes -> "
-          f"{args.prefix}.rec")
+    packed = skipped = 0
+    try:
+        with open(args.prefix + ".lst", "w") as lst:
+            for path, label in items:
+                try:
+                    img = Image.open(path).convert("RGB")
+                    if args.resize:
+                        w, h = img.size
+                        s = args.resize / min(w, h)
+                        img = img.resize((int(w * s), int(h * s)))
+                    buf = _io.BytesIO()
+                    img.save(buf, format="JPEG", quality=args.quality)
+                except OSError as e:  # unreadable/corrupt: log and continue
+                    print(f"skip {path}: {e}", file=sys.stderr)
+                    skipped += 1
+                    continue
+                header = recordio.IRHeader(0, float(label), packed, 0)
+                writer.write_idx(packed, recordio.pack(header, buf.getvalue()))
+                lst.write(f"{packed}\t{label}\t{path}\n")
+                packed += 1
+    finally:
+        writer.close()
+    print(f"packed {packed} images ({skipped} skipped), "
+          f"{len(classes)} classes -> {args.prefix}.rec")
 
 
 if __name__ == "__main__":
