@@ -32,7 +32,7 @@ __all__ = [
     "allreduce", "allgather", "reduce_scatter", "broadcast", "ppermute",
     "axis_is_bound", "shard", "replicate", "shard_map", "num_devices",
     "local_rank", "rank", "world_size", "DataParallel", "split_and_load",
-    "ring_attention", "pipeline_apply",
+    "ring_attention", "pipeline_apply", "moe_dispatch",
 ]
 
 
@@ -48,6 +48,10 @@ def __getattr__(name):
         from .pipeline import pipeline_apply
         globals()[name] = pipeline_apply
         return pipeline_apply
+    if name == "moe_dispatch":
+        from .moe import moe_dispatch
+        globals()[name] = moe_dispatch
+        return moe_dispatch
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 _tls = threading.local()

@@ -1,0 +1,75 @@
+"""Expert parallelism: mixture-of-experts dispatch over the 'ep' mesh axis.
+
+The last parallelism mode ABSENT from the reference (SURVEY §2.3). Each ep
+rank hosts one (or E/ep) expert FFN; tokens route by a learned gate with
+fixed capacity, hop to their expert via `lax.all_to_all` (riding ICI), are
+transformed, and hop back, scaled by the gate probability — the standard
+switch-transformer dispatch, expressed with XLA collectives.
+
+Use inside shard_map: tokens sharded over 'ep' (each rank holds T_local
+tokens), expert weights sharded one-per-rank with P('ep', ...).
+"""
+from __future__ import annotations
+
+__all__ = ["moe_dispatch"]
+
+
+def moe_dispatch(x, gate_logits, expert_fn, axis_name="ep", capacity=None):
+    """Top-1 capacity-based MoE (≙ Switch routing).
+
+    x            (T_local, D)   this rank's tokens
+    gate_logits  (T_local, E)   router scores (E = axis size)
+    expert_fn    (tokens (R*C, D)) -> (R*C, D): THIS rank's expert applied to
+                 the tokens it received (R = number of ranks)
+    capacity     per-(source rank, expert) token budget C; tokens over
+                 capacity pass through unchanged (standard overflow rule)
+
+    Returns (T_local, D): gate-weighted expert outputs (+ passthrough for
+    dropped tokens) and the load-balancing auxiliary loss (scalar).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    T, D = x.shape
+    E = jax.lax.axis_size(axis_name)
+    assert gate_logits.shape[-1] == E, "one expert per ep rank"
+    if capacity is None:
+        capacity = max(2 * T // E, 1)
+    C = capacity
+
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                  # (T,)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+
+    # position of each token within its expert's local send buffer
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # (T, E)
+    pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1)         # (T, E)
+    slot = jnp.take_along_axis(pos_in_expert, expert_idx[:, None],
+                               axis=1)[:, 0]                 # (T,)
+    keep = slot < C
+
+    # scatter tokens into the (E, C, D) send buffer. Additive scatter:
+    # dropped tokens contribute zeros, so their clipped-slot collisions with
+    # kept tokens are harmless (a .set would clobber nondeterministically)
+    send = jnp.zeros((E, C, D), x.dtype)
+    send = send.at[expert_idx, jnp.clip(slot, 0, C - 1)].add(
+        jnp.where(keep[:, None], x, 0.0))
+
+    # all_to_all: dim0 switches from "destination expert" to "source rank"
+    recv = jax.lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
+                              tiled=False)                   # (E, C, D)
+    out = expert_fn(recv.reshape(E * C, D)).reshape(E, C, D)
+    back = jax.lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                              tiled=False)                   # (E, C, D)
+
+    # gather each kept token's transformed value; dropped tokens pass through
+    gathered = back[expert_idx, jnp.clip(slot, 0, C - 1)]    # (T, D)
+    y = jnp.where(keep[:, None], gate[:, None].astype(x.dtype) * gathered, x)
+
+    # load-balancing aux loss (Switch eq. 4): E * sum_e f_e * P_e over the
+    # GLOBAL batch — pmean the per-rank fractions so the scalar is replicated
+    frac_tokens = jax.lax.pmean(
+        jnp.mean(onehot.astype(jnp.float32), axis=0), axis_name)
+    frac_probs = jax.lax.pmean(jnp.mean(probs, axis=0), axis_name)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return y, aux
