@@ -180,10 +180,21 @@ class Optimizer:
         for i, w, g, s in zip(indices, weights, grads, states):
             self.update(i, w, g, s)
 
-    # True for rules with no per-step host-side scalars (Adam-family bakes
-    # the bias-correction step count into the trace, so fusing would retrace
-    # every step) — those use the per-param path until t is made traceable.
+    # True for rules whose step_one is trace-pure given (lr, wd[, t]) —
+    # the Adam family passes its bias-correction step count as the traced
+    # `t` argument. Rules with other per-step host state (Nadam's
+    # m_schedule, SGLD's fresh RNG key) stay on the per-param path.
     _fused_safe = False
+
+    @classmethod
+    def _step_takes_t(cls):
+        """Cached per-class: does step_one accept the traced step count?"""
+        cached = cls.__dict__.get("_takes_t_cache")
+        if cached is None:
+            import inspect
+            cached = "t" in inspect.signature(cls.step_one).parameters
+            cls._takes_t_cache = cached
+        return cached
 
     def _hyper_fingerprint(self):
         """Scalar hyperparameters baked into fused traces (momentum, rho,
@@ -222,24 +233,30 @@ class Optimizer:
         wds = [_np.float32(self._get_wd(i)) for i, _, _, _ in items]
         opt = self
         indices = tuple(i for i, _, _, _ in items)
+        takes_t = type(self)._step_takes_t()
+        ts = ([_np.float32(self._index_update_count[i]) for i in indices]
+              if takes_t else None)
 
         key = ("fused_all", indices, self.clip_gradient,
                self._hyper_fingerprint())
         cached = self._jitted.get(key)
         if cached is None:
-            def f(wbufs, gbufs, sbufs, lr_args, wd_args, rescale):
+            def f(wbufs, gbufs, sbufs, lr_args, wd_args, rescale, t_args):
                 # expose the traced rescale to step_one's _preprocess; the
                 # inner kernel cache detects the tracer and keys on "traced"
                 prev = opt.rescale_grad
                 opt.rescale_grad = rescale
                 try:
                     new_w, new_s = [], []
-                    for idx, wb, gb, sb, lr, wd in zip(
-                            indices, wbufs, gbufs, sbufs, lr_args, wd_args):
+                    for k, (idx, wb, gb, sb, lr, wd) in enumerate(zip(
+                            indices, wbufs, gbufs, sbufs, lr_args, wd_args)):
                         w = _wrap(wb)
                         g = _wrap(gb)
                         st = _wrap_state(sb)
-                        opt.step_one(idx, w, g, st, lr, wd)
+                        if t_args is not None:
+                            opt.step_one(idx, w, g, st, lr, wd, t=t_args[k])
+                        else:
+                            opt.step_one(idx, w, g, st, lr, wd)
                         new_w.append(w._arr)
                         new_s.append(_state_bufs(st))
                     return new_w, new_s
@@ -253,7 +270,7 @@ class Optimizer:
         gbufs = [g._arr for _, _, g, _ in items]
         sbufs = [_state_bufs(s) for _, _, _, s in items]
         new_w, new_s = cached(wbufs, gbufs, sbufs, lrs, wds,
-                              _np.float32(self.rescale_grad))
+                              _np.float32(self.rescale_grad), ts)
         for (idx, w_nd, g_nd, state), wb, sb in zip(items, new_w, new_s):
             w_nd._set_arr(wb)
             _state_restore(state, sb)
@@ -560,10 +577,13 @@ class _AdamBase(Optimizer):
 class Adam(_AdamBase):
     """≙ optimizer/adam.py (adam_update kernel, optimizer_op.cc)."""
 
-    def step_one(self, index, weight, grad, state, lr, wd):
+    _fused_safe = True
+
+    def step_one(self, index, weight, grad, state, lr, wd, t=None):
         import jax.numpy as jnp
         mean, var = state
-        t = self._index_update_count[index]
+        if t is None:
+            t = self._index_update_count[index]
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
         lr_t = lr * (coef2 ** 0.5) / coef1
@@ -587,10 +607,13 @@ class Adam(_AdamBase):
 class AdamW(_AdamBase):
     """Decoupled weight decay (≙ contrib/adamw.cc multi_adamw)."""
 
-    def step_one(self, index, weight, grad, state, lr, wd):
+    _fused_safe = True
+
+    def step_one(self, index, weight, grad, state, lr, wd, t=None):
         import jax.numpy as jnp
         mean, var = state
-        t = self._index_update_count[index]
+        if t is None:
+            t = self._index_update_count[index]
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
         lr_t = lr * (coef2 ** 0.5) / coef1
@@ -616,10 +639,11 @@ class Adamax(_AdamBase):
         super().__init__(learning_rate=learning_rate, beta1=beta1, beta2=beta2,
                          **kwargs)
 
-    def step_one(self, index, weight, grad, state, lr, wd):
+    def step_one(self, index, weight, grad, state, lr, wd, t=None):
         import jax.numpy as jnp
         mean, u = state
-        t = self._index_update_count[index]
+        if t is None:
+            t = self._index_update_count[index]
         lr_t = lr / (1.0 - self.beta1 ** t)
 
         def k(w, g, m, u, lr, wd, b1, b2, eps):
@@ -646,10 +670,11 @@ class Nadam(_AdamBase):
         self.schedule_decay = schedule_decay
         self.m_schedule = 1.0
 
-    def step_one(self, index, weight, grad, state, lr, wd):
+    def step_one(self, index, weight, grad, state, lr, wd, t=None):
         import jax.numpy as jnp
         mean, var = state
-        t = self._index_update_count[index]
+        if t is None:
+            t = self._index_update_count[index]
         momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
         momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
         self.m_schedule = self.m_schedule * momentum_t
@@ -680,10 +705,13 @@ class Nadam(_AdamBase):
 class AdaBelief(_AdamBase):
     """≙ contrib/adabelief.cc."""
 
-    def step_one(self, index, weight, grad, state, lr, wd):
+    _fused_safe = True
+
+    def step_one(self, index, weight, grad, state, lr, wd, t=None):
         import jax.numpy as jnp
         mean, var = state
-        t = self._index_update_count[index]
+        if t is None:
+            t = self._index_update_count[index]
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
         lr_t = lr * (coef2 ** 0.5) / coef1
@@ -719,10 +747,11 @@ class FTML(Optimizer):
                 zeros(weight.shape, dtype=weight.dtype),  # v
                 zeros(weight.shape, dtype=weight.dtype))  # z
 
-    def step_one(self, index, weight, grad, state, lr, wd):
+    def step_one(self, index, weight, grad, state, lr, wd, t=None):
         import jax.numpy as jnp
         d, v, z = state
-        t = self._index_update_count[index]
+        if t is None:
+            t = self._index_update_count[index]
 
         def k(w, g, d, v, z, lr, wd, b1, b2, eps, t):
             g = self._preprocess(g, wd) + wd * w
@@ -892,6 +921,8 @@ class LARS(Optimizer):
 class LAMB(_AdamBase):
     """≙ optimizer/lamb.py (lamb_update_phase1/2, contrib/multi_lamb.cc)."""
 
+    _fused_safe = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-6, lower_bound=None, upper_bound=None,
                  bias_correction=True, **kwargs):
@@ -901,10 +932,11 @@ class LAMB(_AdamBase):
         self.upper_bound = upper_bound
         self.bias_correction = bias_correction
 
-    def step_one(self, index, weight, grad, state, lr, wd):
+    def step_one(self, index, weight, grad, state, lr, wd, t=None):
         import jax.numpy as jnp
         mean, var = state
-        t = self._index_update_count[index]
+        if t is None:
+            t = self._index_update_count[index]
 
         def k(w, g, m, v, lr, wd, b1, b2, eps, t):
             g = self._preprocess(g, wd)
@@ -938,10 +970,13 @@ class LAMB(_AdamBase):
 class LANS(LAMB):
     """Nesterov LAMB (≙ contrib/multi_lans.cc)."""
 
-    def step_one(self, index, weight, grad, state, lr, wd):
+    _fused_safe = True
+
+    def step_one(self, index, weight, grad, state, lr, wd, t=None):
         import jax.numpy as jnp
         mean, var = state
-        t = self._index_update_count[index]
+        if t is None:
+            t = self._index_update_count[index]
 
         def k(w, g, m, v, lr, wd, b1, b2, eps, t):
             g = self._preprocess(g, wd)

@@ -1,7 +1,8 @@
 """Expert parallelism: mixture-of-experts dispatch over the 'ep' mesh axis.
 
 The last parallelism mode ABSENT from the reference (SURVEY §2.3). Each ep
-rank hosts one (or E/ep) expert FFN; tokens route by a learned gate with
+rank hosts exactly ONE expert FFN (E == ep axis size); tokens route by a
+learned gate with
 fixed capacity, hop to their expert via `lax.all_to_all` (riding ICI), are
 transformed, and hop back, scaled by the gate probability — the standard
 switch-transformer dispatch, expressed with XLA collectives.

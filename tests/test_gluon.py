@@ -558,3 +558,46 @@ def test_avgpool_hybrid_backward():
         pool(x).sum().backward()
     np.testing.assert_allclose(x.grad.asnumpy(),
                                0.25 * np.ones((2, 1, 8, 8)), rtol=1e-6)
+
+
+def test_fused_adam_matches_per_param():
+    """Adam-family fused path (traced step count) must equal per-param
+    updates exactly across multiple steps."""
+    def run(force_per_param):
+        mx.seed(21)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 0.01})
+        if force_per_param:
+            tr._optimizer._fused_safe = False
+        x = mx.np.array(np.ones((4, 4), np.float32))
+        for _ in range(4):
+            with mx.autograd.record():
+                (net(x) ** 2).sum().backward()
+            tr.step(4)
+        return {k: p.data().asnumpy()
+                for k, p in net.collect_params().items()}
+
+    w_fused = run(False)
+    w_plain = run(True)
+    for k in w_fused:
+        np.testing.assert_allclose(w_fused[k], w_plain[k], rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_fused_adam_single_trace():
+    """The fused Adam path must reuse ONE executable across steps (t is a
+    traced argument, not a cache-key component)."""
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    x = mx.np.array(np.ones((2, 2), np.float32))
+    for _ in range(5):
+        with mx.autograd.record():
+            net(x).sum().backward()
+        tr.step(2)
+    fused_keys = [k for k in tr._optimizer._jitted
+                  if isinstance(k, tuple) and k[0] == "fused_all"]
+    assert len(fused_keys) == 1, fused_keys
