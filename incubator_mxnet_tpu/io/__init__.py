@@ -258,3 +258,65 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad or 0
+
+
+class CSVIter(NDArrayIter):
+    """≙ mx.io.CSVIter (src/io/iter_csv.cc): batches from CSV files.
+
+    data_csv/label_csv: file paths; data_shape/label_shape: per-example
+    shapes. Loads host-side via numpy then serves fixed-size batches; every
+    example is served each epoch (the final partial batch wraps with its
+    `pad` count exposed, ≙ the reference batch loader's padding contract).
+    """
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype="float32"):
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=dtype, ndmin=2)
+        if data.size == 0:
+            raise MXNetError(f"no examples in {data_csv}")
+        n = data.shape[0]
+        data = data.reshape((n,) + tuple(data_shape))
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=dtype,
+                                ndmin=2).reshape((n,) + tuple(label_shape))
+        else:
+            label = _np.zeros((n,) + tuple(label_shape), dtype)
+        super().__init__(data, label, batch_size, last_batch_handle="pad")
+
+
+class LibSVMIter(NDArrayIter):
+    """≙ mx.io.LibSVMIter (src/io/iter_libsvm.cc). The reference serves
+    sparse CSR batches from ZERO-BASED libsvm files; TPU has no sparse
+    storage, so rows densify into (batch, num_features) float arrays.
+    Out-of-range feature indices raise (a silent drop would corrupt
+    training data — e.g. a 1-based file loaded as 0-based)."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 round_batch=True, dtype="float32"):
+        num_features = int(_np.prod(data_shape))
+        rows, labels = [], []
+        with open(data_libsvm) as f:
+            for lineno, line in enumerate(f, 1):
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = _np.zeros(num_features, dtype)
+                for tok in parts[1:]:
+                    idx, val = tok.split(":")
+                    idx = int(idx)
+                    if not 0 <= idx < num_features:
+                        raise MXNetError(
+                            f"{data_libsvm}:{lineno}: feature index {idx} "
+                            f"outside [0, {num_features}) — libsvm input "
+                            "must be zero-based and match data_shape")
+                    row[idx] = float(val)
+                rows.append(row)
+        if not rows:
+            raise MXNetError(f"no examples in {data_libsvm}")
+        data = _np.stack(rows).reshape((-1,) + tuple(data_shape))
+        super().__init__(data, _np.asarray(labels, dtype), batch_size,
+                         last_batch_handle="pad")
+
+
+__all__ += ["CSVIter", "LibSVMIter"]

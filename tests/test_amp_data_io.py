@@ -270,3 +270,43 @@ def test_amp_autocast_nesting():
     finally:
         amp.uninit()
     assert not amp.is_active()
+
+
+def test_csv_iter(tmp_path):
+    from incubator_mxnet_tpu.io import CSVIter
+    data = np.random.randn(7, 6).astype(np.float32)
+    labels = np.arange(7, dtype=np.float32)
+    np.savetxt(tmp_path / "d.csv", data, delimiter=",")
+    np.savetxt(tmp_path / "l.csv", labels, delimiter=",")
+    it = CSVIter(str(tmp_path / "d.csv"), (2, 3),
+                 label_csv=str(tmp_path / "l.csv"), batch_size=3)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (3, 2, 3)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(),
+                               data[:3].reshape(3, 2, 3), rtol=1e-6)
+    assert batches[-1].pad == 2
+
+
+def test_libsvm_iter(tmp_path):
+    from incubator_mxnet_tpu.io import LibSVMIter
+    f = tmp_path / "t.libsvm"
+    f.write_text("1 0:1.5 3:2.0\n0 1:0.5\n1 2:3.0 3:1.0\n")
+    it = LibSVMIter(str(f), (4,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2  # every example served; tail batch padded
+    x = batches[0].data[0].asnumpy()
+    np.testing.assert_allclose(x[0], [1.5, 0, 0, 2.0])
+    np.testing.assert_allclose(x[1], [0, 0.5, 0, 0])
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), [1.0, 0.0])
+    np.testing.assert_allclose(batches[1].data[0].asnumpy()[0],
+                               [0, 0, 3.0, 1.0])
+    assert batches[1].pad == 1
+    # provide_data works (legacy binding contract)
+    it2 = LibSVMIter(str(f), (4,), batch_size=2)
+    assert it2.provide_data[0].shape == (2, 4)
+    # 1-based (out-of-range) file raises instead of silently dropping
+    g = f.parent / "bad.libsvm"
+    g.write_text("1 4:2.0\n")
+    with pytest.raises(mx.MXNetError):
+        LibSVMIter(str(g), (4,), batch_size=1)
