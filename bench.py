@@ -5,62 +5,110 @@ images/sec on one chip, compared against the reference's published V100 fp32
 row (298.51 img/s @ bs32, docs/.../faq/perf.md:243-253).
 
 The training step is the framework's own path: gluon ResNet-50 hybridized
-(one XLA computation for fwd+bwd via the cached-op tape) + SGD updates.
+(one XLA computation for fwd+bwd via the cached-op tape) + SGD updates —
+run the TPU way: NHWC layout (channels-last keeps contraction dims minor
+for the MXU) + AMP bf16 autocast with fp32 master weights.
+
+Secondary metric (same JSON line): bf16 inference img/s vs the reference's
+published V100 fp16 inference row (2085.03 img/s @ bs32, perf.md:199-212).
 """
 from __future__ import annotations
 
 import json
-import os
 import time
 
 import numpy as np
 
-BASELINE_V100_FP32_TRAIN_BS32 = 298.51  # img/s (BASELINE.md)
+BASELINE_V100_FP32_TRAIN_BS32 = 298.51   # img/s (BASELINE.md)
+BASELINE_V100_FP16_INFER_BS32 = 2085.03  # img/s (BASELINE.md)
 
 
-def bench_resnet50_train(batch_size=32, iters=12, warmup=3):
-    import incubator_mxnet_tpu as mx
-    from incubator_mxnet_tpu import gluon
+def _make_net(layout):
     from incubator_mxnet_tpu.gluon.model_zoo import vision
-
-    net = vision.resnet50_v1()
+    net = vision.resnet50_v1(layout=layout)
     net.initialize()
     net.hybridize()
-    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-    trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": 0.05, "momentum": 0.9})
+    return net
 
-    x = mx.np.array(np.random.uniform(-1, 1,
-                                      (batch_size, 3, 224, 224)).astype(np.float32))
-    y = mx.np.array(np.random.randint(0, 1000, (batch_size,)))
 
-    def step():
-        with mx.autograd.record():
+def bench_resnet50_train(batch_size=32, iters=12, warmup=3, layout="NHWC",
+                         use_amp=True):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import amp, gluon
+
+    if use_amp:
+        amp.init("bfloat16")
+    try:
+        net = _make_net(layout)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9})
+
+        shape = ((batch_size, 3, 224, 224) if layout == "NCHW"
+                 else (batch_size, 224, 224, 3))
+        x = mx.np.array(np.random.uniform(-1, 1, shape).astype(np.float32))
+        y = mx.np.array(np.random.randint(0, 1000, (batch_size,)))
+
+        def step():
+            with mx.autograd.record():
+                out = net(x)
+                L = loss_fn(out, y).mean()
+            L.backward()
+            trainer.step(batch_size, ignore_stale_grad=True)
+            return L
+
+        for _ in range(warmup):
+            step().wait_to_read()
+        mx.waitall()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            L = step()
+        L.wait_to_read()
+        mx.waitall()
+        dt = time.perf_counter() - t0
+    finally:
+        if use_amp:
+            amp.uninit()
+    return batch_size * iters / dt
+
+
+def bench_resnet50_infer(batch_size=32, iters=30, warmup=5, layout="NHWC"):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import amp
+
+    amp.init("bfloat16")
+    try:
+        net = _make_net(layout)
+        shape = ((batch_size, 3, 224, 224) if layout == "NCHW"
+                 else (batch_size, 224, 224, 3))
+        x = mx.np.array(np.random.uniform(-1, 1, shape).astype(np.float32))
+
+        for _ in range(warmup):
+            net(x).wait_to_read()
+        mx.waitall()
+        t0 = time.perf_counter()
+        for _ in range(iters):
             out = net(x)
-            L = loss_fn(out, y).mean()
-        L.backward()
-        trainer.step(batch_size, ignore_stale_grad=True)
-        return L
-
-    for _ in range(warmup):
-        step().wait_to_read()
-    mx.waitall()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        L = step()
-    L.wait_to_read()
-    mx.waitall()
-    dt = time.perf_counter() - t0
+        out.wait_to_read()
+        mx.waitall()
+        dt = time.perf_counter() - t0
+    finally:
+        amp.uninit()
     return batch_size * iters / dt
 
 
 def main():
-    ips = bench_resnet50_train()
+    train_ips = bench_resnet50_train()
+    infer_ips = bench_resnet50_infer()
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_bs32",
-        "value": round(ips, 2),
+        "value": round(train_ips, 2),
         "unit": "images/sec",
-        "vs_baseline": round(ips / BASELINE_V100_FP32_TRAIN_BS32, 4),
+        "vs_baseline": round(train_ips / BASELINE_V100_FP32_TRAIN_BS32, 4),
+        "precision": "bf16_amp_nhwc",
+        "infer_images_per_sec_bs32_bf16": round(infer_ips, 2),
+        "infer_vs_v100_fp16_baseline": round(
+            infer_ips / BASELINE_V100_FP16_INFER_BS32, 4),
     }))
 
 

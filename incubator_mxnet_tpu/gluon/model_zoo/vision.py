@@ -5,8 +5,10 @@ mobilenet,resnet,squeezenet,vgg}.py. Same architectures and get_model()
 registry; `pretrained=True` raises (no network egress — load weights from a
 local file with load_parameters instead).
 
-TPU note: all models accept layout='NCHW' (reference default) or 'NHWC'
-(MXU-preferred). Benchmarks use NHWC + bf16 + hybridize.
+TPU note: the ResNet family accepts layout='NCHW' (reference default) or
+'NHWC' (MXU-preferred; channels-last keeps the contraction dims minor for
+the systolic array). Benchmarks use NHWC + bf16 + hybridize. Other
+architectures are NCHW-only for now.
 """
 from __future__ import annotations
 
@@ -41,22 +43,29 @@ def _check_pretrained(pretrained):
 # ---------------------------------------------------------------------------
 # ResNet V1/V2 (≙ model_zoo/vision/resnet.py)
 # ---------------------------------------------------------------------------
+def _bn_axis(layout):
+    return 1 if layout.startswith("NC") else -1
+
+
 class BasicBlockV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 layout="NCHW"):
         super().__init__()
+        ax = _bn_axis(layout)
         self.body = nn.HybridSequential()
         self.body.add(nn.Conv2D(channels, 3, stride, 1, use_bias=False,
-                                in_channels=in_channels))
-        self.body.add(nn.BatchNorm())
+                                in_channels=in_channels, layout=layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         self.body.add(nn.Activation("relu"))
         self.body.add(nn.Conv2D(channels, 3, 1, 1, use_bias=False,
-                                in_channels=channels))
-        self.body.add(nn.BatchNorm())
+                                in_channels=channels, layout=layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         if downsample:
             self.downsample = nn.HybridSequential()
             self.downsample.add(nn.Conv2D(channels, 1, stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+                                          in_channels=in_channels,
+                                          layout=layout))
+            self.downsample.add(nn.BatchNorm(axis=ax))
         else:
             self.downsample = None
 
@@ -70,22 +79,28 @@ class BasicBlockV1(HybridBlock):
 
 
 class BottleneckV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 layout="NCHW"):
         super().__init__()
+        ax = _bn_axis(layout)
         self.body = nn.HybridSequential()
-        self.body.add(nn.Conv2D(channels // 4, 1, stride, use_bias=False))
-        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Conv2D(channels // 4, 1, stride, use_bias=False,
+                                layout=layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels // 4, 3, 1, 1, use_bias=False))
-        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Conv2D(channels // 4, 3, 1, 1, use_bias=False,
+                                layout=layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, 1, 1, use_bias=False))
-        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Conv2D(channels, 1, 1, use_bias=False,
+                                layout=layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         if downsample:
             self.downsample = nn.HybridSequential()
             self.downsample.add(nn.Conv2D(channels, 1, stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+                                          in_channels=in_channels,
+                                          layout=layout))
+            self.downsample.add(nn.BatchNorm(axis=ax))
         else:
             self.downsample = None
 
@@ -99,17 +114,20 @@ class BottleneckV1(HybridBlock):
 
 
 class BasicBlockV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 layout="NCHW"):
         super().__init__()
-        self.bn1 = nn.BatchNorm()
+        ax = _bn_axis(layout)
+        self.bn1 = nn.BatchNorm(axis=ax)
         self.conv1 = nn.Conv2D(channels, 3, stride, 1, use_bias=False,
-                               in_channels=in_channels)
-        self.bn2 = nn.BatchNorm()
+                               in_channels=in_channels, layout=layout)
+        self.bn2 = nn.BatchNorm(axis=ax)
         self.conv2 = nn.Conv2D(channels, 3, 1, 1, use_bias=False,
-                               in_channels=channels)
+                               in_channels=channels, layout=layout)
         if downsample:
             self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
+                                        in_channels=in_channels,
+                                        layout=layout)
         else:
             self.downsample = None
 
@@ -126,17 +144,22 @@ class BasicBlockV2(HybridBlock):
 
 
 class BottleneckV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 layout="NCHW"):
         super().__init__()
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, 1, 1, use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = nn.Conv2D(channels // 4, 3, stride, 1, use_bias=False)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, 1, 1, use_bias=False)
+        ax = _bn_axis(layout)
+        self.bn1 = nn.BatchNorm(axis=ax)
+        self.conv1 = nn.Conv2D(channels // 4, 1, 1, use_bias=False,
+                               layout=layout)
+        self.bn2 = nn.BatchNorm(axis=ax)
+        self.conv2 = nn.Conv2D(channels // 4, 3, stride, 1, use_bias=False,
+                               layout=layout)
+        self.bn3 = nn.BatchNorm(axis=ax)
+        self.conv3 = nn.Conv2D(channels, 1, 1, use_bias=False, layout=layout)
         if downsample:
             self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
+                                        in_channels=in_channels,
+                                        layout=layout)
         else:
             self.downsample = None
 
@@ -157,32 +180,38 @@ class BottleneckV2(HybridBlock):
 class ResNetV1(HybridBlock):
     """≙ model_zoo/vision/resnet.py ResNetV1."""
 
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False):
+    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
+                 layout="NCHW"):
         super().__init__()
         assert len(layers) == len(channels) - 1
+        ax = _bn_axis(layout)
         self.features = nn.HybridSequential()
         if thumbnail:
-            self.features.add(nn.Conv2D(channels[0], 3, 1, 1, use_bias=False))
+            self.features.add(nn.Conv2D(channels[0], 3, 1, 1, use_bias=False,
+                                        layout=layout))
         else:
-            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False,
+                                        layout=layout))
+            self.features.add(nn.BatchNorm(axis=ax))
             self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(3, 2, 1))
+            self.features.add(nn.MaxPool2D(3, 2, 1, layout=layout))
         for i, num_layer in enumerate(layers):
             stride = 1 if i == 0 else 2
             self.features.add(self._make_layer(
                 block, num_layer, channels[i + 1], stride,
-                in_channels=channels[i]))
-        self.features.add(nn.GlobalAvgPool2D())
+                in_channels=channels[i], layout=layout))
+        self.features.add(nn.GlobalAvgPool2D(layout=layout))
         self.output = nn.Dense(classes, in_units=channels[-1])
 
     @staticmethod
-    def _make_layer(block, num_layers, channels, stride, in_channels=0):
+    def _make_layer(block, num_layers, channels, stride, in_channels=0,
+                    layout="NCHW"):
         layer = nn.HybridSequential()
         layer.add(block(channels, stride, channels != in_channels,
-                        in_channels=in_channels))
+                        in_channels=in_channels, layout=layout))
         for _ in range(num_layers - 1):
-            layer.add(block(channels, 1, False, in_channels=channels))
+            layer.add(block(channels, 1, False, in_channels=channels,
+                            layout=layout))
         return layer
 
     def forward(self, x):
@@ -193,27 +222,31 @@ class ResNetV1(HybridBlock):
 class ResNetV2(HybridBlock):
     """≙ model_zoo/vision/resnet.py ResNetV2 (pre-activation)."""
 
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False):
+    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
+                 layout="NCHW"):
         super().__init__()
+        ax = _bn_axis(layout)
         self.features = nn.HybridSequential()
-        self.features.add(nn.BatchNorm(scale=False, center=False))
+        self.features.add(nn.BatchNorm(axis=ax, scale=False, center=False))
         if thumbnail:
-            self.features.add(nn.Conv2D(channels[0], 3, 1, 1, use_bias=False))
+            self.features.add(nn.Conv2D(channels[0], 3, 1, 1, use_bias=False,
+                                        layout=layout))
         else:
-            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False,
+                                        layout=layout))
+            self.features.add(nn.BatchNorm(axis=ax))
             self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(3, 2, 1))
+            self.features.add(nn.MaxPool2D(3, 2, 1, layout=layout))
         in_channels = channels[0]
         for i, num_layer in enumerate(layers):
             stride = 1 if i == 0 else 2
             self.features.add(self._make_layer(
                 block, num_layer, channels[i + 1], stride,
-                in_channels=in_channels))
+                in_channels=in_channels, layout=layout))
             in_channels = channels[i + 1]
-        self.features.add(nn.BatchNorm())
+        self.features.add(nn.BatchNorm(axis=ax))
         self.features.add(nn.Activation("relu"))
-        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.GlobalAvgPool2D(layout=layout))
         self.features.add(nn.Flatten())
         self.output = nn.Dense(classes, in_units=channels[-1])
 

@@ -15,7 +15,8 @@ from __future__ import annotations
 __all__ = ["moe_dispatch"]
 
 
-def moe_dispatch(x, gate_logits, expert_fn, axis_name="ep", capacity=None):
+def moe_dispatch(x, gate_logits, expert_fn, axis_name="ep", capacity=None,
+                 stats_axes=None):
     """Top-1 capacity-based MoE (≙ Switch routing).
 
     x            (T_local, D)   this rank's tokens
@@ -24,6 +25,11 @@ def moe_dispatch(x, gate_logits, expert_fn, axis_name="ep", capacity=None):
                  the tokens it received (R = number of ranks)
     capacity     per-(source rank, expert) token budget C; tokens over
                  capacity pass through unchanged (standard overflow rule)
+    stats_axes   mesh axes to average the load fractions over for the aux
+                 loss (default: just `axis_name`). When tokens are also
+                 sharded along other axes (e.g. 'sp'), include them so the
+                 aux is the Switch eq.4 objective over the GLOBAL batch —
+                 the fractions are linear in tokens, the aux product is not.
 
     Returns (T_local, D): gate-weighted expert outputs (+ passthrough for
     dropped tokens) and the load-balancing auxiliary loss (scalar).
@@ -68,9 +74,11 @@ def moe_dispatch(x, gate_logits, expert_fn, axis_name="ep", capacity=None):
     y = jnp.where(keep[:, None], gate[:, None].astype(x.dtype) * gathered, x)
 
     # load-balancing aux loss (Switch eq. 4): E * sum_e f_e * P_e over the
-    # GLOBAL batch — pmean the per-rank fractions so the scalar is replicated
+    # GLOBAL batch — pmean the per-rank fractions (linear in tokens) over
+    # every axis the tokens are sharded on, THEN take the product
+    axes = stats_axes if stats_axes is not None else (axis_name,)
     frac_tokens = jax.lax.pmean(
-        jnp.mean(onehot.astype(jnp.float32), axis=0), axis_name)
-    frac_probs = jax.lax.pmean(jnp.mean(probs, axis=0), axis_name)
+        jnp.mean(onehot.astype(jnp.float32), axis=0), axes)
+    frac_probs = jax.lax.pmean(jnp.mean(probs, axis=0), axes)
     aux = E * jnp.sum(frac_tokens * frac_probs)
     return y, aux
