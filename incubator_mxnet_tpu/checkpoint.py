@@ -34,6 +34,24 @@ def _flatten(tree, prefix=""):
     return out
 
 
+def _norm_npz_path(path):
+    """np.savez appends '.npz' to extension-less paths; normalize so save's
+    return value and load agree on the real filename."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def _encode_key(k):
+    """Escape-safe flat-key encoding: every '_' in the original becomes
+    '_u' and every '/' becomes '_s', so names containing '__' round-trip."""
+    return k.replace("_", "_u").replace("/", "_s")
+
+
+def _decode_key(k):
+    # every '_' in the encoded form starts a 2-char token ('_u' or '_s'),
+    # so these sequential replaces cannot misalign
+    return k.replace("_s", "/").replace("_u", "_")
+
+
 def save_checkpoint(path, params, step=None, trainer=None):
     """Host-local checkpoint: params (dict of NDArray/array, or a Block) +
     optional trainer state (≙ the reference's save pattern, one file)."""
@@ -43,10 +61,12 @@ def save_checkpoint(path, params, step=None, trainer=None):
                   if p._data is not None}
     payload = {}
     for k, v in _flatten(params).items():
-        payload[k.replace("/", "__")] = (
+        payload[_encode_key(k)] = (
             v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v))
+    path = _norm_npz_path(path)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     _np.savez(path, __step__=_np.asarray(step if step is not None else -1),
+              __fmt__=_np.asarray(2),  # v2: escape-safe key encoding
               **payload)
     if trainer is not None:
         trainer.save_states(path + ".trainer")
@@ -56,10 +76,17 @@ def save_checkpoint(path, params, step=None, trainer=None):
 def load_checkpoint(path, net=None, trainer=None, device=None):
     """Load a host-local checkpoint; returns (params_dict, step)."""
     from .ndarray import array
+    raw_path = path
+    path = _norm_npz_path(path)
     with _np.load(path, allow_pickle=False) as f:
         step = int(f["__step__"])
-        params = {k.replace("__", "/"): array(f[k], device=device)
-                  for k in f.files if k != "__step__"}
+        # v1 files (no __fmt__) used a lossy '/'->'__' mapping; decode them
+        # with the legacy rule so their keys aren't silently corrupted
+        fmt = int(f["__fmt__"]) if "__fmt__" in f.files else 1
+        decode = _decode_key if fmt >= 2 else (lambda k: k.replace("__", "/"))
+        meta = ("__step__", "__fmt__")
+        params = {decode(k): array(f[k], device=device)
+                  for k in f.files if k not in meta}
     if net is not None:
         flat = {k.replace("/", "."): v for k, v in params.items()}
         own = net.collect_params()
@@ -67,8 +94,12 @@ def load_checkpoint(path, net=None, trainer=None, device=None):
             if name in flat:
                 p.shape = flat[name].shape
                 p.set_data(flat[name])
-    if trainer is not None and os.path.exists(path + ".trainer"):
-        trainer.load_states(path + ".trainer")
+    if trainer is not None:
+        # v1 saves wrote trainer state next to the un-normalized path
+        for tp in (path + ".trainer", raw_path + ".trainer"):
+            if os.path.exists(tp):
+                trainer.load_states(tp)
+                break
     return params, (step if step >= 0 else None)
 
 
@@ -114,11 +145,12 @@ def load_sharded(directory, step=None, target=None):
     path = os.path.join(os.path.abspath(directory), str(step))
     ckptr = ocp.PyTreeCheckpointer()
     if target is not None:
-        from orbax.checkpoint import args as ocp_args
-        try:
-            return ckptr.restore(path, item=target), step
-        except TypeError:
-            pass
+        # modern orbax args API: reshard each leaf onto the target's
+        # sharding/dtype as it is read back
+        restore_args = ocp.checkpoint_utils.construct_restore_args(target)
+        return ckptr.restore(
+            path, args=ocp.args.PyTreeRestore(
+                item=target, restore_args=restore_args)), step
     return ckptr.restore(path), step
 
 
