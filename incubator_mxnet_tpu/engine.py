@@ -20,7 +20,7 @@ from contextlib import contextmanager
 from .base import get_env
 
 __all__ = ["bulk", "set_bulk_size", "current_bulk_size", "is_naive",
-           "wait_for_all"]
+           "set_naive", "wait_for_all"]
 
 _bulk_size = [0]
 
@@ -46,9 +46,27 @@ def bulk(size):
         set_bulk_size(prev)
 
 
+_naive = [None]  # None = follow the env var; bool = set_naive override
+
+
 def is_naive():
-    """True when MXNET_ENGINE_TYPE=NaiveEngine (synchronous debug mode)."""
+    """True when MXNET_ENGINE_TYPE=NaiveEngine (synchronous debug mode).
+    Consumed by ops.registry.invoke: every op dispatch blocks until its
+    results are materialized, giving the reference NaiveEngine's
+    deterministic one-op-at-a-time debugging behavior. Reads the env var
+    live unless set_naive() overrode it."""
+    if _naive[0] is not None:
+        return _naive[0]
     return get_env("MXNET_ENGINE_TYPE") == "NaiveEngine"
+
+
+def set_naive(value):
+    """Toggle synchronous dispatch at runtime (≙ re-exec with
+    MXNET_ENGINE_TYPE=NaiveEngine). Returns the previous effective setting;
+    pass None to resume following the env var."""
+    prev = is_naive()
+    _naive[0] = value if value is None else bool(value)
+    return prev
 
 
 def wait_for_all():

@@ -33,6 +33,44 @@ from .parameter import Parameter, DeferredInitializationError
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
 
+def _amp_fingerprint():
+    """The active autocast target dtype, or None (part of the cached-op
+    cache key: amp-on and amp-off traces are different XLA programs)."""
+    import sys
+    amp_mod = sys.modules.get("incubator_mxnet_tpu.amp")
+    if amp_mod is None or not amp_mod.is_active():
+        return None
+    return amp_mod._state["target_dtype"]
+
+
+from contextlib import contextmanager as _contextmanager
+
+
+@_contextmanager
+def _amp_scope(amp_fp):
+    """Re-enter (or force off) the autocast state a forward trace was built
+    under — including the fingerprinted target dtype, which may have been
+    re-inited globally since — so the backward's recompute trace bakes
+    identical casts."""
+    import sys
+    amp_mod = sys.modules.get("incubator_mxnet_tpu.amp")
+    if amp_fp is None:
+        if amp_mod is None:
+            yield
+            return
+        with amp_mod.autocast(False):
+            yield
+        return
+    from .. import amp as amp_mod
+    prev_dtype = amp_mod._state["target_dtype"]
+    amp_mod._state["target_dtype"] = amp_fp
+    try:
+        with amp_mod.autocast(True):
+            yield
+    finally:
+        amp_mod._state["target_dtype"] = prev_dtype
+
+
 class _BlockScope:
     """Naming helper for programmatically-created children."""
     _count = {}
@@ -338,7 +376,7 @@ class HybridBlock(Block):
     def __init__(self):
         super().__init__()
         self._active = False
-        self._cached_graph = {}     # (training flag) -> (jitted fn, meta)
+        self._cached_graph = {}  # (training, amp_fp) -> (jit fn, meta, bwd)
         self._cached_params = None  # stable param order for the cache
         self._shapes_ready = False
         self._jit_kwargs = {}
@@ -385,24 +423,32 @@ class HybridBlock(Block):
                                    sorted(self.collect_params().items())]
         params = self._cached_params
         training = autograd.is_training()
-        cached = self._cached_graph.get(training)
+        # cache key includes the autocast state: an amp-on trace bakes bf16
+        # casts into the XLA program, an amp-off trace must not reuse it
+        amp_fp = _amp_fingerprint()
+        cached = self._cached_graph.get((training, amp_fp))
         if cached is None:
-            cached = self._build_cache(training)
-            self._cached_graph[training] = cached
-        jit_fn, meta = cached
+            cached = self._build_cache(training, amp_fp)
+            self._cached_graph[(training, amp_fp)] = cached
+        jit_fn, meta = cached[0], cached[1]
 
         n_in = len(args)
         key = _random.next_key()
 
         from ..ops.registry import invoke
 
-        def runner(*flat):
+        def runner(key, *flat):
             inputs, pbufs = flat[:n_in], flat[n_in:]
             outs, aux, _ = jit_fn(pbufs, key, *inputs)
             return tuple(outs) + tuple(aux)
 
-        results = invoke(runner, tuple(args) + tuple(p.data() for p in params),
-                         name=type(self).__name__, multi_out=True)
+        get_bwd = cached[2]
+        cached_vjp = lambda raw, cts: get_bwd(n_in)(raw[0], raw[1:], cts)
+        results = invoke(runner,
+                         (key,) + tuple(args)
+                         + tuple(p.data() for p in params),
+                         name=type(self).__name__, multi_out=True,
+                         cached_vjp=cached_vjp)
         n_out = meta["n_out"]
         outs = results[:n_out]
         aux_new = results[n_out:]
@@ -416,7 +462,7 @@ class HybridBlock(Block):
             hook(self, args, out)
         return out
 
-    def _build_cache(self, training):
+    def _build_cache(self, training, amp_fp=None):
         """Construct + jit the pure function for this block (≙ _build_cache
         block.py:1095 building the CachedOp)."""
         import jax
@@ -458,12 +504,64 @@ class HybridBlock(Block):
             aux = tuple(mutated[i] for i in sorted(mutated))
             return out_raw, aux, None
 
-        return jax.jit(pure_fn), meta
+        bwd_cache = {}
+
+        def get_bwd(n_in):
+            """Jitted recompute-based VJP, compiled ONCE per input arity.
+
+            Per-call jax.vjp over the cached graph re-traces + transposes in
+            Python every step (50ms-class overhead on a ResNet) and runs the
+            backward through the eager transpose interpreter. Instead:
+            recompute the forward inside ONE jitted backward — XLA fuses
+            fwd-recompute + transpose into a single program, no residual
+            storage, no per-step tracing. The rng key rides through as a
+            jit argument so dropout masks replay identically.
+            """
+            bwd = bwd_cache.get(n_in)
+            if bwd is not None:
+                return bwd
+
+            def bwd_fn(key, flat_args, cts):
+                def flat_fn(*a):
+                    inputs, pbufs = a[:n_in], a[n_in:]
+                    outs, aux, _ = pure_fn(pbufs, key, *inputs)
+                    return tuple(outs) + tuple(aux)
+
+                # replay the forward's autocast state: backward runs with
+                # amp suspended, but the recompute must bake the SAME bf16
+                # casts the forward trace did or cotangent dtypes mismatch
+                with _amp_scope(amp_fp):
+                    _, vjp = jax.vjp(flat_fn, *flat_args)
+                grads = vjp(tuple(cts))
+                # None for the (integer) rng key slot + float0 -> None so
+                # jit never returns float0 buffers
+                clean = tuple(
+                    None if (hasattr(g, "dtype")
+                             and g.dtype == jax.dtypes.float0) else g
+                    for g in grads)
+                return (None,) + clean
+
+            bwd = jax.jit(bwd_fn)
+            bwd_cache[n_in] = bwd
+            return bwd
+
+        return jax.jit(pure_fn), meta, get_bwd
 
     # ------------------------------------------------------------------
     def optimize_for(self, x, *args, backend=None, **kwargs):
         """≙ HybridBlock.optimize_for (block.py:1272): on TPU all graph
-        optimization happens in XLA; this hybridizes and warms the cache."""
+        optimization happens in XLA; this hybridizes and warms the cache.
+
+        Unknown backends raise (reference semantics: partitioning for an
+        unregistered backend is an error, not a silent no-op)."""
+        _KNOWN = (None, "xla", "XLA", "tpu", "TPU")
+        if backend not in _KNOWN:
+            from ..base import MXNetError
+            raise MXNetError(
+                f"optimize_for backend {backend!r} is not available on this "
+                "stack; XLA owns graph partitioning/optimization (pass "
+                "backend=None or 'xla'). Reference backends like 'MKLDNN' "
+                "or 'TensorRT' have no TPU equivalent")
         self.hybridize(True)
         self(x, *args)
 
@@ -489,11 +587,11 @@ class HybridBlock(Block):
                 self._cached_params = [p for _, p in
                                        sorted(self.collect_params().items())]
             params = self._cached_params
-            cached = self._cached_graph.get(False)
+            cached = self._cached_graph.get((False, None))
             if cached is None:
                 cached = self._build_cache(False)
-                self._cached_graph[False] = cached
-            jit_fn, meta = cached
+                self._cached_graph[(False, None)] = cached
+            jit_fn, meta = cached[0], cached[1]
             pbufs = tuple(p.data()._arr for p in params)
             in_raw = tuple(a._arr if isinstance(a, NDArray) else a
                            for a in example_inputs)

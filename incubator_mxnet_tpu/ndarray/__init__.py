@@ -619,9 +619,15 @@ def stack(*arrays, axis=0):
 
 
 def waitall():
-    """≙ Engine::WaitForAll / mx.nd.waitall: barrier on all pending work."""
+    """≙ Engine::WaitForAll / mx.nd.waitall: barrier on all pending work.
+
+    PJRT has no global 'wait for everything' call; blocking on every live
+    array is the faithful equivalent (a dummy computation only proves the
+    stream accepts work, not that queued computations finished).
+    """
     import jax
-    (jax.device_put(0.0) + 0).block_until_ready()
+    for a in jax.live_arrays():
+        a.block_until_ready()
 
 
 def from_numpy(a, zero_copy=False):

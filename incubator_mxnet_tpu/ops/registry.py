@@ -77,6 +77,20 @@ def _amp_cast(r, dtype):
     return r
 
 
+_engine_mod = None
+
+
+def _engine_naive():
+    """NaiveEngine check — one source of truth (engine module state, which
+    snapshots MXNET_ENGINE_TYPE at import and is togglable via set_naive).
+    engine.py is dependency-light, so importing it here costs nothing."""
+    global _engine_mod
+    if _engine_mod is None:
+        from .. import engine as _engine_mod_imported
+        _engine_mod = _engine_mod_imported
+    return _engine_mod.is_naive()
+
+
 def _is_float_dtype(dtype):
     if str(dtype) in ("bfloat16", "float8_e4m3fn", "float8_e5m2"):
         return True  # ml_dtypes extension floats are not np.floating subtypes
@@ -86,11 +100,19 @@ def _is_float_dtype(dtype):
         return False
 
 
-def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False):
+def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False,
+           cached_vjp=None):
     """Execute `fn` on arrays, wrapping results and taping when recording.
 
     `fn` is a pure jax function of the array-positional args (static/scalar
     params must be closed over by the caller). Returns NDArray or tuple.
+
+    cached_vjp: optional pre-built backward `(raw_args, cts) -> grads`
+    aligned with `args`. When given, the recording path skips the per-call
+    jax.vjp (which re-traces + transposes in Python on EVERY call — ruinous
+    for large cached graphs) and tapes this callable instead. Used by
+    HybridBlock's cached op, where the backward is a jitted
+    recompute-based VJP compiled once per shape.
     """
     import jax
     from ..ndarray import NDArray, _wrap
@@ -126,22 +148,34 @@ def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False):
     recording = autograd.is_recording() and tracked_any
     if not recording:
         out = fn(*raw)
+        if _engine_naive():  # MXNET_ENGINE_TYPE=NaiveEngine: block per op
+            jax.block_until_ready(out)
         if isinstance(out, (tuple, list)):
-            res = tuple(_wrap(o) for o in out)
+            # None entries = symbolic-zero cotangents from a cached vjp
+            # (non-differentiable slots); pass through unchanged
+            res = tuple(_wrap(o) if o is not None else None for o in out)
             return res if (multi_out or len(res) != 1) else res[0]
         return (_wrap(out),) if multi_out else _wrap(out)
 
-    outs, vjp_fn = jax.vjp(fn, *raw)
+    if cached_vjp is not None:
+        outs = fn(*raw)
+        raw_t = tuple(raw)
+        tape_fn = lambda cts: cached_vjp(raw_t, tuple(cts))
+    else:
+        outs, vjp_fn = jax.vjp(fn, *raw)
+    if _engine_naive():
+        jax.block_until_ready(outs)
     single = not isinstance(outs, (tuple, list))
     outs_t = (outs,) if single else tuple(outs)
 
     any_float = any(_is_float_dtype(o.dtype) for o in outs_t)
     wrapped = tuple(_wrap(o) for o in outs_t)
     if any_float:
-        if single:
-            tape_fn = lambda cts: vjp_fn(cts[0])
-        else:
-            tape_fn = lambda cts: vjp_fn(tuple(cts))
+        if cached_vjp is None:
+            if single:
+                tape_fn = lambda cts: vjp_fn(cts[0])
+            else:
+                tape_fn = lambda cts: vjp_fn(tuple(cts))
         node = autograd.Node(tape_fn, parents,
                              [(o.shape, o.dtype) for o in outs_t], name=name,
                              fn=fn,

@@ -601,3 +601,37 @@ def test_fused_adam_single_trace():
     fused_keys = [k for k in tr._optimizer._jitted
                   if isinstance(k, tuple) and k[0] == "fused_all"]
     assert len(fused_keys) == 1, fused_keys
+
+
+def test_naive_engine_blocking_dispatch():
+    """MXNET_ENGINE_TYPE=NaiveEngine: every op dispatch blocks until its
+    result is materialized (engine.set_naive toggles at runtime)."""
+    from incubator_mxnet_tpu import engine
+    prev = engine.set_naive(True)
+    try:
+        assert engine.is_naive()
+        a = mx.np.ones((4, 4))
+        b = (a @ a) + 1  # dispatches through ops.registry.invoke
+        np.testing.assert_allclose(b.asnumpy(), np.full((4, 4), 5.0))
+        # tape path blocks too
+        x = mx.np.ones((3,))
+        x.attach_grad()
+        with mx.autograd.record():
+            y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.ones(3))
+    finally:
+        engine.set_naive(prev)
+    assert engine.is_naive() == prev
+
+
+def test_optimize_for_rejects_unknown_backend():
+    """Reference semantics: optimize_for with an unregistered backend is an
+    error, not a silent no-op."""
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    x = mx.np.ones((2, 4))
+    with pytest.raises(mx.MXNetError, match="not available"):
+        net.optimize_for(x, backend="TensorRT")
+    net.optimize_for(x, backend="xla")  # known backend works
+    assert net._active  # hybridized
