@@ -39,11 +39,12 @@ class Device:
         jax backend (tpu/axon), cpu resolves to the host backend."""
         import jax
         if self.device_type == "cpu":
-            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+            devs = (jax.local_devices(backend="cpu") if _has_platform("cpu")
+                    else jax.local_devices())
         else:
             devs = _accelerator_devices()
             if not devs:  # CPU-only process (tests): transparent fallback
-                devs = jax.devices()
+                devs = jax.local_devices()
         return devs[min(self.device_id, len(devs) - 1)]
 
     # -- scoping ------------------------------------------------------------
@@ -83,9 +84,11 @@ def _has_platform(name):
 
 
 def _accelerator_devices():
-    """All non-host PJRT devices (TPU chips; 'axon' tunneled chips included)."""
+    """This process's non-host PJRT devices (TPU chips; 'axon' tunneled
+    chips included). Local only: in multi-process runs, placing data on
+    another process's device is invalid."""
     import jax
-    devs = jax.devices()
+    devs = jax.local_devices()
     accel = [d for d in devs if d.platform not in ("cpu",)]
     return accel
 

@@ -108,11 +108,43 @@ class KVStore(KVStoreBase):
         return self.num_workers
 
     # ------------------------------------------------------------------
+    def _dist_active(self):
+        """True when this is a dist-type store in a real multi-process run —
+        push/broadcast/barrier then use actual cross-process collectives
+        (≙ ps-lite servers; here: jax multihost collectives over DCN)."""
+        if self.type.split("_")[0] not in ("dist", "horovod", "byteps"):
+            return False
+        import jax
+        try:
+            return jax.process_count() > 1
+        except RuntimeError:
+            return False
+
+    @staticmethod
+    def _cross_process_sum(agg):
+        """Sum a value across processes (≙ ps-lite server aggregation)."""
+        from jax.experimental import multihost_utils
+        from ..ndarray import NDArray, array
+        raw = agg._arr if isinstance(agg, NDArray) else agg
+        gathered = multihost_utils.process_allgather(raw)  # (P, *shape)
+        return array(_np.asarray(gathered).sum(axis=0))
+
+    @staticmethod
+    def _bcast_from_root(v):
+        """Rank 0's value to every process (≙ KVStore::Init server copy)."""
+        from jax.experimental import multihost_utils
+        from ..ndarray import NDArray, array
+        raw = v._arr if isinstance(v, NDArray) else v
+        return array(_np.asarray(multihost_utils.broadcast_one_to_all(raw)))
+
     def init(self, key, value):
         keys, values = _pairs(key, value)
+        dist = self._dist_active()
         for k, v in zip(keys, values):
             if k not in self._store:
-                self._store[k] = _one(v).copy()
+                v0 = _one(v)
+                self._store[k] = (self._bcast_from_root(v0) if dist
+                                  else v0.copy())
 
     def broadcast(self, key, value, out=None, priority=0):
         """≙ KVStore::Broadcast (kvstore.h:203): init then pull."""
@@ -129,6 +161,11 @@ class KVStore(KVStoreBase):
                 v = [self._compression.compress((k, i), g)
                      for i, g in enumerate(vs)]
             agg = _aggregate(v)
+            if self._dist_active():
+                # ≙ dist_sync: the server's sum over workers. Every process
+                # contributes its local aggregate and receives the global
+                # sum, so updater/optimizer runs identically everywhere.
+                agg = self._cross_process_sum(agg)
             if self._updater is not None:
                 if k not in self._store:
                     self._store[k] = _one(v).copy()
@@ -189,9 +226,13 @@ class KVStore(KVStoreBase):
         self._opt_states = {k: _from_np_state(s) for k, s in data.items()}
 
     def barrier(self):
-        """≙ KVStore::Barrier."""
+        """≙ KVStore::Barrier: local completion + (in dist mode) a real
+        cross-process rendezvous."""
         from ..ndarray import waitall
         waitall()
+        if self._dist_active():
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mx_kvstore_barrier")
 
     def _send_command_to_servers(self, head, body):
         pass  # no server processes in the SPMD runtime

@@ -1,0 +1,55 @@
+"""2-process KVStore correctness (≙ reference tests/nightly/
+dist_sync_kvstore.py:66-101: each worker pushes rank-dependent values and
+every worker must observe the server-side sum).
+
+Launched by tools/launch.py:
+
+    PYTHONPATH= python tools/launch.py -n 2 --env JAX_PLATFORMS=cpu \
+        --env PYTHONPATH= python tests/nightly/dist_kvstore.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+
+def main():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import kvstore, parallel
+
+    parallel.initialize()
+    rank, world = parallel.rank(), parallel.world_size()
+    assert world > 1, "run under tools/launch.py"
+
+    kv = kvstore.create("dist_sync")
+    assert kv.rank == rank and kv.num_workers == world
+
+    # init: rank 0's value wins everywhere (server-side copy semantics)
+    kv.init("w", mx.np.full((4,), float(rank + 10)))
+    out = mx.np.zeros((4,))
+    kv.pull("w", out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((4,), 10.0))
+
+    # push: every worker contributes (rank+1); the stored value becomes the
+    # cross-process sum on EVERY process
+    kv.push("w", mx.np.full((4,), float(rank + 1)))
+    kv.pull("w", out)
+    expect = float(sum(r + 1 for r in range(world)))
+    np.testing.assert_allclose(out.asnumpy(), np.full((4,), expect))
+
+    # pushpull fused
+    kv.init("g", mx.np.zeros((3,)))
+    o2 = mx.np.zeros((3,))
+    kv.pushpull("g", mx.np.full((3,), float(rank)), out=o2)
+    np.testing.assert_allclose(
+        o2.asnumpy(), np.full((3,), float(sum(range(world)))))
+
+    kv.barrier()
+    print(f"rank {rank}/{world}: dist kvstore OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -245,6 +245,28 @@ def test_multiprocess_dist_sync_launcher():
                                               proc.stderr[-2000:])
 
 
+def test_multiprocess_dist_kvstore():
+    """2 real processes: kvstore push/pull/pushpull/barrier perform actual
+    cross-process aggregation (≙ reference dist_sync_kvstore nightly)."""
+    import subprocess
+    import sys
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""  # skip the axon sitecustomize: it pre-inits PJRT
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"), "-n", "2",
+         "--env", "JAX_PLATFORMS=cpu", "--env", "PYTHONPATH=",
+         sys.executable, os.path.join(repo, "tests", "nightly",
+                                      "dist_kvstore.py")],
+        env=env, capture_output=True, text=True, timeout=240)
+    ok = proc.stdout.count("dist kvstore OK")
+    assert proc.returncode == 0 and ok == 2, (proc.stdout[-2000:],
+                                              proc.stderr[-2000:])
+
+
 def test_moe_expert_parallel_matches_dense():
     """Top-1 MoE over ep=4 with ample capacity == routing each token through
     its argmax expert directly (the last parallelism mode: EP)."""
