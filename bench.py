@@ -31,7 +31,18 @@ def _make_net(layout):
     return net
 
 
-def bench_resnet50_train(batch_size=32, iters=12, warmup=3, layout="NHWC",
+def _input_pool(batch_size, layout, n=6):
+    """Distinct input batches, cycled during timing. Timing loops must not
+    re-dispatch an identical (executable, buffers) pair — transport layers
+    may dedupe those, yielding fantasy throughput."""
+    import incubator_mxnet_tpu as mx
+    shape = ((batch_size, 3, 224, 224) if layout == "NCHW"
+             else (batch_size, 224, 224, 3))
+    return [mx.np.array(np.random.uniform(-1, 1, shape).astype(np.float32))
+            for _ in range(n)]
+
+
+def bench_resnet50_train(batch_size=32, iters=18, warmup=3, layout="NHWC",
                          use_amp=True):
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import amp, gluon
@@ -44,25 +55,23 @@ def bench_resnet50_train(batch_size=32, iters=12, warmup=3, layout="NHWC",
         trainer = gluon.Trainer(net.collect_params(), "sgd",
                                 {"learning_rate": 0.05, "momentum": 0.9})
 
-        shape = ((batch_size, 3, 224, 224) if layout == "NCHW"
-                 else (batch_size, 224, 224, 3))
-        x = mx.np.array(np.random.uniform(-1, 1, shape).astype(np.float32))
+        xs = _input_pool(batch_size, layout)
         y = mx.np.array(np.random.randint(0, 1000, (batch_size,)))
 
-        def step():
+        def step(i):
             with mx.autograd.record():
-                out = net(x)
+                out = net(xs[i % len(xs)])
                 L = loss_fn(out, y).mean()
             L.backward()
             trainer.step(batch_size, ignore_stale_grad=True)
             return L
 
-        for _ in range(warmup):
-            step().wait_to_read()
+        for i in range(warmup):
+            step(i).wait_to_read()
         mx.waitall()
         t0 = time.perf_counter()
-        for _ in range(iters):
-            L = step()
+        for i in range(iters):
+            L = step(i)
         L.wait_to_read()
         mx.waitall()
         dt = time.perf_counter() - t0
@@ -79,19 +88,23 @@ def bench_resnet50_infer(batch_size=32, iters=30, warmup=5, layout="NHWC"):
     amp.init("bfloat16")
     try:
         net = _make_net(layout)
-        shape = ((batch_size, 3, 224, 224) if layout == "NCHW"
-                 else (batch_size, 224, 224, 3))
-        x = mx.np.array(np.random.uniform(-1, 1, shape).astype(np.float32))
-
-        for _ in range(warmup):
-            net(x).wait_to_read()
+        # params don't change in inference, so every timed dispatch must see
+        # fresh input buffers/values; perturbing in place (a functional
+        # update -> new buffer) keeps device residency at a constant 6
+        # batches instead of O(iters)
+        xs = _input_pool(batch_size, layout)
+        outs = []
+        for i in range(warmup):
+            net(xs[i % len(xs)]).wait_to_read()
         mx.waitall()
         t0 = time.perf_counter()
-        for _ in range(iters):
-            out = net(x)
-        out.wait_to_read()
+        for i in range(iters):
+            j = i % len(xs)
+            xs[j] = xs[j] + 1e-6
+            outs.append(net(xs[j]))
         mx.waitall()
         dt = time.perf_counter() - t0
+        del outs
     finally:
         amp.uninit()
     return batch_size * iters / dt
