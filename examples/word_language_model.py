@@ -85,6 +85,9 @@ def main():
     ap.add_argument("--bptt", type=int, default=35)
     ap.add_argument("--lr", type=float, default=1.0)
     ap.add_argument("--clip", type=float, default=0.25)
+    ap.add_argument("--no-fused", action="store_true",
+                    help="use the eager tape path instead of the fused "
+                         "one-XLA-program BPTT step")
     args = ap.parse_args()
 
     ids, vocab = get_corpus(args.data)
@@ -96,29 +99,66 @@ def main():
     model.initialize(init="xavier")
     model.hybridize()   # one XLA executable per (T, N) signature
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-    trainer = gluon.Trainer(model.collect_params(), "sgd",
-                            {"learning_rate": args.lr})
+
+    fused_step = None
+    if not args.no_fused:
+        # the whole truncated-BPTT step (fwd+loss+bwd+clip+SGD) as ONE
+        # jitted XLA program (≙ the reference's fused RNN training kernel,
+        # src/operator/rnn.cc — here the full step, not just the RNN)
+        from incubator_mxnet_tpu import optimizer as opt_mod
+        from incubator_mxnet_tpu.gluon.contrib import FusedTrainStep
+        h0, c0 = model.begin_state(args.batch_size)
+        _ = model(mx.np.array(data[:args.bptt]), h0, c0)  # resolve shapes
+        # identical math to the eager path below: grad of the mean loss,
+        # clipped at clip*batch_size, then rescaled 1/batch_size in the
+        # update (Trainer.step(batch_size) semantics)
+        opt = opt_mod.create("sgd", learning_rate=args.lr,
+                             rescale_grad=1.0 / args.batch_size)
+
+        def fn(net, x, y, h, c):
+            out, h2, c2 = net(x, h, c)
+            # reference semantics: backward of the unreduced per-token loss
+            # vector = grad of the SUM; the optimizer's 1/batch rescale then
+            # makes the effective objective mean_loss * bptt
+            return loss_fn(out, y).sum(), h2, c2
+
+        fused_step = FusedTrainStep(model, fn, opt,
+                                    clip_global_norm=args.clip
+                                    * args.batch_size)
+    else:
+        trainer = gluon.Trainer(model.collect_params(), "sgd",
+                                {"learning_rate": args.lr})
 
     for epoch in range(args.epochs):
         h, c = model.begin_state(args.batch_size)
-        total_loss, n_batches = 0.0, 0
+        losses, n_batches = [], 0
         t0 = time.time()
         for i in range(0, data.shape[0] - 1 - args.bptt, args.bptt):
             x = mx.np.array(data[i:i + args.bptt])
             y = mx.np.array(data[i + 1:i + 1 + args.bptt].reshape(-1))
-            h, c = h.detach(), c.detach()
-            with mx.autograd.record():
-                out, h, c = model(x, h, c)
-                L = loss_fn(out, y).mean()
-            L.backward()
-            grads = [p.grad() for p in model.collect_params().values()
-                     if p.grad_req != "null"]
-            mx.npx.clip_by_global_norm(grads, args.clip * args.batch_size)
-            trainer.step(args.batch_size)
-            total_loss += float(L.asnumpy())
+            n_tok = args.bptt * args.batch_size
+            if fused_step is not None:
+                L, h, c = fused_step(x, y, h, c)
+                losses.append(L / n_tok)  # device-side; no per-step sync
+            else:
+                h, c = h.detach(), c.detach()
+                with mx.autograd.record():
+                    out, h, c = model(x, h, c)
+                    L = loss_fn(out, y).sum()
+                L.backward()
+                L = L / n_tok
+                grads = [p.grad() for p in model.collect_params().values()
+                         if p.grad_req != "null"]
+                mx.npx.clip_by_global_norm(grads, args.clip * args.batch_size)
+                trainer.step(args.batch_size)
+                losses.append(L)
             n_batches += 1
+        if losses:
+            losses[-1].wait_to_read()
+        dt = time.time() - t0  # before the epoch-loss sync loop
+        total_loss = float(sum(float(l.asnumpy()) for l in losses))
         ppl = math.exp(total_loss / max(n_batches, 1))
-        tok_s = n_batches * args.bptt * args.batch_size / (time.time() - t0)
+        tok_s = n_batches * args.bptt * args.batch_size / dt
         print(f"epoch {epoch}: perplexity={ppl:.1f} ({tok_s:.0f} tokens/s)")
 
 

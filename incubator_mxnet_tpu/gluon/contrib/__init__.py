@@ -1,2 +1,3 @@
 """gluon.contrib (≙ python/mxnet/gluon/contrib): estimator + extras."""
 from . import estimator
+from .fused import FusedTrainStep

@@ -1,0 +1,204 @@
+"""FusedTrainStep — one XLA program for fwd + loss + bwd + clip + update.
+
+TPU-native counterpart of the reference's fused-RNN training capability
+(src/operator/rnn.cc: the whole BPTT step as one kernel) generalized to ANY
+HybridBlock: the forward, the loss, the backward, global-norm clipping and
+the optimizer update all compile into a single jitted computation with
+donated parameter/state buffers. No per-op dispatch, no per-step tape, no
+host round-trips inside the step.
+
+    step = FusedTrainStep(net, fn, optimizer)        # fn(net, *inputs)
+    loss, *extras = step(x, y, ...)                  # one XLA execution
+
+`fn` receives the live net and the step inputs and returns a scalar loss
+NDArray (or a tuple (loss, *extras) — extras pass through untouched, e.g.
+recurrent states). Optimizers whose `step_one` kernels are pure traceable
+functions work (the same eligibility as the multi-tensor fused update
+path); host-stateful rules (SGLD, Nadam) and multi_precision are
+rejected at construction — use gluon.Trainer for those.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+
+__all__ = ["FusedTrainStep"]
+
+
+class FusedTrainStep:
+    def __init__(self, net, fn, optimizer, clip_global_norm=None):
+        from ... import optimizer as opt_mod
+        optimizer = opt_mod.create(optimizer)
+        # same eligibility rules as the multi-tensor fused path
+        # (optimizer/__init__.py fused_update_all): host-stateful rules
+        # (SGLD's per-step noise key, Nadam's m_schedule) would be baked
+        # in as trace-time constants, multi-precision needs the
+        # update_multi_precision flow, and subclasses overriding update()
+        # expect to be called per-param on the host.
+        if not getattr(optimizer, "_fused_safe", True):
+            raise MXNetError(
+                f"{type(optimizer).__name__} keeps per-step host state and "
+                "cannot be traced into one program; use gluon.Trainer")
+        if optimizer.multi_precision:
+            raise MXNetError(
+                "multi_precision optimizers are not supported by "
+                "FusedTrainStep yet; use gluon.Trainer")
+        if (type(optimizer).update is not opt_mod.Optimizer.update
+                or type(optimizer).update_multi_precision
+                is not opt_mod.Optimizer.update_multi_precision):
+            raise MXNetError(
+                f"{type(optimizer).__name__} overrides update(); the "
+                "extension point runs per-param on the host — use "
+                "gluon.Trainer")
+        self._net = net
+        self._fn = fn
+        self._opt = optimizer
+        self._clip = clip_global_norm
+        params = [p for _, p in sorted(net.collect_params().items())]
+        for p in params:
+            if p._data is None:
+                raise MXNetError(
+                    "FusedTrainStep needs a fully initialized net: run one "
+                    "forward pass first (deferred shapes must be resolved)")
+        self._params = params
+        self._train_idx = [i for i, p in enumerate(params)
+                           if p.grad_req != "null"]
+        self._frozen_idx = [i for i, p in enumerate(params)
+                            if p.grad_req == "null"]
+        self._states = None
+        self._jit = None
+        self._meta = {"aux_idx": None}  # frozen params mutated in forward
+
+    # ------------------------------------------------------------------
+    def _ensure_states(self):
+        if self._states is None:
+            self._states = [
+                self._opt.create_state_multi_precision(
+                    i, self._params[i].data())
+                for i in self._train_idx]
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        from ... import autograd, random as _random
+        from ...ndarray import _wrap
+        from ...optimizer import _state_bufs, _wrap_state
+
+        params = self._params
+        train_idx, frozen_idx = self._train_idx, self._frozen_idx
+        net, fn, opt, clip = self._net, self._fn, self._opt, self._clip
+        takes_t = type(opt)._step_takes_t()
+        meta = self._meta
+
+        def step(train_bufs, sbufs, frozen_bufs, key, lrs, wds, rescale, ts,
+                 *in_raw):
+            def loss_of(tbufs):
+                full = [None] * len(params)
+                for k, i in enumerate(train_idx):
+                    full[i] = tbufs[k]
+                for k, i in enumerate(frozen_idx):
+                    full[i] = frozen_bufs[k]
+                saved = []
+                for p, buf in zip(params, full):
+                    nd = p.data()
+                    saved.append(nd._data)
+                    nd._data = buf
+                    nd._version += 1
+                try:
+                    with autograd._Scope(recording=False, training=True), \
+                            _random.trace_key_scope(key):
+                        out = fn(net, *[_wrap(r) for r in in_raw])
+                    if isinstance(out, (tuple, list)):
+                        loss, extras = out[0], tuple(out[1:])
+                    else:
+                        loss, extras = out, ()
+                    loss_raw = loss._arr
+                    extras_raw = tuple(e._arr for e in extras)
+                    # aux state written during forward (BN running stats
+                    # live on grad_req='null' params); which indices mutate
+                    # is a trace-time constant, recorded once in meta
+                    mutated = {}
+                    for i, (p, buf) in enumerate(zip(params, full)):
+                        cur = p.data()._data
+                        if cur is not buf:
+                            mutated[i] = cur
+                    if meta["aux_idx"] is None:
+                        meta["aux_idx"] = tuple(sorted(mutated))
+                    aux_bufs = tuple(mutated[i] for i in sorted(mutated))
+                finally:
+                    for p, old in zip(params, saved):
+                        p.data()._data = old
+                return loss_raw, (extras_raw, aux_bufs)
+
+            (loss, (extras, aux_bufs)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(list(train_bufs))
+
+            if clip is not None:
+                total = jnp.zeros((), jnp.float32)
+                for g in grads:
+                    total = total + jnp.sum(jnp.square(g.astype(jnp.float32)))
+                norm = jnp.sqrt(total)
+                scale = jnp.minimum(
+                    1.0, clip / jnp.maximum(norm, 1e-12))
+                grads = [g * scale.astype(g.dtype) for g in grads]
+
+            prev = opt.rescale_grad
+            opt.rescale_grad = rescale  # traced; inner kernels key on it
+            try:
+                new_w, new_s = [], []
+                for k, i in enumerate(train_idx):
+                    w = _wrap(train_bufs[k])
+                    g = _wrap(grads[k])
+                    st = _wrap_state(sbufs[k])
+                    if takes_t:
+                        opt.step_one(i, w, g, st, lrs[k], wds[k], t=ts[k])
+                    else:
+                        opt.step_one(i, w, g, st, lrs[k], wds[k])
+                    new_w.append(w._arr)
+                    new_s.append(_state_bufs(st))
+            finally:
+                opt.rescale_grad = prev
+            return new_w, new_s, loss, extras, aux_bufs
+
+        # donate only the trainable weight + optimizer-state buffers; frozen
+        # params keep their buffers live across calls
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+    def __call__(self, *inputs):
+        from ... import random as _random
+        from ...ndarray import NDArray, _wrap
+        from ...optimizer import _state_bufs, _state_restore
+
+        self._ensure_states()
+        if self._jit is None:
+            self._jit = self._build()
+        opt = self._opt
+        for i in self._train_idx:
+            opt._update_count(i)
+        lrs = _np.asarray([opt._get_lr(i) for i in self._train_idx],
+                          _np.float32)
+        wds = _np.asarray([opt._get_wd(i) for i in self._train_idx],
+                          _np.float32)
+        ts = (_np.asarray([opt._index_update_count[i]
+                           for i in self._train_idx], _np.float32)
+              if type(opt)._step_takes_t() else None)
+        key = _random.next_key()
+        train_bufs = [self._params[i].data()._arr for i in self._train_idx]
+        frozen_bufs = [self._params[i].data()._arr for i in self._frozen_idx]
+        sbufs = [_state_bufs(s) for s in self._states]
+        in_raw = tuple(a._arr if isinstance(a, NDArray) else a
+                       for a in inputs)
+
+        new_w, new_s, loss, extras, aux_bufs = self._jit(
+            train_bufs, sbufs, frozen_bufs, key, lrs, wds,
+            _np.float32(opt.rescale_grad), ts, *in_raw)
+
+        for k, i in enumerate(self._train_idx):
+            self._params[i].data()._set_arr(new_w[k])
+            _state_restore(self._states[k], new_s[k])
+        for i, buf in zip(self._meta["aux_idx"], aux_bufs):
+            self._params[i].data()._set_arr(buf)
+        out = (_wrap(loss),) + tuple(_wrap(e) for e in extras)
+        return out if len(out) > 1 else out[0]
