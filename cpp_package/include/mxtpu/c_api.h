@@ -1,0 +1,94 @@
+/*
+ * mxtpu C ABI — the stable non-Python boundary of incubator_mxnet_tpu.
+ *
+ * Role parity: /root/reference/include/mxnet/c_api.h (240 functions over
+ * the C++ runtime) + c_predict_api.h (predictor subset). Here the runtime
+ * is the JAX/XLA/PJRT stack; libmxtpu.so embeds it once per process and
+ * exposes the same capability axes a deployment consumer needs:
+ *
+ *   - error handling:    MXGetLastError (thread-local, reference semantics)
+ *   - NDArray:           create/free/shape/dtype/copy-out  (c_api.h:603+)
+ *   - imperative ops:    MXImperativeInvoke — any registered operator by
+ *                        name with JSON kwargs (c_api_ndarray.cc:91)
+ *   - predictor:         MXPredCreate/Forward/GetOutput/Free over the
+ *                        HybridBlock.export artifact triple
+ *                        (c_predict_api.h:57-166)
+ *
+ * Threading: every entry point may be called from any thread; the library
+ * serializes through the embedded interpreter (GIL) while PJRT executions
+ * themselves run released. Multi-threaded inference over one predictor is
+ * supported (≙ example/multi_threaded_inference).
+ *
+ * Environment: the embedded runtime resolves Python packages via the
+ * standard PYTHONPATH; point it at the framework and its site-packages
+ * when running outside a venv.
+ *
+ * All functions return 0 on success, -1 on failure (then consult
+ * MXGetLastError()).
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *NDArrayHandle;
+typedef void *PredictorHandle;
+
+/* dtype codes follow the reference's mshadow enumeration: 0=float32,
+ * 1=float64, 2=float16, 3=uint8, 4=int32, 5=int8, 6=int64, 7=bool,
+ * 8=int16, 9=uint16, 10=uint32, 11=uint64, 12=bfloat16. */
+
+/* ---- runtime ---------------------------------------------------------- */
+int MXTPUInit(void);          /* optional: force interpreter bring-up now  */
+int MXTPUShutdown(void);      /* optional: finalize (process end only)     */
+const char *MXGetLastError(void);
+int MXGetVersion(int *out);   /* e.g. 10100 for 1.1.0                      */
+int MXNDArrayWaitAll(void);
+
+/* ---- NDArray ---------------------------------------------------------- */
+int MXNDArrayCreate(const void *data, const int64_t *shape, int ndim,
+                    int dtype, NDArrayHandle *out);
+int MXNDArrayZeros(const int64_t *shape, int ndim, int dtype,
+                   NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArrayGetNDim(NDArrayHandle handle, int *out);
+int MXNDArrayGetShape(NDArrayHandle handle, int64_t *out_shape);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out);
+/* copy the full array to host memory; nbytes must equal the array size */
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t nbytes);
+
+/* ---- imperative operator invoke --------------------------------------- */
+/* Invoke any operator registered in the np/npx/nd namespaces. kwargs_json
+ * is a JSON object of keyword arguments ("" or NULL for none). *outputs is
+ * a library-allocated handle array of *num_outputs entries; release it
+ * with MXFreeHandleArray (which frees the array, not the handles). */
+int MXImperativeInvoke(const char *op_name, int num_inputs,
+                       NDArrayHandle *inputs, const char *kwargs_json,
+                       int *num_outputs, NDArrayHandle **outputs);
+int MXFreeHandleArray(NDArrayHandle *arr);
+
+/* ---- predictor (HybridBlock.export consumer) -------------------------- */
+/* prefix form: "path/net-0000"; triple form: explicit artifact paths. */
+int MXPredCreateFromPrefix(const char *prefix, PredictorHandle *out);
+int MXPredCreate(const char *jaxport_file, const char *params_file,
+                 const char *manifest_file, PredictorHandle *out);
+int MXPredGetNumInputs(PredictorHandle handle, int *out);
+/* shape buffer must hold at least MXTPU_MAX_NDIM entries */
+#define MXTPU_MAX_NDIM 16
+int MXPredGetInputSpec(PredictorHandle handle, int index,
+                       int64_t *out_shape, int *out_ndim, int *out_dtype);
+int MXPredForward(PredictorHandle handle, int num_inputs,
+                  NDArrayHandle *inputs, int *num_outputs,
+                  NDArrayHandle **outputs);
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_API_H_ */

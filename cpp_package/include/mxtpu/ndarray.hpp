@@ -1,0 +1,152 @@
+/*
+ * mxtpu::NDArray — RAII C++ frontend over the mxtpu C ABI.
+ *
+ * Role parity: /root/reference/cpp-package/include/mxnet-cpp/ndarray.hpp
+ * (the header-only C++ NDArray riding c_api.h). Same shape of API:
+ * construct from host data, query shape/dtype, arithmetic via operator
+ * invoke, synchronous copy-out. All device work happens behind the ABI in
+ * the embedded XLA runtime.
+ */
+#ifndef MXTPU_NDARRAY_HPP_
+#define MXTPU_NDARRAY_HPP_
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "c_api.h"
+
+namespace mxtpu {
+
+enum class DType : int {
+  kFloat32 = 0, kFloat64 = 1, kFloat16 = 2, kUint8 = 3,
+  kInt32 = 4, kInt8 = 5, kInt64 = 6, kBool = 7,
+  kInt16 = 8, kUint16 = 9, kUint32 = 10, kUint64 = 11, kBfloat16 = 12,
+};
+
+inline void check(int rc, const char *what) {
+  if (rc != 0)
+    throw std::runtime_error(std::string(what) + ": " + MXGetLastError());
+}
+
+inline size_t dtype_size(DType t) {
+  static const size_t s[] = {4, 8, 2, 1, 4, 1, 8, 1, 2, 2, 4, 8, 2};
+  return s[static_cast<int>(t)];
+}
+
+class NDArray {
+ public:
+  NDArray() = default;
+  // Takes ownership of an ABI handle.
+  explicit NDArray(NDArrayHandle h) : h_(h) {}
+
+  NDArray(const void *data, const std::vector<int64_t> &shape, DType dtype) {
+    check(MXNDArrayCreate(data, shape.data(),
+                          static_cast<int>(shape.size()),
+                          static_cast<int>(dtype), &h_),
+          "MXNDArrayCreate");
+  }
+
+  static NDArray Zeros(const std::vector<int64_t> &shape,
+                       DType dtype = DType::kFloat32) {
+    NDArrayHandle h = nullptr;
+    check(MXNDArrayZeros(shape.data(), static_cast<int>(shape.size()),
+                         static_cast<int>(dtype), &h),
+          "MXNDArrayZeros");
+    return NDArray(h);
+  }
+
+  ~NDArray() { reset(); }
+  NDArray(const NDArray &) = delete;
+  NDArray &operator=(const NDArray &) = delete;
+  NDArray(NDArray &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  NDArray &operator=(NDArray &&o) noexcept {
+    if (this != &o) { reset(); h_ = o.h_; o.h_ = nullptr; }
+    return *this;
+  }
+
+  NDArrayHandle handle() const { return h_; }
+  bool valid() const { return h_ != nullptr; }
+
+  std::vector<int64_t> shape() const {
+    int nd = 0;
+    check(MXNDArrayGetNDim(h_, &nd), "MXNDArrayGetNDim");
+    std::vector<int64_t> s(nd);
+    if (nd) check(MXNDArrayGetShape(h_, s.data()), "MXNDArrayGetShape");
+    return s;
+  }
+
+  DType dtype() const {
+    int c = 0;
+    check(MXNDArrayGetDType(h_, &c), "MXNDArrayGetDType");
+    return static_cast<DType>(c);
+  }
+
+  int64_t size() const {
+    int64_t n = 1;
+    for (int64_t d : shape()) n *= d;
+    return n;
+  }
+
+  // Synchronous full copy to a host vector (T must match dtype width).
+  template <typename T>
+  std::vector<T> copy_to_host() const {
+    std::vector<T> out(static_cast<size_t>(size()));
+    check(MXNDArraySyncCopyToCPU(h_, out.data(), out.size() * sizeof(T)),
+          "MXNDArraySyncCopyToCPU");
+    return out;
+  }
+
+  void reset() {
+    if (h_) { MXNDArrayFree(h_); h_ = nullptr; }
+  }
+
+ private:
+  NDArrayHandle h_ = nullptr;
+};
+
+// Invoke any registered operator; returns all outputs.
+inline std::vector<NDArray> invoke(const std::string &op,
+                                   const std::vector<const NDArray *> &inputs,
+                                   const std::string &kwargs_json = "") {
+  std::vector<NDArrayHandle> in;
+  in.reserve(inputs.size());
+  for (const NDArray *a : inputs) in.push_back(a->handle());
+  int n_out = 0;
+  NDArrayHandle *outs = nullptr;
+  check(MXImperativeInvoke(op.c_str(), static_cast<int>(in.size()),
+                           in.data(), kwargs_json.c_str(), &n_out, &outs),
+        "MXImperativeInvoke");
+  std::vector<NDArray> result;
+  result.reserve(n_out);
+  for (int i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
+  MXFreeHandleArray(outs);
+  return result;
+}
+
+inline NDArray invoke1(const std::string &op,
+                       const std::vector<const NDArray *> &inputs,
+                       const std::string &kwargs_json = "") {
+  auto outs = invoke(op, inputs, kwargs_json);
+  if (outs.empty()) throw std::runtime_error(op + ": no outputs");
+  return std::move(outs[0]);
+}
+
+inline NDArray operator+(const NDArray &a, const NDArray &b) {
+  return invoke1("add", {&a, &b});
+}
+inline NDArray operator-(const NDArray &a, const NDArray &b) {
+  return invoke1("subtract", {&a, &b});
+}
+inline NDArray operator*(const NDArray &a, const NDArray &b) {
+  return invoke1("multiply", {&a, &b});
+}
+inline NDArray dot(const NDArray &a, const NDArray &b) {
+  return invoke1("dot", {&a, &b});
+}
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_NDARRAY_HPP_

@@ -1,0 +1,84 @@
+/*
+ * mxtpu::Predictor — RAII C++ inference frontend over the mxtpu C ABI.
+ *
+ * Role parity: the reference's c_predict_api.h consumer pattern
+ * (/root/reference/include/mxnet/c_predict_api.h:57-166 and
+ * example/multi_threaded_inference/). Loads a `HybridBlock.export`
+ * artifact triple and serves forward passes; safe to share across threads
+ * (the ABI serializes through the embedded runtime, executions run on the
+ * XLA device asynchronously).
+ */
+#ifndef MXTPU_PREDICTOR_HPP_
+#define MXTPU_PREDICTOR_HPP_
+
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "c_api.h"
+#include "ndarray.hpp"
+
+namespace mxtpu {
+
+struct InputSpec {
+  std::vector<int64_t> shape;
+  DType dtype;
+};
+
+class Predictor {
+ public:
+  // prefix form: "path/net-0000" (expects .jaxport/.params.npz/.deploy.json)
+  explicit Predictor(const std::string &prefix) {
+    check(MXPredCreateFromPrefix(prefix.c_str(), &h_),
+          "MXPredCreateFromPrefix");
+  }
+  Predictor(const std::string &jaxport, const std::string &params,
+            const std::string &manifest) {
+    check(MXPredCreate(jaxport.c_str(), params.c_str(), manifest.c_str(),
+                       &h_),
+          "MXPredCreate");
+  }
+  ~Predictor() {
+    if (h_) MXPredFree(h_);
+  }
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+
+  int num_inputs() const {
+    int n = 0;
+    check(MXPredGetNumInputs(h_, &n), "MXPredGetNumInputs");
+    return n;
+  }
+
+  InputSpec input_spec(int i) const {
+    int64_t shape[MXTPU_MAX_NDIM];
+    int ndim = 0, dtype = 0;
+    check(MXPredGetInputSpec(h_, i, shape, &ndim, &dtype),
+          "MXPredGetInputSpec");
+    return InputSpec{std::vector<int64_t>(shape, shape + ndim),
+                     static_cast<DType>(dtype)};
+  }
+
+  std::vector<NDArray> forward(const std::vector<const NDArray *> &inputs) {
+    std::vector<NDArrayHandle> in;
+    in.reserve(inputs.size());
+    for (const NDArray *a : inputs) in.push_back(a->handle());
+    int n_out = 0;
+    NDArrayHandle *outs = nullptr;
+    check(MXPredForward(h_, static_cast<int>(in.size()), in.data(), &n_out,
+                        &outs),
+          "MXPredForward");
+    std::vector<NDArray> result;
+    result.reserve(n_out);
+    for (int i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
+    MXFreeHandleArray(outs);
+    return result;
+  }
+
+ private:
+  PredictorHandle h_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_PREDICTOR_HPP_

@@ -1,0 +1,144 @@
+/*
+ * Pure-C smoke test of the mxtpu C ABI (role parity: the reference's
+ * C-API tests and example/image-classification/predict-cpp).
+ *
+ * usage: test_c_api [export_prefix out_bin]
+ *
+ * Always: version check, NDArray round-trip, imperative op invoke.
+ * With arguments: load the exported predictor, run forward on a
+ * deterministic ramp input, write the raw float32 output to out_bin for
+ * the Python harness to compare bit-exactly.
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu/c_api.h"
+
+#define CHECK(rc, what)                                               \
+  do {                                                                \
+    if ((rc) != 0) {                                                  \
+      fprintf(stderr, "FAIL %s: %s\n", (what), MXGetLastError());     \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+int main(int argc, char **argv) {
+  CHECK(MXTPUInit(), "MXTPUInit");
+
+  int version = 0;
+  CHECK(MXGetVersion(&version), "MXGetVersion");
+  if (version <= 0) {
+    fprintf(stderr, "FAIL bad version %d\n", version);
+    return 1;
+  }
+
+  /* NDArray round-trip + imperative invoke: c = a + b, then dot. */
+  float a_data[6] = {1, 2, 3, 4, 5, 6};
+  float b_data[6] = {10, 20, 30, 40, 50, 60};
+  int64_t shape[2] = {2, 3};
+  NDArrayHandle a, b;
+  CHECK(MXNDArrayCreate(a_data, shape, 2, 0, &a), "create a");
+  CHECK(MXNDArrayCreate(b_data, shape, 2, 0, &b), "create b");
+
+  int ndim = 0, dtype = -1;
+  CHECK(MXNDArrayGetNDim(a, &ndim), "ndim");
+  CHECK(MXNDArrayGetDType(a, &dtype), "dtype");
+  if (ndim != 2 || dtype != 0) {
+    fprintf(stderr, "FAIL ndim/dtype %d %d\n", ndim, dtype);
+    return 1;
+  }
+
+  NDArrayHandle *outs = NULL;
+  int n_out = 0;
+  NDArrayHandle add_in[2];
+  add_in[0] = a;
+  add_in[1] = b;
+  CHECK(MXImperativeInvoke("add", 2, add_in, NULL, &n_out, &outs),
+        "invoke add");
+  if (n_out != 1) {
+    fprintf(stderr, "FAIL add n_out=%d\n", n_out);
+    return 1;
+  }
+  float c_data[6];
+  CHECK(MXNDArraySyncCopyToCPU(outs[0], c_data, sizeof(c_data)), "copy c");
+  for (int i = 0; i < 6; ++i) {
+    if (c_data[i] != a_data[i] + b_data[i]) {
+      fprintf(stderr, "FAIL add[%d]=%f\n", i, c_data[i]);
+      return 1;
+    }
+  }
+  CHECK(MXNDArrayFree(outs[0]), "free c");
+  CHECK(MXFreeHandleArray(outs), "free outs");
+
+  /* kwargs path: sum over axis 1 keeps shape (2,1) */
+  CHECK(MXImperativeInvoke("sum", 1, &a, "{\"axis\": 1, \"keepdims\": true}",
+                           &n_out, &outs),
+        "invoke sum");
+  int64_t sshape[2];
+  CHECK(MXNDArrayGetShape(outs[0], sshape), "sum shape");
+  if (sshape[0] != 2 || sshape[1] != 1) {
+    fprintf(stderr, "FAIL sum shape %ld %ld\n", (long)sshape[0],
+            (long)sshape[1]);
+    return 1;
+  }
+  MXNDArrayFree(outs[0]);
+  MXFreeHandleArray(outs);
+  MXNDArrayFree(a);
+  MXNDArrayFree(b);
+
+  if (argc >= 3) {
+    PredictorHandle pred;
+    CHECK(MXPredCreateFromPrefix(argv[1], &pred), "MXPredCreateFromPrefix");
+    int n_in = 0;
+    CHECK(MXPredGetNumInputs(pred, &n_in), "num inputs");
+    if (n_in != 1) {
+      fprintf(stderr, "FAIL n_in=%d\n", n_in);
+      return 1;
+    }
+    int64_t in_shape[MXTPU_MAX_NDIM];
+    int in_ndim = 0, in_dtype = 0;
+    CHECK(MXPredGetInputSpec(pred, 0, in_shape, &in_ndim, &in_dtype),
+          "input spec");
+    int64_t n = 1;
+    for (int i = 0; i < in_ndim; ++i) n *= in_shape[i];
+    float *x = (float *)malloc(n * sizeof(float));
+    for (int64_t i = 0; i < n; ++i) x[i] = (float)(i % 13) * 0.25f - 1.0f;
+    NDArrayHandle xin;
+    CHECK(MXNDArrayCreate(x, in_shape, in_ndim, in_dtype, &xin), "x");
+    free(x);
+
+    NDArrayHandle *pouts = NULL;
+    int n_pout = 0;
+    CHECK(MXPredForward(pred, 1, &xin, &n_pout, &pouts), "forward");
+    if (n_pout < 1) {
+      fprintf(stderr, "FAIL n_pout=%d\n", n_pout);
+      return 1;
+    }
+    int ond = 0;
+    CHECK(MXNDArrayGetNDim(pouts[0], &ond), "out ndim");
+    int64_t oshape[MXTPU_MAX_NDIM];
+    CHECK(MXNDArrayGetShape(pouts[0], oshape), "out shape");
+    int64_t on = 1;
+    for (int i = 0; i < ond; ++i) on *= oshape[i];
+    float *y = (float *)malloc(on * sizeof(float));
+    CHECK(MXNDArraySyncCopyToCPU(pouts[0], y, on * sizeof(float)),
+          "out copy");
+    FILE *f = fopen(argv[2], "wb");
+    if (!f) {
+      fprintf(stderr, "FAIL open %s\n", argv[2]);
+      return 1;
+    }
+    fwrite(y, sizeof(float), on, f);
+    fclose(f);
+    free(y);
+    for (int i = 0; i < n_pout; ++i) MXNDArrayFree(pouts[i]);
+    MXFreeHandleArray(pouts);
+    MXNDArrayFree(xin);
+    CHECK(MXPredFree(pred), "pred free");
+  }
+
+  printf("C API OK (version %d)\n", version);
+  return 0;
+}

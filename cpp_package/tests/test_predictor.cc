@@ -1,0 +1,83 @@
+/*
+ * C++ frontend test (role parity: cpp-package tests + the
+ * multi_threaded_inference example): drives mxtpu::NDArray and
+ * mxtpu::Predictor, including concurrent forward passes from several
+ * threads over one shared predictor.
+ *
+ * usage: test_predictor <export_prefix> <out_bin>
+ * Writes the single-thread forward output (ramp input) to out_bin and
+ * self-checks that 4 threads produce bit-identical results.
+ */
+#include <cstdio>
+#include <thread>
+#include <vector>
+
+#include "mxtpu/ndarray.hpp"
+#include "mxtpu/predictor.hpp"
+
+using mxtpu::DType;
+using mxtpu::NDArray;
+using mxtpu::Predictor;
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <export_prefix> <out_bin>\n", argv[0]);
+    return 2;
+  }
+  try {
+    // NDArray algebra through the ABI
+    float ad[4] = {1, 2, 3, 4}, bd[4] = {5, 6, 7, 8};
+    NDArray a(ad, {2, 2}, DType::kFloat32);
+    NDArray b(bd, {2, 2}, DType::kFloat32);
+    auto s = (a + b).copy_to_host<float>();
+    for (int i = 0; i < 4; ++i)
+      if (s[i] != ad[i] + bd[i]) {
+        std::fprintf(stderr, "FAIL add[%d]=%f\n", i, s[i]);
+        return 1;
+      }
+    auto d = mxtpu::dot(a, b).copy_to_host<float>();
+    if (d[0] != 1 * 5 + 2 * 7) {
+      std::fprintf(stderr, "FAIL dot=%f\n", d[0]);
+      return 1;
+    }
+
+    Predictor pred(argv[1]);
+    auto spec = pred.input_spec(0);
+    int64_t n = 1;
+    for (int64_t v : spec.shape) n *= v;
+    std::vector<float> x(static_cast<size_t>(n));
+    for (int64_t i = 0; i < n; ++i)
+      x[static_cast<size_t>(i)] = static_cast<float>(i % 13) * 0.25f - 1.0f;
+    NDArray xin(x.data(), spec.shape, spec.dtype);
+
+    auto outs = pred.forward({&xin});
+    auto y0 = outs.at(0).copy_to_host<float>();
+
+    // multi-threaded inference over the shared predictor
+    std::vector<std::vector<float>> results(4);
+    std::vector<std::thread> threads;
+    for (int t = 0; t < 4; ++t) {
+      threads.emplace_back([&, t]() {
+        NDArray xt(x.data(), spec.shape, spec.dtype);
+        auto o = pred.forward({&xt});
+        results[static_cast<size_t>(t)] = o.at(0).copy_to_host<float>();
+      });
+    }
+    for (auto &th : threads) th.join();
+    for (int t = 0; t < 4; ++t)
+      if (results[static_cast<size_t>(t)] != y0) {
+        std::fprintf(stderr, "FAIL thread %d output differs\n", t);
+        return 1;
+      }
+
+    FILE *f = std::fopen(argv[2], "wb");
+    if (!f) return 1;
+    std::fwrite(y0.data(), sizeof(float), y0.size(), f);
+    std::fclose(f);
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "FAIL exception: %s\n", e.what());
+    return 1;
+  }
+  std::printf("C++ predictor OK\n");
+  return 0;
+}

@@ -38,7 +38,7 @@ from . import ops
 _LAZY = ("gluon", "optimizer", "kvstore", "parallel", "amp", "profiler",
          "initializer", "lr_scheduler", "metric", "test_utils", "util",
          "runtime", "io", "image", "engine", "context", "recordio",
-         "checkpoint", "visualization", "models", "native")
+         "checkpoint", "visualization", "models", "native", "deploy")
 
 
 def __getattr__(name):

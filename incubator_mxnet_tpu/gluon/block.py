@@ -497,6 +497,7 @@ class HybridBlock(Block):
             if meta["n_out"] is None:
                 meta["n_out"] = len(out_raw)
                 meta["aux_indices"] = sorted(mutated)
+                meta["single"] = single
                 if single:
                     meta["treedef"] = lambda outs: outs[0]
                 else:
@@ -572,9 +573,18 @@ class HybridBlock(Block):
 
         Always saves `<path>-<epoch>.params.npz` (weights by structural
         name); when `example_inputs` are given, additionally saves
-        `<path>-<epoch>.stablehlo.mlir` — the StableHLO module of the
-        inference forward, the portable deployment artifact replacing the
-        nnvm symbol JSON. Returns the tuple of file paths written."""
+
+          * `<path>-<epoch>.stablehlo.mlir` — StableHLO text of the
+            inference forward (the portable artifact replacing the nnvm
+            symbol JSON),
+          * `<path>-<epoch>.jaxport` — the versioned `jax.export`
+            serialization of the same module, lowered for cpu AND tpu
+            (the *executable* artifact: `deploy.ExportedModel`, the C ABI
+            in native/c_api.cc, and the C++ frontend all consume it),
+          * `<path>-<epoch>.deploy.json` — manifest (param order, input
+            avals, output arity) so a loader needs no Python model class.
+
+        Returns the tuple of file paths written."""
         import jax
         from ..ndarray import NDArray
         params_file = f"{path}-{epoch:04d}.params.npz"
@@ -602,6 +612,30 @@ class HybridBlock(Block):
             with open(hlo_file, "w") as f:
                 f.write(lowered.as_text(dialect="stablehlo"))
             outputs.append(hlo_file)
+
+            import json
+            import jax.export as _jexp
+            exported = _jexp.export(jit_fn, platforms=("cpu", "tpu"))(
+                pbufs, dummy_key, *in_raw)
+            port_file = f"{path}-{epoch:04d}.jaxport"
+            with open(port_file, "wb") as f:
+                f.write(exported.serialize())
+            outputs.append(port_file)
+
+            manifest = {
+                "format_version": 1,
+                "params": [name for name, _ in
+                           sorted(self.collect_params().items())],
+                "n_out": meta["n_out"],
+                "single_output": bool(meta.get("single", meta["n_out"] == 1)),
+                "aux_indices": list(meta["aux_indices"]),
+                "inputs": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                           for a in in_raw],
+            }
+            man_file = f"{path}-{epoch:04d}.deploy.json"
+            with open(man_file, "w") as f:
+                json.dump(manifest, f, indent=1)
+            outputs.append(man_file)
         return tuple(outputs)
 
     def forward(self, *args):
@@ -615,12 +649,39 @@ class HybridBlock(Block):
 
 class SymbolBlock(HybridBlock):
     """≙ gluon.SymbolBlock (block.py:1638). The reference wraps a saved
-    symbol graph; here a saved jitted function + params. Minimal: construct
-    from a HybridBlock export."""
+    symbol graph; here a saved exported module (`HybridBlock.export`
+    artifact triple) served by `deploy.ExportedModel`. `forward` executes
+    the compiled program — no Python model class required."""
+
+    def __init__(self, model):
+        super().__init__()
+        self._model = model
+
+    def forward(self, *args):
+        # Traceable path (call_arrays): works eagerly AND under a parent
+        # hybridize trace — the exported program composes into the outer
+        # XLA computation instead of forcing a host round-trip.
+        from ..ndarray import NDArray, _wrap
+        arrs = [a._arr if isinstance(a, NDArray) else a for a in args]
+        out_raw = self._model.call_arrays(*arrs)
+        outs = tuple(_wrap(o) for o in out_raw)
+        return outs[0] if self._model.single_output else outs
 
     @staticmethod
-    def imports(symbol_file, input_names, param_file=None, device=None):
-        raise MXNetError(
-            "SymbolBlock.imports: symbol-json graphs do not exist in the "
-            "TPU-native runtime; save/load Blocks with save_parameters + "
-            "source code, or use HybridBlock.export")
+    def imports(symbol_file, input_names=None, param_file=None, device=None):
+        """Load an export artifact. `symbol_file` is the export prefix
+        (`net-0000`) or the `.jaxport` path; `param_file`/manifest default
+        to the sibling artifact names. `input_names` is accepted for
+        reference-signature compatibility and unused (the manifest records
+        the input signature)."""
+        from ..deploy import ExportedModel
+        if symbol_file.endswith(".jaxport"):
+            prefix = symbol_file[:-len(".jaxport")]
+        elif symbol_file.endswith(".stablehlo.mlir"):
+            prefix = symbol_file[:-len(".stablehlo.mlir")]
+        else:
+            prefix = symbol_file
+        model = ExportedModel(
+            prefix,
+            params=param_file if param_file else None)
+        return SymbolBlock(model)

@@ -62,6 +62,10 @@ class FusedTrainStep:
                     "FusedTrainStep needs a fully initialized net: run one "
                     "forward pass first (deferred shapes must be resolved)")
         self._params = params
+        # same per-parameter lr_mult/wd_mult plumbing as gluon.Trainer
+        # (trainer.py:48-57): _get_lr/_get_wd resolve multipliers through
+        # optimizer.param_dict
+        optimizer.param_dict = {i: p for i, p in enumerate(params)}
         self._train_idx = [i for i, p in enumerate(params)
                            if p.grad_req != "null"]
         self._frozen_idx = [i for i, p in enumerate(params)

@@ -25,6 +25,43 @@ def _compile(src, out):
     subprocess.run(cmd, check=True, capture_output=True)
 
 
+def build_capi():
+    """Build (caching) libmxtpu.so — the C ABI over the embedded runtime
+    (see cpp_package/include/mxtpu/c_api.h). Returns the .so path, or None
+    when the toolchain or libpython is unavailable."""
+    import sysconfig
+    src = os.path.join(_HERE, "c_api.cc")
+    out = os.path.join(_BUILD_DIR, "libmxtpu.so")
+    import shutil
+    include = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pylib = "python" + sysconfig.get_config_var("VERSION")
+    # missing toolchain/headers -> None (consumers skip); an actual compile
+    # failure of our own source must surface, not read as "no toolchain"
+    if (shutil.which("g++") is None
+            or not os.path.exists(os.path.join(include, "Python.h"))):
+        return None
+    with _LOCK:
+        if (not os.path.exists(out)
+                or os.path.getmtime(out) < os.path.getmtime(src)):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", f"-I{include}", src, "-o", out,
+                   f"-L{libdir}", f"-Wl,-rpath,{libdir}",
+                   f"-l{pylib}"]
+            r = subprocess.run(cmd, capture_output=True, text=True)
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"libmxtpu build failed:\n{r.stderr[-4000:]}")
+    return out
+
+
+def capi_header_dir():
+    """Directory holding mxtpu/c_api.h (for -I when compiling consumers)."""
+    repo_root = os.path.dirname(os.path.dirname(_HERE))
+    return os.path.join(repo_root, "cpp_package", "include")
+
+
 def load_recordio():
     """Load (building if needed) the native recordio library; None if the
     toolchain is unavailable."""

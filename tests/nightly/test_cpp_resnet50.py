@@ -1,0 +1,66 @@
+"""Nightly: compiled C++ predictor runs an exported ResNet-50.
+
+The VERDICT-r1 acceptance for the C API axis: a non-Python consumer
+(cpp_package/tests/test_predictor.cc) executes the full model-zoo ResNet-50
+from the `HybridBlock.export` artifact triple and matches the Python
+forward bit-for-bit within fp tolerance. Kept nightly because the CPU
+ahead-of-time compile of ResNet-50 dominates runtime (~1 min).
+
+Run directly: python -m pytest tests/nightly/test_cpp_resnet50.py -q
+"""
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+from incubator_mxnet_tpu.native import build_capi, capi_header_dir  # noqa: E402
+
+
+@pytest.mark.skipif(build_capi() is None,
+                    reason="C toolchain or libpython unavailable")
+def test_cpp_runs_exported_resnet50(tmp_path):
+    net = vision.resnet50_v1(layout="NHWC")
+    net.initialize()
+    net.hybridize()
+    shape = (1, 112, 112, 3)
+    x = mx.np.zeros(shape, dtype="float32")
+    net(x)
+    prefix = str(tmp_path / "resnet50")
+    net.export(prefix, example_inputs=x)
+
+    n = int(np.prod(shape))
+    ramp = ((np.arange(n) % 13) * 0.25 - 1.0).astype(np.float32)
+    ref = net(mx.np.array(ramp.reshape(shape))).asnumpy()
+
+    lib = build_capi()
+    binary = str(tmp_path / "test_predictor")
+    subprocess.run(
+        ["g++", "-O1", "-std=c++17", "-pthread",
+         os.path.join(REPO, "cpp_package", "tests", "test_predictor.cc"),
+         "-o", binary, f"-I{capi_header_dir()}", lib,
+         f"-Wl,-rpath,{os.path.dirname(lib)}"],
+        check=True, capture_output=True)
+
+    env = dict(os.environ)
+    site = [p for p in sys.path if p.endswith("site-packages")]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + site)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["LD_LIBRARY_PATH"] = os.pathsep.join(
+        [os.path.dirname(lib), sysconfig.get_config_var("LIBDIR"),
+         env.get("LD_LIBRARY_PATH", "")])
+    out_bin = str(tmp_path / "out.bin")
+    r = subprocess.run([binary, f"{prefix}-0000", out_bin], env=env,
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    got = np.fromfile(out_bin, dtype=np.float32).reshape(ref.shape)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)

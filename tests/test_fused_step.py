@@ -103,3 +103,44 @@ def test_fused_step_requires_initialized_net():
     net.initialize()
     with pytest.raises(mx.MXNetError, match="initialized"):
         FusedTrainStep(net, lambda n, x: n(x).sum(), "sgd")
+
+
+def test_fused_step_honors_param_multipliers():
+    """lr_mult/wd_mult on Parameters must flow into the fused update the
+    same way gluon.Trainer resolves them (via optimizer.param_dict)."""
+    x = mx.np.array(np.random.randn(8, 8).astype(np.float32))
+    y = mx.np.array(np.random.randn(8, 4).astype(np.float32))
+    loss_fn = gluon.loss.L2Loss()
+
+    def freeze_mults(net):
+        for name, p in net.collect_params().items():
+            if name.endswith("bias"):
+                p.lr_mult = 0.0   # biases must not move at all
+
+    net_a = _mlp(3)
+    freeze_mults(net_a)
+    tr = gluon.Trainer(net_a.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    with mx.autograd.record():
+        L = loss_fn(net_a(x), y).mean()
+    L.backward()
+    tr.step(1, ignore_stale_grad=True)
+
+    net_b = _mlp(3)
+    freeze_mults(net_b)
+    step = FusedTrainStep(net_b, lambda n, xx, yy: loss_fn(n(xx), yy).mean(),
+                          opt_mod.create("sgd", learning_rate=0.1))
+    step(x, y)
+
+    for (name, pa), (_, pb) in zip(sorted(net_a.collect_params().items()),
+                                   sorted(net_b.collect_params().items())):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(), rtol=1e-6,
+                                   atol=1e-6, err_msg=name)
+        if name.endswith("bias"):
+            # and specifically: unchanged from init
+            net_c = _mlp(3)
+            init = dict(net_c.collect_params().items())[name]
+            np.testing.assert_allclose(pb.data().asnumpy(),
+                                       init.data().asnumpy(), rtol=0,
+                                       atol=0, err_msg=name)
