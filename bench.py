@@ -112,10 +112,29 @@ def bench_resnet50_infer(batch_size=32, iters=30, warmup=5, layout="NHWC"):
     return batch_size * iters / dt
 
 
+def bench_io_pipeline():
+    """Host data-pipeline throughput (subprocess: needs a CPU-forced jax;
+    see benchmark/io_bench.py). Returns img/s or None."""
+    import os
+    import subprocess
+    import sys
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(here, "benchmark", "io_bench.py"),
+             "--n", "384"],
+            capture_output=True, text=True, timeout=600, cwd=here)
+        line = r.stdout.strip().splitlines()[-1]
+        return json.loads(line)["value"]
+    except Exception:
+        return None
+
+
 def main():
     train_ips = bench_resnet50_train()
     infer_ips = bench_resnet50_infer()
-    print(json.dumps({
+    io_ips = bench_io_pipeline()
+    out = {
         "metric": "resnet50_train_images_per_sec_bs32",
         "value": round(train_ips, 2),
         "unit": "images/sec",
@@ -124,7 +143,11 @@ def main():
         "infer_images_per_sec_bs32_bf16": round(infer_ips, 2),
         "infer_vs_v100_fp16_baseline": round(
             infer_ips / BASELINE_V100_FP16_INFER_BS32, 4),
-    }))
+    }
+    if io_ips is not None:
+        out["io_pipeline_images_per_sec"] = io_ips
+        out["io_vs_reference_3000"] = round(io_ips / 3000.0, 4)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,101 @@
+"""Data-pipeline throughput bench (≙ the reference's note_data_loading.md
+measurement: ImageRecordIter ~3000 img/s with a full decode+augment
+pipeline, docs/.../note_data_loading.md:181).
+
+Synthesizes a .rec of realistic JPEGs once (256px shorter side), then
+measures ImageRecordIter end-to-end: threaded C++ JPEG decode + shorter-
+side resize + random crop 224 + mirror + mean/std normalize + contiguous
+NHWC batch. Prints one JSON line.
+
+Usage: python benchmark/io_bench.py [--n 768] [--batch 128] [--threads 0]
+"""
+import argparse
+import io
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Host-pipeline bench: keep batches on the host platform. (The ambient
+# axon sitecustomize rewrites JAX_PLATFORMS, so use the config API.)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+REFERENCE_IMG_S = 3000.0  # reference ImageRecordIter published figure
+
+
+def make_rec(path, n, size=256):
+    from PIL import Image
+    from incubator_mxnet_tpu import recordio
+    rng = np.random.RandomState(0)
+    w = recordio.MXRecordIO(path, "w")
+    # realistic JPEG content: smooth blobs + noise (compresses like photos)
+    for i in range(n):
+        h_ = size + int(rng.randint(0, 64))
+        w_ = size + int(rng.randint(0, 96))
+        yy, xx = np.mgrid[0:h_, 0:w_]
+        base = (
+            127 + 80 * np.sin(yy / 23.0 + i) + 40 * np.cos(xx / 17.0))
+        img = np.stack([base, base * 0.8, base * 1.1], -1)
+        img += rng.randn(h_, w_, 3) * 12
+        img = np.clip(img, 0, 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=85)
+        w.write(recordio.pack(
+            recordio.IRHeader(0, float(i % 1000), i, 0), buf.getvalue()))
+    w.close()
+
+
+def bench(rec_path, batch_size, threads, epochs=2):
+    from incubator_mxnet_tpu import io as mxio
+    it = mxio.ImageRecordIter(
+        path_imgrec=rec_path, data_shape=(224, 224, 3),
+        batch_size=batch_size, shuffle=True, rand_crop=True,
+        rand_mirror=True, resize=256,
+        mean_r=123.68, mean_g=116.779, mean_b=103.939,
+        std_r=58.393, std_g=57.12, std_b=57.375,
+        preprocess_threads=threads, round_batch=False)
+    native = it._native is not None
+    # warm epoch (page cache, thread pool)
+    n = 0
+    for b in it:
+        n += b.data[0].shape[0]
+    t0 = time.perf_counter()
+    total = 0
+    for _ in range(epochs):
+        it.reset()
+        for b in it:
+            total += b.data[0].shape[0]
+            b.data[0].asnumpy()  # consume: force materialization
+    dt = time.perf_counter() - t0
+    return total / dt, native
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=768)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--threads", type=int, default=0)
+    ap.add_argument("--rec", default="/tmp/io_bench.rec")
+    args = ap.parse_args()
+
+    if not os.path.exists(args.rec):
+        make_rec(args.rec, args.n)
+    ips, native = bench(args.rec, args.batch, args.threads)
+    print(json.dumps({
+        "metric": "image_pipeline_images_per_sec",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / REFERENCE_IMG_S, 4),
+        "native": native,
+        "decode_resize_crop_mirror_normalize": True,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -160,6 +160,13 @@ def _capi_version():
     return __version__
 
 
+def _capi_dtype_size(dtype_code):
+    """Element width in bytes for a C-ABI dtype code (single source of
+    truth for the boundary; c_api.cc queries this rather than keeping its
+    own table)."""
+    return int(_np_dtype(dtype_code).itemsize)
+
+
 def _capi_ndarray_create(buf, shape, dtype_code):
     """bytes-like + shape list + reference dtype code -> NDArray."""
     from . import np as mxnp

@@ -15,7 +15,8 @@ import threading
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _BUILD_DIR = os.path.join(_HERE, "_build")
 _LOCK = threading.Lock()
-_LIB = {"recordio": None, "tried": False}
+_LIB = {"recordio": None, "tried": False,
+        "imagerec": None, "imagerec_tried": False}
 
 
 def _compile(src, out):
@@ -70,10 +71,12 @@ def load_recordio():
             return _LIB["recordio"]
         _LIB["tried"] = True
         src = os.path.join(_HERE, "recordio.cc")
+        hdr = os.path.join(_HERE, "recordio_core.h")
         out = os.path.join(_BUILD_DIR, "librecordio.so")
         try:
+            newest = max(os.path.getmtime(src), os.path.getmtime(hdr))
             if (not os.path.exists(out)
-                    or os.path.getmtime(out) < os.path.getmtime(src)):
+                    or os.path.getmtime(out) < newest):
                 _compile(src, out)
             lib = ctypes.CDLL(out)
         except (OSError, subprocess.CalledProcessError):
@@ -98,6 +101,116 @@ def load_recordio():
         lib.rr_version.restype = ctypes.c_char_p
         _LIB["recordio"] = lib
         return lib
+
+
+def load_imagerec():
+    """Load (building if needed) the native JPEG decode+augment library
+    (imagerec.cc, links -ljpeg); None when the toolchain or libjpeg is
+    unavailable — consumers fall back to the Python/PIL path."""
+    with _LOCK:
+        if _LIB["imagerec_tried"]:
+            return _LIB["imagerec"]
+        _LIB["imagerec_tried"] = True
+        src = os.path.join(_HERE, "imagerec.cc")
+        out = os.path.join(_BUILD_DIR, "libimagerec.so")
+        hdr = os.path.join(_HERE, "recordio_core.h")
+        try:
+            newest = max(os.path.getmtime(src), os.path.getmtime(hdr))
+            if (not os.path.exists(out)
+                    or os.path.getmtime(out) < newest):
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                       "-pthread", src, "-o", out, "-ljpeg"]
+                subprocess.run(cmd, check=True, capture_output=True)
+            lib = ctypes.CDLL(out)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        lib.ir_open.restype = ctypes.c_void_p
+        lib.ir_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.ir_close.argtypes = [ctypes.c_void_p]
+        lib.ir_count.restype = ctypes.c_int64
+        lib.ir_count.argtypes = [ctypes.c_void_p]
+        lib.ir_read_batch.restype = ctypes.c_int64
+        lib.ir_read_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int]
+        lib.ir_version.restype = ctypes.c_char_p
+        _LIB["imagerec"] = lib
+        return lib
+
+
+class NativeImageRecordFile:
+    """Threaded decode+augment reader over an image .rec file (≙ the
+    worker half of ImageRecordIter, src/io/iter_image_recordio_2.cc)."""
+
+    def __init__(self, path, num_threads=0):
+        import numpy as np
+        self._np = np
+        self._lib = load_imagerec()
+        if self._lib is None:
+            raise RuntimeError("native imagerec library unavailable")
+        if num_threads <= 0:
+            num_threads = min(os.cpu_count() or 4, 16)
+        self._h = self._lib.ir_open(path.encode(), num_threads)
+        if not self._h:
+            raise IOError(f"cannot open/parse record file {path}")
+
+    def __len__(self):
+        return int(self._lib.ir_count(self._h))
+
+    def read_batch(self, indices, data_shape, resize=0, rand_crop=False,
+                   rand_mirror=False, seed=0, mean=None, std=None,
+                   label_width=1):
+        """Decode+augment `indices` into one contiguous NHWC float32 batch.
+
+        data_shape is (H, W, 3) (NHWC — the MXU layout) or reference-style
+        (3, H, W); labels come back as (n, label_width) float32. Corrupt
+        records zero-fill their slot with label -1."""
+        np = self._np
+        ct = ctypes
+        if len(data_shape) != 3:
+            raise ValueError("data_shape must be rank 3")
+        if data_shape[0] == 3 and data_shape[2] != 3:
+            h, w = int(data_shape[1]), int(data_shape[2])  # (3,H,W) legacy
+        else:
+            h, w = int(data_shape[0]), int(data_shape[1])
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        n = len(idx)
+        images = np.empty((n, h, w, 3), dtype=np.float32)
+        labels = np.empty((n, label_width), dtype=np.float32)
+
+        def fptr(a):
+            return a.ctypes.data_as(ct.POINTER(ct.c_float))
+
+        mean_a = (np.ascontiguousarray(mean, np.float32)
+                  if mean is not None else None)
+        std_a = (np.ascontiguousarray(std, np.float32)
+                 if std is not None else None)
+        failed = self._lib.ir_read_batch(
+            self._h, idx.ctypes.data_as(ct.POINTER(ct.c_int64)), n,
+            h, w, int(resize), int(bool(rand_crop)), int(bool(rand_mirror)),
+            ct.c_uint64(seed),
+            fptr(mean_a) if mean_a is not None else None,
+            fptr(std_a) if std_a is not None else None,
+            fptr(images), fptr(labels), label_width)
+        if failed < 0:
+            raise IOError("ir_read_batch: invalid arguments")
+        return images, labels, int(failed)
+
+    def close(self):
+        if self._h:
+            self._lib.ir_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class NativeRecordFile:

@@ -210,20 +210,17 @@ int MXNDArrayCreate(const void *data, const int64_t *shape, int ndim,
   Gil gil;
   int64_t n = 1;
   for (int i = 0; i < ndim; ++i) n *= shape[i];
-  PyObject *itemsize_probe = nullptr;
-  (void)itemsize_probe;
-  // element size from dtype code via deploy to stay single-sourced
+  // element size from the dtype code via deploy — the single source of
+  // truth for the boundary's dtype table
+  PyObject *size_args = PyTuple_New(1);
+  PyTuple_SET_ITEM(size_args, 0, PyLong_FromLong(dtype));
+  PyObject *size_obj = call_deploy("_capi_dtype_size", size_args);
+  if (!size_obj) return -1;
+  int64_t itemsize = PyLong_AsLongLong(size_obj);
+  Py_DECREF(size_obj);
   PyObject *args = PyTuple_New(3);
-  // bytes copy: size = n * itemsize; compute itemsize locally for the
-  // common codes to avoid a second interpreter hop
-  static const int kItem[] = {4, 8, 2, 1, 4, 1, 8, 1, 2, 2, 4, 8, 2};
-  if (dtype < 0 || dtype > 12) {
-    Py_DECREF(args);
-    set_error("bad dtype code");
-    return -1;
-  }
   PyObject *buf = PyBytes_FromStringAndSize(
-      static_cast<const char *>(data), n * kItem[dtype]);
+      static_cast<const char *>(data), n * itemsize);
   PyTuple_SET_ITEM(args, 0, buf);
   PyTuple_SET_ITEM(args, 1, shape_to_list(shape, ndim));
   PyTuple_SET_ITEM(args, 2, PyLong_FromLong(dtype));

@@ -1,0 +1,313 @@
+// Native image-record pipeline: threaded JPEG decode + augment + batch.
+//
+// TPU-native equivalent of the reference's ImageRecordIter hot path
+// (src/io/iter_image_recordio_2.cc:708-940: per-thread JPEG decode,
+// random-crop/mirror augmentation, normalization, contiguous batch
+// assembly). The reference feeds NCHW float batches to its GPU executor;
+// here batches are NHWC float32 — the layout the MXU wants — and land in
+// one caller-provided contiguous buffer ready for a single host->device
+// transfer.
+//
+// Record payload layout (= reference mx.recordio image records, written by
+// tools/im2rec.py): IRHeader "<IfQQ" (flag,u32; label,f32; id,u64; id2,u64)
+// then `flag` extra f32 labels when flag>0, then the encoded image.
+//
+// Augment set (the standard training pipeline, ≙ DefaultImageAugmenter in
+// src/io/image_aug_default.cc): shorter-side resize, random/center crop,
+// horizontal mirror, per-channel mean/std normalization. Per-record
+// deterministic RNG (splitmix64 of seed^index) keeps multi-worker epochs
+// reproducible (reference seeds each worker the same way).
+//
+// Corrupt images do not kill the batch: the slot is zero-filled and
+// counted; the return value is the number of failed records (-1 = hard
+// error). Build links -ljpeg (gated in native/__init__.py).
+
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <csetjmp>
+
+#include "recordio_core.h"
+
+using mxtpu_io::CopyRecord;
+using mxtpu_io::Reader;
+using mxtpu_io::Record;
+
+namespace {
+
+constexpr int kIRHeaderBytes = 24;  // <IfQQ
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jmp;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jmp, 1);
+}
+
+// splitmix64: cheap, well-mixed per-record RNG
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed) {}
+  uint64_t next() {
+    s += 0x9e3779b97f4a7c15ull;
+    uint64_t z = s;
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+    return z ^ (z >> 31);
+  }
+  // uniform int in [0, n)
+  uint32_t below(uint32_t n) {
+    return n ? static_cast<uint32_t>(next() % n) : 0;
+  }
+};
+
+// Decode JPEG bytes to an RGB8 buffer; returns false on corrupt input.
+// When min_target > 0, uses libjpeg's DCT-domain scaling (1/2, 1/4, 1/8)
+// to decode at the smallest size whose shorter side still covers the
+// resize target — the same IDCT shortcut the reference's decoder takes
+// for large photos (≙ cv::IMREAD_REDUCED paths).
+bool DecodeJpeg(const uint8_t* bytes, uint64_t len, int min_target,
+                std::vector<uint8_t>* out, int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  // declared before setjmp: a longjmp must not jump over live
+  // non-trivially-destructible objects ([stmt.jump] UB + buffer leak)
+  std::vector<uint8_t> gray_row;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(bytes),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  // classic libjpeg62 can't colour-convert grayscale->RGB; decode native
+  // components and expand below
+  if (cinfo.jpeg_color_space != JCS_GRAYSCALE)
+    cinfo.out_color_space = JCS_RGB;
+  if (min_target > 0) {
+    int full_min = cinfo.image_width < cinfo.image_height
+                       ? static_cast<int>(cinfo.image_width)
+                       : static_cast<int>(cinfo.image_height);
+    int denom = 1;
+    while (denom < 8 && full_min / (denom * 2) >= min_target) denom *= 2;
+    cinfo.scale_num = 1;
+    cinfo.scale_denom = static_cast<unsigned int>(denom);
+  }
+  jpeg_start_decompress(&cinfo);
+  *w = static_cast<int>(cinfo.output_width);
+  *h = static_cast<int>(cinfo.output_height);
+  const int comps = cinfo.output_components;
+  if (comps != 1 && comps != 3) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  out->resize(static_cast<size_t>(*w) * *h * 3);
+  const size_t stride = static_cast<size_t>(*w) * 3;
+  uint8_t* row = out->data();
+  while (cinfo.output_scanline < cinfo.output_height) {
+    if (comps == 3) {
+      JSAMPROW rows[1] = {row};
+      jpeg_read_scanlines(&cinfo, rows, 1);
+    } else {
+      gray_row.resize(static_cast<size_t>(*w));
+      JSAMPROW rows[1] = {gray_row.data()};
+      jpeg_read_scanlines(&cinfo, rows, 1);
+      for (int x = 0; x < *w; ++x) {
+        row[x * 3] = row[x * 3 + 1] = row[x * 3 + 2] = gray_row[x];
+      }
+    }
+    row += stride;
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+struct AugmentParams {
+  int out_h, out_w;
+  int resize_min;    // shorter-side target before crop; 0 = resize exactly
+  int rand_crop;     // 1 = random crop position, 0 = center
+  int rand_mirror;   // 1 = coin-flip horizontal mirror
+  uint64_t seed;
+  const float* mean;  // len 3 or null
+  const float* stdv;  // len 3 or null
+};
+
+// Full per-record pipeline: decode -> resize -> crop -> mirror ->
+// normalize into dst (out_h*out_w*3 float32 NHWC). Returns false if the
+// image failed to decode.
+bool ProcessOne(const uint8_t* payload, uint64_t len, const AugmentParams& ap,
+                uint64_t record_seed, float* dst, float* label_out,
+                int label_width) {
+  if (len < static_cast<uint64_t>(kIRHeaderBytes)) return false;
+  uint32_t flag;
+  float label0;
+  std::memcpy(&flag, payload, 4);
+  std::memcpy(&label0, payload + 4, 4);
+  const uint8_t* img_bytes = payload + kIRHeaderBytes;
+  uint64_t img_len = len - kIRHeaderBytes;
+  if (flag > 0) {
+    // flag extra float labels precede the image bytes
+    uint64_t extra = static_cast<uint64_t>(flag) * 4;
+    if (len < kIRHeaderBytes + extra) return false;
+    for (int i = 0; i < label_width && i < static_cast<int>(flag); ++i)
+      std::memcpy(&label_out[i], payload + kIRHeaderBytes + 4ull * i, 4);
+    for (int i = static_cast<int>(flag); i < label_width; ++i)
+      label_out[i] = 0.f;
+    img_bytes += extra;
+    img_len -= extra;
+  } else {
+    label_out[0] = label0;
+    for (int i = 1; i < label_width; ++i) label_out[i] = 0.f;
+  }
+
+  int short_target = ap.resize_min > 0
+                         ? ap.resize_min
+                         : (ap.out_h > ap.out_w ? ap.out_h : ap.out_w);
+  std::vector<uint8_t> rgb;
+  int w = 0, h = 0;
+  if (!DecodeJpeg(img_bytes, img_len, short_target, &rgb, &w, &h))
+    return false;
+
+  Rng rng(record_seed);
+
+  // Virtual shorter-side resize to `short_target` + crop + mirror +
+  // normalize, all in ONE sampling pass: output pixel (y, x) maps through
+  // crop offset and resize scale straight into decoded-image coordinates
+  // (half-pixel convention at both hops composes into one affine map), so
+  // no intermediate resized buffer is ever materialized.
+  int min_side = w < h ? w : h;
+  float scale = static_cast<float>(short_target) / min_side;
+  int nw = static_cast<int>(w * scale + 0.5f);
+  int nh = static_cast<int>(h * scale + 0.5f);
+  if (nw < ap.out_w) nw = ap.out_w;
+  if (nh < ap.out_h) nh = ap.out_h;
+
+  int max_x = nw - ap.out_w, max_y = nh - ap.out_h;
+  int x0 = ap.rand_crop ? static_cast<int>(rng.below(max_x + 1)) : max_x / 2;
+  int y0 = ap.rand_crop ? static_cast<int>(rng.below(max_y + 1)) : max_y / 2;
+  bool mirror = ap.rand_mirror && (rng.next() & 1);
+
+  const float sx = static_cast<float>(w) / nw;
+  const float sy = static_cast<float>(h) / nh;
+  const float inv255 = 1.0f / 255.0f;
+  float mean[3] = {ap.mean ? ap.mean[0] : 0.f, ap.mean ? ap.mean[1] : 0.f,
+                   ap.mean ? ap.mean[2] : 0.f};
+  float istd[3] = {ap.stdv ? 1.f / ap.stdv[0] : 1.f,
+                   ap.stdv ? 1.f / ap.stdv[1] : 1.f,
+                   ap.stdv ? 1.f / ap.stdv[2] : 1.f};
+  const uint8_t* src = rgb.data();
+  for (int y = 0; y < ap.out_h; ++y) {
+    float fy = (y0 + y + 0.5f) * sy - 0.5f;
+    if (fy < 0) fy = 0;
+    if (fy > h - 1) fy = static_cast<float>(h - 1);
+    int iy0 = static_cast<int>(fy);
+    int iy1 = iy0 + 1 < h ? iy0 + 1 : iy0;
+    float wy = fy - iy0;
+    const uint8_t* r0 = src + static_cast<size_t>(iy0) * w * 3;
+    const uint8_t* r1 = src + static_cast<size_t>(iy1) * w * 3;
+    float* drow = dst + static_cast<size_t>(y) * ap.out_w * 3;
+    for (int x = 0; x < ap.out_w; ++x) {
+      int xo = mirror ? (ap.out_w - 1 - x) : x;
+      float fx = (x0 + x + 0.5f) * sx - 0.5f;
+      if (fx < 0) fx = 0;
+      if (fx > w - 1) fx = static_cast<float>(w - 1);
+      int ix0 = static_cast<int>(fx);
+      int ix1 = ix0 + 1 < w ? ix0 + 1 : ix0;
+      float wx = fx - ix0;
+      float w00 = (1 - wy) * (1 - wx), w01 = (1 - wy) * wx;
+      float w10 = wy * (1 - wx), w11 = wy * wx;
+      for (int c = 0; c < 3; ++c) {
+        float v = w00 * r0[ix0 * 3 + c] + w01 * r0[ix1 * 3 + c] +
+                  w10 * r1[ix0 * 3 + c] + w11 * r1[ix1 * 3 + c];
+        drow[xo * 3 + c] = (v * inv255 - mean[c]) * istd[c];
+      }
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ir_open(const char* path, int num_threads) {
+  return mxtpu_io::OpenReader(path, num_threads);
+}
+
+void ir_close(void* handle) {
+  mxtpu_io::CloseReader(static_cast<Reader*>(handle));
+}
+
+int64_t ir_count(void* handle) {
+  return static_cast<Reader*>(handle)->records.size();
+}
+
+// Decode+augment a batch. out_images: n*out_h*out_w*3 f32 NHWC (contiguous);
+// out_labels: n*label_width f32. Returns number of corrupt/failed records
+// (their slots zero-filled), or -1 on invalid arguments.
+int64_t ir_read_batch(void* handle, const int64_t* indices, int64_t n,
+                      int out_h, int out_w, int resize_min, int rand_crop,
+                      int rand_mirror, uint64_t seed, const float* mean,
+                      const float* stdv, float* out_images, float* out_labels,
+                      int label_width) {
+  auto* r = static_cast<Reader*>(handle);
+  if (!r || n < 0 || out_h <= 0 || out_w <= 0 || label_width <= 0) return -1;
+  AugmentParams ap{out_h, out_w, resize_min, rand_crop, rand_mirror,
+                   seed, mean, stdv};
+  const size_t img_elems = static_cast<size_t>(out_h) * out_w * 3;
+  std::atomic<int64_t> done{0};
+  std::atomic<int64_t> failed{0};
+  std::mutex mu;
+  std::condition_variable cv;
+  for (int64_t i = 0; i < n; ++i) {
+    r->pool->Submit([=, &ap, &done, &failed, &mu, &cv] {
+      int64_t idx = indices[i];
+      float* dst = out_images + static_cast<size_t>(i) * img_elems;
+      float* lab = out_labels + static_cast<size_t>(i) * label_width;
+      bool ok = false;
+      if (idx >= 0 && idx < static_cast<int64_t>(r->records.size())) {
+        const Record& rec = r->records[idx];
+        const uint8_t* payload;
+        std::vector<uint8_t> tmp;
+        if (!rec.chunked) {
+          payload = r->data + rec.offset + 8;
+        } else {
+          tmp.resize(rec.length);
+          CopyRecord(r, rec, tmp.data());
+          payload = tmp.data();
+        }
+        ok = ProcessOne(payload, rec.length, ap,
+                        seed ^ (0x9e3779b97f4a7c15ull * (idx + 1)), dst, lab,
+                        label_width);
+      }
+      if (!ok) {
+        std::memset(dst, 0, img_elems * sizeof(float));
+        for (int k = 0; k < label_width; ++k) lab[k] = -1.f;
+        failed.fetch_add(1);
+      }
+      if (done.fetch_add(1) + 1 == n) {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.notify_one();
+      }
+    });
+  }
+  std::unique_lock<std::mutex> lk(mu);
+  cv.wait(lk, [&] { return done.load() == n; });
+  return failed.load();
+}
+
+const char* ir_version() { return "incubator-mxnet-tpu-native-imagerec/1"; }
+
+}  // extern "C"

@@ -1,0 +1,35 @@
+"""Shared helpers for compiled C-ABI consumer tests (test_c_api.py and
+tests/nightly/test_cpp_resnet50.py): build flags and the subprocess
+environment that forces the CPU platform for the embedded runtime."""
+import os
+import subprocess
+import sys
+import sysconfig
+
+from incubator_mxnet_tpu.native import build_capi, capi_header_dir
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def subprocess_env():
+    env = dict(os.environ)
+    site = [p for p in sys.path if p.endswith("site-packages")]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + site)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # no virtual mesh needed; keep compiles fast
+    libdir = sysconfig.get_config_var("LIBDIR")
+    env["LD_LIBRARY_PATH"] = os.pathsep.join(
+        [os.path.dirname(build_capi()), libdir,
+         env.get("LD_LIBRARY_PATH", "")])
+    return env
+
+
+def compile_consumer(src, out):
+    lib = build_capi()
+    compiler = "g++" if src.endswith(".cc") else "gcc"
+    cmd = [compiler, "-O1", src, "-o", out, f"-I{capi_header_dir()}",
+           lib, f"-Wl,-rpath,{os.path.dirname(lib)}"]
+    if src.endswith(".cc"):
+        cmd += ["-std=c++17", "-pthread"]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return out

@@ -11,7 +11,6 @@ Run directly: python -m pytest tests/nightly/test_cpp_resnet50.py -q
 import os
 import subprocess
 import sys
-import sysconfig
 
 import numpy as np
 import pytest
@@ -19,10 +18,12 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
 
 import incubator_mxnet_tpu as mx  # noqa: E402
 from incubator_mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
-from incubator_mxnet_tpu.native import build_capi, capi_header_dir  # noqa: E402
+from incubator_mxnet_tpu.native import build_capi  # noqa: E402
+from capi_utils import compile_consumer, subprocess_env  # noqa: E402
 
 
 @pytest.mark.skipif(build_capi() is None,
@@ -41,23 +42,10 @@ def test_cpp_runs_exported_resnet50(tmp_path):
     ramp = ((np.arange(n) % 13) * 0.25 - 1.0).astype(np.float32)
     ref = net(mx.np.array(ramp.reshape(shape))).asnumpy()
 
-    lib = build_capi()
-    binary = str(tmp_path / "test_predictor")
-    subprocess.run(
-        ["g++", "-O1", "-std=c++17", "-pthread",
-         os.path.join(REPO, "cpp_package", "tests", "test_predictor.cc"),
-         "-o", binary, f"-I{capi_header_dir()}", lib,
-         f"-Wl,-rpath,{os.path.dirname(lib)}"],
-        check=True, capture_output=True)
-
-    env = dict(os.environ)
-    site = [p for p in sys.path if p.endswith("site-packages")]
-    env["PYTHONPATH"] = os.pathsep.join([REPO] + site)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
-    env["LD_LIBRARY_PATH"] = os.pathsep.join(
-        [os.path.dirname(lib), sysconfig.get_config_var("LIBDIR"),
-         env.get("LD_LIBRARY_PATH", "")])
+    binary = compile_consumer(
+        os.path.join(REPO, "cpp_package", "tests", "test_predictor.cc"),
+        str(tmp_path / "test_predictor"))
+    env = subprocess_env()
     out_bin = str(tmp_path / "out.bin")
     r = subprocess.run([binary, f"{prefix}-0000", out_bin], env=env,
                        capture_output=True, text=True, timeout=900)

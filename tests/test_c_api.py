@@ -9,8 +9,6 @@ suite's conftest does.
 import ctypes
 import os
 import subprocess
-import sys
-import sysconfig
 
 import numpy as np
 import pytest
@@ -18,9 +16,11 @@ import pytest
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import gluon
 from incubator_mxnet_tpu.gluon import nn
-from incubator_mxnet_tpu.native import build_capi, capi_header_dir
+from incubator_mxnet_tpu.native import build_capi
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from capi_utils import REPO, compile_consumer as _compile_consumer, \
+    subprocess_env as _subprocess_env
+
 CPP_TESTS = os.path.join(REPO, "cpp_package", "tests")
 
 
@@ -30,30 +30,6 @@ def _toolchain_ok():
 
 pytestmark = pytest.mark.skipif(
     not _toolchain_ok(), reason="C toolchain or libpython unavailable")
-
-
-def _subprocess_env():
-    env = dict(os.environ)
-    site = [p for p in sys.path if p.endswith("site-packages")]
-    env["PYTHONPATH"] = os.pathsep.join([REPO] + site)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)  # no virtual mesh needed; keep compiles fast
-    libdir = sysconfig.get_config_var("LIBDIR")
-    env["LD_LIBRARY_PATH"] = os.pathsep.join(
-        [os.path.dirname(build_capi()), libdir,
-         env.get("LD_LIBRARY_PATH", "")])
-    return env
-
-
-def _compile_consumer(src, out):
-    lib = build_capi()
-    compiler = "g++" if src.endswith(".cc") else "gcc"
-    cmd = [compiler, "-O1", src, "-o", out, f"-I{capi_header_dir()}",
-           lib, f"-Wl,-rpath,{os.path.dirname(lib)}"]
-    if src.endswith(".cc"):
-        cmd += ["-std=c++17", "-pthread"]
-    subprocess.run(cmd, check=True, capture_output=True)
-    return out
 
 
 @pytest.fixture(scope="module")
