@@ -81,9 +81,14 @@ def main():
     ap.add_argument("--n", type=int, default=768)
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--threads", type=int, default=0)
-    ap.add_argument("--rec", default="/tmp/io_bench.rec")
+    ap.add_argument("--rec", default=None)
     args = ap.parse_args()
 
+    if args.rec is None:
+        # size-stamped per-user cache: no stale-count reuse, no /tmp clash
+        import tempfile
+        args.rec = os.path.join(
+            tempfile.gettempdir(), f"io_bench_{os.getuid()}_{args.n}.rec")
     if not os.path.exists(args.rec):
         make_rec(args.rec, args.n)
     ips, native = bench(args.rec, args.batch, args.threads)

@@ -1,10 +1,14 @@
-"""Mini single-shot detector with AMP (BASELINE ladder config #5 slice:
-SSD-style heads + bf16 autocast; multi-host extends via tools/launch.py).
+"""Single-shot detector with AMP using the real SSD operator tail
+(≙ reference example/ssd: MultiBoxPrior → MultiBoxTarget → MultiBoxDetection,
+src/operator/contrib/multibox_*.cc) — BASELINE ladder config #5 slice.
 
-A compact SSD: conv backbone → per-cell class+box heads over a feature grid
-(anchors = cell centers), trained with the reference SSD losses (softmax CE
-for class, smooth-L1 for box offsets) under amp.scale_loss. Inference decodes
-and runs npx.box_nms. Synthetic data (one bright square per image) keeps the
+Flow (the reference SSD recipe, TPU-native ops underneath):
+  anchors   = npx.multibox_prior(feature_map, sizes, ratios)
+  targets   = npx.multibox_target(anchors, gt_boxes, cls_logits)
+  loss      = softmax CE over cls_target (ignore -1) + smooth-L1 * loc_mask
+  inference = npx.multibox_detection(softmax(cls), loc, anchors) [NMS inside]
+
+Synthetic data (one bright square per image with its true box) keeps the
 script runnable in zero-egress environments:
 
     python examples/ssd_amp.py [--steps 60]
@@ -23,48 +27,49 @@ import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import amp, gluon, npx
 from incubator_mxnet_tpu.gluon import nn
 
-GRID = 4          # 4x4 anchor grid over a 32x32 image
-CELL = 32 // GRID
+IMG = 32
+GRID = 4          # feature map 4x4 after 3 stride-2 convs
+SIZES = (0.3, 0.5)
+RATIOS = (1.0, 2.0, 0.5)
+K = len(SIZES) + len(RATIOS) - 1   # anchors per cell
 
 
-class MiniSSD(gluon.HybridBlock):
-    def __init__(self, num_classes=2):
+class SSD(gluon.HybridBlock):
+    def __init__(self, num_classes=1):
         super().__init__()
+        self.num_classes = num_classes
         self.backbone = nn.HybridSequential()
         for ch in (16, 32, 64):
             self.backbone.add(nn.Conv2D(ch, 3, 2, 1, use_bias=False),
                               nn.BatchNorm(), nn.Activation("relu"))
-        self.cls_head = nn.Conv2D(num_classes + 1, 3, padding=1)  # +bg
-        self.box_head = nn.Conv2D(4, 3, padding=1)
+        self.cls_head = nn.Conv2D(K * (num_classes + 1), 3, padding=1)
+        self.box_head = nn.Conv2D(K * 4, 3, padding=1)
 
     def forward(self, x):
-        feat = self.backbone(x)                        # (N, 64, GRID, GRID)
-        cls = self.cls_head(feat)                      # (N, C+1, G, G)
-        box = self.box_head(feat)                      # (N, 4, G, G)
+        feat = self.backbone(x)                     # (N, 64, G, G)
+        cls = self.cls_head(feat)                   # (N, K*(C+1), G, G)
+        box = self.box_head(feat)                   # (N, K*4, G, G)
         n = x.shape[0]
-        cls = cls.transpose((0, 2, 3, 1)).reshape((n, GRID * GRID, -1))
-        box = box.transpose((0, 2, 3, 1)).reshape((n, GRID * GRID, 4))
-        return cls, box
+        # (N, C+1, A) layout, A = G*G*K — what multibox_target/detection
+        # expect (class axis second, reference convention)
+        cls = cls.transpose((0, 2, 3, 1)).reshape(
+            (n, GRID * GRID * K, self.num_classes + 1)).transpose((0, 2, 1))
+        box = box.transpose((0, 2, 3, 1)).reshape((n, GRID * GRID * K * 4))
+        return cls, box, feat
 
 
 def make_batch(rng, n):
-    """Images with one bright square; labels = anchor-cell targets."""
-    imgs = rng.normal(0, 0.1, (n, 1, 32, 32)).astype(np.float32)
-    cls_t = np.zeros((n, GRID * GRID), np.int32)       # 0 = background
-    box_t = np.zeros((n, GRID * GRID, 4), np.float32)
+    """Images with one bright square + its ground-truth box (cls 0)."""
+    imgs = rng.normal(0, 0.1, (n, 1, IMG, IMG)).astype(np.float32)
+    labels = np.full((n, 2, 5), -1.0, np.float32)   # (cls,x1,y1,x2,y2)
     for i in range(n):
-        gx, gy = rng.integers(0, GRID, 2)
-        cx = gx * CELL + rng.integers(2, CELL - 2)
-        cy = gy * CELL + rng.integers(2, CELL - 2)
-        sz = int(rng.integers(3, 6))
-        imgs[i, 0, max(cy - sz, 0):cy + sz, max(cx - sz, 0):cx + sz] += 1.5
-        cell = gy * GRID + gx
-        cls_t[i, cell] = 1
-        # offsets relative to the anchor (cell center), normalized by CELL
-        box_t[i, cell] = [(cx - (gx * CELL + CELL / 2)) / CELL,
-                          (cy - (gy * CELL + CELL / 2)) / CELL,
-                          2 * sz / CELL, 2 * sz / CELL]
-    return (mx.np.array(imgs), mx.np.array(cls_t), mx.np.array(box_t))
+        cx, cy = rng.integers(8, IMG - 8, 2)
+        sz = int(rng.integers(4, 8))
+        x1, y1 = max(cx - sz, 0), max(cy - sz, 0)
+        x2, y2 = min(cx + sz, IMG), min(cy + sz, IMG)
+        imgs[i, 0, y1:y2, x1:x2] += 1.5
+        labels[i, 0] = [0, x1 / IMG, y1 / IMG, x2 / IMG, y2 / IMG]
+    return mx.np.array(imgs), mx.np.array(labels)
 
 
 def main():
@@ -74,56 +79,66 @@ def main():
     args = ap.parse_args()
     rng = np.random.default_rng(0)
 
-    net = MiniSSD()
+    net = SSD(num_classes=1)
     net.initialize(init="xavier")
-    net.hybridize()
-    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
-    box_loss = gluon.loss.HuberLoss()
+    sl1 = gluon.loss.HuberLoss()
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": 2e-3})
     amp.init()                  # bf16 autocast on the conv/matmul path
     amp.init_trainer(trainer)   # dynamic loss scaling
 
+    anchors = None
     for step in range(args.steps):
-        x, cls_t, box_t = make_batch(rng, args.batch_size)
+        x, labels = make_batch(rng, args.batch_size)
         with mx.autograd.record():
-            cls_p, box_p = net(x)
-            pos = (cls_t > 0).astype("float32")
-            L = (cls_loss(cls_p.reshape((-1, cls_p.shape[-1])),
-                          cls_t.reshape((-1,))).mean()
-                 + (box_loss(box_p, box_t,
-                             pos.reshape(pos.shape + (1,))).mean() * 4.0))
+            cls, box, feat = net(x)
+            if anchors is None:
+                anchors = npx.multibox_prior(
+                    feat, sizes=SIZES, ratios=RATIOS, clip=True)
+            loc_t, loc_m, cls_t = npx.multibox_target(
+                anchors, labels, cls, negative_mining_ratio=3.0)
+            valid = (cls_t >= 0).astype("float32")   # -1 = ignore
+            logp = npx.log_softmax(cls, axis=1)      # (N, C+1, A)
+            nll = -npx.pick(logp.transpose((0, 2, 1)),
+                            mx.np.maximum(cls_t, 0))  # (N, A)
+            Lcls = (nll * valid).sum() / mx.np.maximum(valid.sum(), 1)
+            Lloc = sl1(box * loc_m, loc_t * loc_m).mean() * 4.0
+            L = Lcls + Lloc
             with amp.scale_loss(L, trainer) as scaled:
                 scaled.backward()
         if not amp.step_with_overflow_check(trainer, args.batch_size):
             print(f"step {step}: overflow, skipped "
                   f"(scale={trainer._amp_loss_scaler.loss_scale})")
         if step % 20 == 0 or step == args.steps - 1:
-            print(f"step {step}: loss={float(L.asnumpy()):.4f}")
+            print(f"step {step}: loss={float(L.asnumpy()):.4f} "
+                  f"(cls {float(Lcls.asnumpy()):.4f} "
+                  f"loc {float(Lloc.asnumpy()):.4f})")
     amp.uninit()
 
-    # inference: decode + NMS on one batch
-    x, cls_t, _ = make_batch(rng, 4)
+    # inference: decode + per-class NMS through the detection op
+    x, labels = make_batch(rng, 4)
     with mx.autograd.predict_mode():
-        cls_p, box_p = net(x)
-    prob = npx.softmax(cls_p, axis=-1).asnumpy()
-    boxes = box_p.asnumpy()
-    correct = 0
+        cls, box, _ = net(x)
+    det = npx.multibox_detection(npx.softmax(cls, axis=1), box, anchors,
+                                 nms_threshold=0.45, threshold=0.2)
+    det = det.asnumpy()
+    hits = 0
     for i in range(4):
-        cell_scores = prob[i, :, 1]
-        best = int(cell_scores.argmax())
-        if cls_t.asnumpy()[i, best] == 1:
-            correct += 1
-        gx, gy = best % GRID, best // GRID
-        ox, oy, w, h = boxes[i, best]
-        cx = gx * CELL + CELL / 2 + ox * CELL
-        cy = gy * CELL + CELL / 2 + oy * CELL
-        dets = np.array([[1, cell_scores[best],
-                          cx - w * CELL / 2, cy - h * CELL / 2,
-                          cx + w * CELL / 2, cy + h * CELL / 2]], np.float32)
-        kept = npx.box_nms(mx.np.array(dets), overlap_thresh=0.5)
-        assert kept.shape == dets.shape
-    print(f"localization accuracy on held-out batch: {correct}/4")
+        top = det[i, 0]
+        gt = labels.asnumpy()[i, 0, 1:5]
+        if top[0] < 0:
+            print(f"img {i}: no detection")
+            continue
+        ix1, iy1 = max(top[2], gt[0]), max(top[3], gt[1])
+        ix2, iy2 = min(top[4], gt[2]), min(top[5], gt[3])
+        inter = max(0, ix2 - ix1) * max(0, iy2 - iy1)
+        union = ((top[4] - top[2]) * (top[5] - top[3])
+                 + (gt[2] - gt[0]) * (gt[3] - gt[1]) - inter)
+        iou = inter / union if union > 0 else 0.0
+        print(f"img {i}: top det cls={int(top[0])} score={top[1]:.2f} "
+              f"IoU vs gt={iou:.2f}")
+        hits += iou > 0.3
+    print(f"detections overlapping gt (IoU>0.3): {hits}/4")
 
 
 if __name__ == "__main__":

@@ -358,11 +358,14 @@ class ImageRecordIter(DataIter):
         self._rand_crop = rand_crop
         self._rand_mirror = rand_mirror
         self._resize = int(resize)
-        # reference means are in 0..255 pixel units; the native pipeline
-        # normalizes after scaling to [0,1]
+        # reference means/stds are in 0..255 pixel units (each std defaults
+        # to 1.0 per channel there); the native pipeline normalizes after
+        # scaling to [0,1], so divide by 255 and map unset std channels to
+        # the reference default 1.0 rather than a 1/0 blow-up
         self._mean = ([mean_r / 255.0, mean_g / 255.0, mean_b / 255.0]
                       if (mean_r or mean_g or mean_b) else None)
-        self._std = ([std_r / 255.0, std_g / 255.0, std_b / 255.0]
+        self._std = ([(s if s else 1.0) / 255.0
+                      for s in (std_r, std_g, std_b)]
                      if (std_r or std_g or std_b) else None)
         self._label_width = int(label_width)
         self._seed = int(seed)

@@ -19,11 +19,16 @@ _LIB = {"recordio": None, "tried": False,
         "imagerec": None, "imagerec_tried": False}
 
 
-def _compile(src, out):
+def _compile(src, out, extra_flags=()):
     os.makedirs(_BUILD_DIR, exist_ok=True)
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           src, "-o", out]
+           src, "-o", out, *extra_flags]
     subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _needs_rebuild(out, *srcs):
+    newest = max(os.path.getmtime(s) for s in srcs)
+    return not os.path.exists(out) or os.path.getmtime(out) < newest
 
 
 def build_capi():
@@ -74,9 +79,7 @@ def load_recordio():
         hdr = os.path.join(_HERE, "recordio_core.h")
         out = os.path.join(_BUILD_DIR, "librecordio.so")
         try:
-            newest = max(os.path.getmtime(src), os.path.getmtime(hdr))
-            if (not os.path.exists(out)
-                    or os.path.getmtime(out) < newest):
+            if _needs_rebuild(out, src, hdr):
                 _compile(src, out)
             lib = ctypes.CDLL(out)
         except (OSError, subprocess.CalledProcessError):
@@ -115,13 +118,8 @@ def load_imagerec():
         out = os.path.join(_BUILD_DIR, "libimagerec.so")
         hdr = os.path.join(_HERE, "recordio_core.h")
         try:
-            newest = max(os.path.getmtime(src), os.path.getmtime(hdr))
-            if (not os.path.exists(out)
-                    or os.path.getmtime(out) < newest):
-                os.makedirs(_BUILD_DIR, exist_ok=True)
-                cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                       "-pthread", src, "-o", out, "-ljpeg"]
-                subprocess.run(cmd, check=True, capture_output=True)
+            if _needs_rebuild(out, src, hdr):
+                _compile(src, out, extra_flags=("-ljpeg",))
             lib = ctypes.CDLL(out)
         except (OSError, subprocess.CalledProcessError):
             return None

@@ -233,6 +233,93 @@ def bilinear_resize2d(data, height, width, layout="NCHW"):
                   (_as_nd(data),), name="bilinear_resize2d")
 
 
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5), layout="NCHW"):
+    """≙ _npx_multibox_prior (src/operator/contrib/multibox_prior.cc)."""
+    from ..ops import contrib as _contrib
+    return invoke(functools.partial(
+        _contrib.multibox_prior, sizes=tuple(sizes), ratios=tuple(ratios),
+        clip=clip, steps=tuple(steps), offsets=tuple(offsets),
+        layout=layout), (_as_nd(data),), name="multibox_prior")
+
+
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """≙ _npx_multibox_target (src/operator/contrib/multibox_target.cc)."""
+    from ..ops import contrib as _contrib
+    return invoke(functools.partial(
+        _contrib.multibox_target, overlap_threshold=overlap_threshold,
+        ignore_label=ignore_label,
+        negative_mining_ratio=negative_mining_ratio,
+        negative_mining_thresh=negative_mining_thresh,
+        minimum_negative_samples=minimum_negative_samples,
+        variances=tuple(variances)),
+        (_as_nd(anchor), _as_nd(label), _as_nd(cls_pred)),
+        name="multibox_target", multi_out=True)
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """≙ _npx_multibox_detection (multibox_detection.cc)."""
+    from ..ops import contrib as _contrib
+    return invoke(functools.partial(
+        _contrib.multibox_detection, clip=clip, threshold=threshold,
+        background_id=background_id, nms_threshold=nms_threshold,
+        force_suppress=force_suppress, variances=tuple(variances),
+        nms_topk=nms_topk),
+        (_as_nd(cls_prob), _as_nd(loc_pred), _as_nd(anchor)),
+        name="multibox_detection")
+
+
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False):
+    """≙ _contrib_Proposal (src/operator/contrib/proposal.cc)."""
+    from ..ops import contrib as _contrib
+    return invoke(functools.partial(
+        _contrib.proposal, rpn_pre_nms_top_n=rpn_pre_nms_top_n,
+        rpn_post_nms_top_n=rpn_post_nms_top_n, threshold=threshold,
+        rpn_min_size=rpn_min_size, scales=tuple(scales),
+        ratios=tuple(ratios), feature_stride=feature_stride,
+        output_score=output_score, iou_loss=iou_loss),
+        (_as_nd(cls_prob), _as_nd(bbox_pred), _as_nd(im_info)),
+        name="proposal", multi_out=output_score)
+
+
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                           num_deformable_group=1):
+    """≙ _npx_deformable_convolution (deformable_convolution.cc)."""
+    from ..ops import contrib as _contrib
+    fn = functools.partial(
+        _contrib.deformable_convolution, kernel=tuple(kernel),
+        stride=tuple(stride), pad=tuple(pad), dilate=tuple(dilate),
+        num_deformable_group=num_deformable_group)
+    args = (_as_nd(data), _as_nd(offset), _as_nd(weight))
+    if bias is not None:
+        args = args + (_as_nd(bias),)
+        return invoke(lambda d, o, w, b: fn(d, o, w, bias=b), args,
+                      name="deformable_convolution")
+    return invoke(lambda d, o, w: fn(d, o, w), args,
+                  name="deformable_convolution")
+
+
+def psroi_pooling(data, rois, spatial_scale, output_dim, pooled_size,
+                  group_size=0):
+    """≙ _contrib_PSROIPooling (psroi_pooling.cc, R-FCN)."""
+    from ..ops import contrib as _contrib
+    return invoke(functools.partial(
+        _contrib.psroi_pooling, spatial_scale=spatial_scale,
+        output_dim=output_dim, pooled_size=pooled_size,
+        group_size=group_size), (_as_nd(data), _as_nd(rois)),
+        name="psroi_pooling")
+
+
 def smooth_l1(x, scalar=1.0):
     """reference: smooth_l1 op (src/operator/tensor/elemwise_unary_op)"""
     def f(v):
@@ -460,4 +547,6 @@ def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
 
 
 __all__ += ["sequence_last", "sequence_reverse", "box_iou", "box_nms",
-            "roi_align", "bilinear_resize2d"]
+            "roi_align", "bilinear_resize2d", "multibox_prior",
+            "multibox_target", "multibox_detection", "proposal",
+            "deformable_convolution", "psroi_pooling"]

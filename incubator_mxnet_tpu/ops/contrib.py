@@ -12,7 +12,9 @@ import functools
 
 import numpy as _np
 
-__all__ = ["box_iou", "box_nms", "roi_align", "bilinear_resize2d"]
+__all__ = ["box_iou", "box_nms", "roi_align", "bilinear_resize2d",
+           "multibox_prior", "multibox_target", "multibox_detection",
+           "proposal", "deformable_convolution", "psroi_pooling"]
 
 
 def _jnp():
@@ -151,3 +153,619 @@ def bilinear_resize2d(data, height, width, layout="NCHW"):
     else:
         shape = (data.shape[0], height, width, data.shape[-1])
     return jax.image.resize(data, shape, method="linear")
+
+
+# ---------------------------------------------------------------------------
+# SSD detection tail (≙ src/operator/contrib/multibox_prior.cc,
+# multibox_target.cc, multibox_detection.cc). Re-designed fixed-shape and
+# batched: the reference's per-anchor C loops become vectorized IoU tables,
+# a lax.fori_loop bipartite matcher, and argsort-based compaction, all of
+# which compile under jit.
+# ---------------------------------------------------------------------------
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5), layout="NCHW"):
+    """Generate SSD prior (anchor) boxes from a feature map
+    (≙ multibox_prior.cc:31-75). Returns (1, H*W*K, 4) corner boxes in
+    normalized [0,1] coords, K = len(sizes) + len(ratios) - 1, ordered
+    (per cell): each size with ratios[0], then sizes[0] with ratios[1:]."""
+    jnp = _jnp()
+    if layout == "NCHW":
+        in_h, in_w = int(data.shape[2]), int(data.shape[3])
+    else:
+        in_h, in_w = int(data.shape[1]), int(data.shape[2])
+    step_y = steps[0] if steps[0] > 0 else 1.0 / in_h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / in_w
+    cy = (jnp.arange(in_h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(in_w, dtype=jnp.float32) + offsets[1]) * step_x
+
+    # per-cell half-sizes: sizes x sqrt(ratios[0]), then sizes[0] x ratios[1:]
+    hw, hh = [], []
+    r0 = float(_np.sqrt(ratios[0])) if len(ratios) else 1.0
+    for s in sizes:
+        hw.append(s * in_h / in_w * r0 / 2)
+        hh.append(s / r0 / 2)
+    for r in ratios[1:]:
+        sr = float(_np.sqrt(r))
+        hw.append(sizes[0] * in_h / in_w * sr / 2)
+        hh.append(sizes[0] / sr / 2)
+    hw = jnp.asarray(hw, jnp.float32)   # (K,)
+    hh = jnp.asarray(hh, jnp.float32)
+
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")      # (H, W)
+    cxg = cxg[:, :, None]
+    cyg = cyg[:, :, None]
+    boxes = jnp.stack([cxg - hw, cyg - hh, cxg + hw, cyg + hh], axis=-1)
+    boxes = boxes.reshape(1, in_h * in_w * hw.shape[0], 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+def _encode_loc(anchor, gt, variances):
+    """(≙ AssignLocTargets, multibox_target.cc:32-60)"""
+    jnp = _jnp()
+    aw = anchor[..., 2] - anchor[..., 0]
+    ah = anchor[..., 3] - anchor[..., 1]
+    ax = (anchor[..., 0] + anchor[..., 2]) * 0.5
+    ay = (anchor[..., 1] + anchor[..., 3]) * 0.5
+    gw = gt[..., 2] - gt[..., 0]
+    gh = gt[..., 3] - gt[..., 1]
+    gx = (gt[..., 0] + gt[..., 2]) * 0.5
+    gy = (gt[..., 1] + gt[..., 3]) * 0.5
+    eps = 1e-12
+    return jnp.stack([
+        (gx - ax) / jnp.maximum(aw, eps) / variances[0],
+        (gy - ay) / jnp.maximum(ah, eps) / variances[1],
+        jnp.log(jnp.maximum(gw, eps) / jnp.maximum(aw, eps)) / variances[2],
+        jnp.log(jnp.maximum(gh, eps) / jnp.maximum(ah, eps)) / variances[3],
+    ], axis=-1)
+
+
+@functools.lru_cache(maxsize=None)
+def _multibox_target_impl(overlap_threshold, ignore_label,
+                          negative_mining_ratio, negative_mining_thresh,
+                          minimum_negative_samples, variances):
+    """jit-compiled matcher, cached per hyperparameter tuple (eager calls
+    would otherwise re-trace the fori_loop every training step)."""
+    import jax
+    jnp = _jnp()
+
+    def impl(anchor, label, cls_pred):
+        anc = anchor.reshape(-1, 4)
+        A = anc.shape[0]
+        G = label.shape[1]
+
+        def one(lab, cpred):
+            valid = jnp.cumprod(lab[:, 0] != -1.0).astype(bool)   # (G,)
+            ious = box_iou(anc, lab[:, 1:5])                       # (A, G)
+            ious = jnp.where(valid[None, :], ious, -1.0)
+
+            def body(_, st):
+                match, flags, iou_m = st
+                flat = jnp.argmax(iou_m)
+                aj, gk = flat // G, flat % G
+                best = iou_m[aj, gk]
+                take = best > 1e-6
+                match = jnp.where(take, match.at[aj].set(gk), match)
+                flags = jnp.where(take, flags.at[aj].set(1), flags)
+                iou_m = jnp.where(take, iou_m.at[aj, :].set(-1.0), iou_m)
+                iou_m = jnp.where(take, iou_m.at[:, gk].set(-1.0), iou_m)
+                return match, flags, iou_m
+
+            match0 = jnp.full((A,), -1, jnp.int32)
+            flags0 = jnp.full((A,), -1, jnp.int32)  # -1 ign, 0 neg, 1 pos
+            match, flags, _ = jax.lax.fori_loop(
+                0, G, body, (match0, flags0, ious))
+
+            best_gt = jnp.argmax(ious, axis=1).astype(jnp.int32)
+            best_iou = jnp.max(ious, axis=1)
+            thr_pos = (flags != 1) & (best_iou > overlap_threshold)
+            if overlap_threshold > 0:
+                match = jnp.where(thr_pos, best_gt, match)
+                flags = jnp.where(thr_pos, 1, flags)
+
+            num_pos = jnp.sum(flags == 1)
+
+            if negative_mining_ratio > 0:
+                # rank by LOWEST background softmax prob = anchors the
+                # classifier most confidently calls foreground — the hard
+                # negatives (≙ multibox_target.cc:221-235: sort by -prob
+                # of class 0)
+                bg_prob = jax.nn.softmax(cpred, axis=0)[0]
+                neg_cand = ((flags != 1)
+                            & (best_iou < negative_mining_thresh))
+                num_neg = jnp.minimum(
+                    (num_pos * negative_mining_ratio).astype(jnp.int32),
+                    A - num_pos)
+                num_neg = jnp.maximum(num_neg, minimum_negative_samples)
+                score = jnp.where(neg_cand, -bg_prob, -jnp.inf)
+                order = jnp.argsort(-score)
+                rank = jnp.zeros((A,), jnp.int32).at[order].set(
+                    jnp.arange(A, dtype=jnp.int32))
+                sel = neg_cand & (rank < num_neg)
+                flags = jnp.where(sel, 0, flags)
+            else:
+                flags = jnp.where(flags != 1, 0, flags)
+
+            safe_gt = jnp.clip(match, 0, G - 1)
+            gt_rows = lab[safe_gt]
+            loc_t = _encode_loc(anc, gt_rows[:, 1:5],
+                                jnp.asarray(variances, jnp.float32))
+            pos = (flags == 1)
+            loc_t = jnp.where(pos[:, None], loc_t, 0.0)
+            loc_m = jnp.where(pos[:, None], 1.0, 0.0) * jnp.ones((A, 4))
+            cls_t = jnp.where(
+                pos, gt_rows[:, 0] + 1.0,
+                jnp.where(flags == 0, 0.0, float(ignore_label)))
+            return (loc_t.reshape(-1), loc_m.reshape(-1),
+                    cls_t.astype(anc.dtype))
+
+        return jax.vmap(one)(label, cls_pred)
+
+    return jax.jit(impl)
+
+
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training-target assignment
+    (≙ MultiBoxTargetForward, multibox_target.cc:76-287).
+
+    anchor (1,A,4) or (A,4); label (B,G,5) rows [cls,xmin,ymin,xmax,ymax]
+    with -1 rows as padding; cls_pred (B,num_cls,A) (used by negative
+    mining). Returns (loc_target (B,A*4), loc_mask (B,A*4),
+    cls_target (B,A)). Matching = bipartite (each gt grabs its best free
+    anchor, highest IoU pairs first) then threshold matching; optional
+    hard-negative mining ranks unmatched anchors by peak class logit.
+    Non-differentiable (targets are labels — reference semantics)."""
+    import jax
+    fn = _multibox_target_impl(
+        float(overlap_threshold), float(ignore_label),
+        float(negative_mining_ratio), float(negative_mining_thresh),
+        int(minimum_negative_samples), tuple(variances))
+    return fn(jax.lax.stop_gradient(anchor), jax.lax.stop_gradient(label),
+              jax.lax.stop_gradient(cls_pred))
+
+
+@functools.lru_cache(maxsize=None)
+def _multibox_detection_impl(clip, threshold, nms_threshold, force_suppress,
+                             variances, nms_topk):
+    import jax
+    jnp = _jnp()
+
+    def impl(cls_prob, loc_pred, anchor):
+        return _multibox_detection_body(
+            jnp, jax, cls_prob, loc_pred, anchor, clip, threshold,
+            nms_threshold, force_suppress, variances, nms_topk)
+
+    return jax.jit(impl)
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD decode + per-class NMS
+    (≙ MultiBoxDetectionForward, multibox_detection.cc:87-190).
+
+    cls_prob (B,num_cls,A) softmax probs (class 0 = background),
+    loc_pred (B,A*4), anchor (1,A,4). Returns (B,A,6) rows
+    [class_id, score, xmin, ymin, xmax, ymax]; invalid rows have id -1
+    and are compacted after the valid ones (stable order, like the
+    reference's valid_count compaction). Non-differentiable (inference
+    op, reference semantics); jitted + cached per hyperparameter set."""
+    import jax
+    fn = _multibox_detection_impl(
+        bool(clip), float(threshold), float(nms_threshold),
+        bool(force_suppress), tuple(variances), int(nms_topk))
+    return fn(jax.lax.stop_gradient(cls_prob),
+              jax.lax.stop_gradient(loc_pred),
+              jax.lax.stop_gradient(anchor))
+
+
+def _multibox_detection_body(jnp, jax, cls_prob, loc_pred, anchor, clip,
+                             threshold, nms_threshold, force_suppress,
+                             variances, nms_topk):
+    anc = anchor.reshape(-1, 4)
+    A = anc.shape[0]
+
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    ax = (anc[:, 0] + anc[:, 2]) * 0.5
+    ay = (anc[:, 1] + anc[:, 3]) * 0.5
+
+    def one(cprob, lpred):
+        lp = lpred.reshape(A, 4)
+        score = jnp.max(cprob[1:], axis=0)          # best fg prob (A,)
+        cid = jnp.argmax(cprob[1:], axis=0) + 1     # 1-based class
+        cid = jnp.where(score < threshold, 0, cid)  # ≙ id>0 && score<thr
+        ox = lp[:, 0] * variances[0] * aw + ax
+        oy = lp[:, 1] * variances[1] * ah + ay
+        ow = jnp.exp(lp[:, 2] * variances[2]) * aw / 2
+        oh = jnp.exp(lp[:, 3] * variances[3]) * ah / 2
+        boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        out_id = cid.astype(jnp.float32) - 1.0       # background -> -1
+
+        # NMS sweep in score order (suppress same class unless
+        # force_suppress), optional topk
+        order = jnp.argsort(-jnp.where(out_id >= 0, score, -1.0))
+        s_id = out_id[order]
+        s_score = score[order]
+        s_boxes = boxes[order]
+        if nms_topk > 0:
+            in_topk = jnp.arange(A) < nms_topk
+            s_id = jnp.where(in_topk, s_id, -1.0)
+
+        def body(i, alive_id):
+            me_valid = alive_id[i] >= 0
+            iou = box_iou(s_boxes[i][None, :], s_boxes)[0]        # (A,)
+            same_cls = (alive_id == alive_id[i]) if not force_suppress \
+                else jnp.ones_like(alive_id, bool)
+            later = jnp.arange(A) > i
+            kill = me_valid & later & same_cls & (iou > nms_threshold) \
+                & (alive_id >= 0)
+            return jnp.where(kill, -1.0, alive_id)
+
+        s_id = jax.lax.fori_loop(0, A, body, s_id)
+
+        # compact valid rows to the front, stable
+        invalid = s_id < 0
+        comp = jnp.argsort(invalid, stable=True)
+        rows = jnp.concatenate(
+            [s_id[:, None], jnp.where(invalid, -1.0, s_score)[:, None],
+             s_boxes], axis=-1)
+        return rows[comp]
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# Faster-RCNN tail (≙ src/operator/contrib/proposal.cc,
+# deformable_convolution.cc, psroi_pooling.cc, deformable_psroi_pooling.cc)
+# ---------------------------------------------------------------------------
+
+def _generate_base_anchors(base_size, ratios, scales):
+    """(≙ utils::GenerateAnchors, proposal.cc) ratio then scale enumeration
+    around a base_size x base_size window, area-preserving with rounding."""
+    base = _np.array([0, 0, base_size - 1, base_size - 1], _np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    out = []
+    for r in ratios:
+        size = w * h
+        size_r = size / r
+        ws = _np.round(_np.sqrt(size_r))
+        hs = _np.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            out.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                        cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return _np.asarray(out, _np.float32)
+
+
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False):
+    """RPN proposal generation (≙ ProposalOp::Forward, proposal.cc:275-430).
+
+    cls_prob (1, 2K, H, W) [background scores first, foreground second],
+    bbox_pred (1, 4K, H, W), im_info (1, 3) [height, width, scale].
+    Returns (post_nms, 5) rows [batch_idx, x1, y1, x2, y2] (+ (post_nms, 1)
+    scores when output_score). Fixed-shape: NMS survivors are compacted,
+    short results padded by repeating the best proposal (reference pads the
+    tail the same way). Non-differentiable; jitted + cached per config."""
+    import jax
+    fn = _proposal_impl(
+        int(rpn_pre_nms_top_n), int(rpn_post_nms_top_n), float(threshold),
+        int(rpn_min_size), tuple(scales), tuple(ratios),
+        int(feature_stride), bool(output_score), bool(iou_loss))
+    return fn(jax.lax.stop_gradient(cls_prob),
+              jax.lax.stop_gradient(bbox_pred),
+              jax.lax.stop_gradient(im_info))
+
+
+@functools.lru_cache(maxsize=None)
+def _proposal_impl(rpn_pre_nms_top_n, rpn_post_nms_top_n, threshold,
+                   rpn_min_size, scales, ratios, feature_stride,
+                   output_score, iou_loss):
+    import jax
+
+    def impl(cls_prob, bbox_pred, im_info):
+        return _proposal_body(cls_prob, bbox_pred, im_info,
+                              rpn_pre_nms_top_n, rpn_post_nms_top_n,
+                              threshold, rpn_min_size, scales, ratios,
+                              feature_stride, output_score, iou_loss)
+
+    return jax.jit(impl)
+
+
+def _proposal_body(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n,
+                   rpn_post_nms_top_n, threshold, rpn_min_size, scales,
+                   ratios, feature_stride, output_score, iou_loss):
+    import jax
+    jnp = _jnp()
+    K = cls_prob.shape[1] // 2
+    H, W = int(cls_prob.shape[2]), int(cls_prob.shape[3])
+    count = K * H * W
+    pre_n = min(rpn_pre_nms_top_n if rpn_pre_nms_top_n > 0 else count, count)
+    post_n = min(rpn_post_nms_top_n, pre_n)
+
+    base = jnp.asarray(
+        _generate_base_anchors(feature_stride, ratios, scales))   # (K,4)
+    sy = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    sx = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    syg, sxg = jnp.meshgrid(sy, sx, indexing="ij")                # (H,W)
+    shift = jnp.stack([sxg, syg, sxg, syg], axis=-1)              # (H,W,4)
+    anchors = (base[None, None, :, :] + shift[:, :, None, :])     # (H,W,K,4)
+    anchors = anchors.reshape(-1, 4)                              # (HWK,4)
+
+    fg = cls_prob[0, K:].transpose(1, 2, 0).reshape(-1)           # (HWK,)
+    deltas = bbox_pred[0].reshape(K, 4, H, W).transpose(
+        2, 3, 0, 1).reshape(-1, 4)
+
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + 0.5 * (aw - 1.0)
+    ay = anchors[:, 1] + 0.5 * (ah - 1.0)
+    if iou_loss:
+        x1 = anchors[:, 0] + deltas[:, 0]
+        y1 = anchors[:, 1] + deltas[:, 1]
+        x2 = anchors[:, 2] + deltas[:, 2]
+        y2 = anchors[:, 3] + deltas[:, 3]
+    else:
+        px = deltas[:, 0] * aw + ax
+        py = deltas[:, 1] * ah + ay
+        pw = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+        ph = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+        x1 = px - 0.5 * (pw - 1.0)
+        y1 = py - 0.5 * (ph - 1.0)
+        x2 = px + 0.5 * (pw - 1.0)
+        y2 = py + 0.5 * (ph - 1.0)
+    im_h, im_w, im_scale = im_info[0, 0], im_info[0, 1], im_info[0, 2]
+    x1 = jnp.clip(x1, 0.0, im_w - 1.0)
+    y1 = jnp.clip(y1, 0.0, im_h - 1.0)
+    x2 = jnp.clip(x2, 0.0, im_w - 1.0)
+    y2 = jnp.clip(y2, 0.0, im_h - 1.0)
+
+    min_size = rpn_min_size * im_scale
+    keep = ((x2 - x1 + 1.0) >= min_size) & ((y2 - y1 + 1.0) >= min_size)
+    score = jnp.where(keep, fg, -1.0)
+
+    order = jnp.argsort(-score)
+    take = order[:pre_n]
+    boxes = jnp.stack([x1, y1, x2, y2], -1)[take]
+    score = score[take]
+
+    def body(i, alive):
+        me = alive[i] > -1.0
+        xx1 = jnp.maximum(boxes[i, 0], boxes[:, 0])
+        yy1 = jnp.maximum(boxes[i, 1], boxes[:, 1])
+        xx2 = jnp.minimum(boxes[i, 2], boxes[:, 2])
+        yy2 = jnp.minimum(boxes[i, 3], boxes[:, 3])
+        inter = (jnp.maximum(0.0, xx2 - xx1 + 1.0)
+                 * jnp.maximum(0.0, yy2 - yy1 + 1.0))
+        a_i = ((boxes[i, 2] - boxes[i, 0] + 1.0)
+               * (boxes[i, 3] - boxes[i, 1] + 1.0))
+        a_all = ((boxes[:, 2] - boxes[:, 0] + 1.0)
+                 * (boxes[:, 3] - boxes[:, 1] + 1.0))
+        iou = inter / (a_i + a_all - inter)
+        kill = me & (jnp.arange(pre_n) > i) & (iou > threshold)
+        return jnp.where(kill, -1.0, alive)
+
+    alive = jax.lax.fori_loop(0, pre_n, body, score)
+    comp = jnp.argsort(alive <= -1.0, stable=True)[:post_n]
+    out_boxes = boxes[comp]
+    out_score = alive[comp]
+    # pad suppressed tail rows by repeating the top proposal
+    bad = (out_score <= -1.0)
+    out_boxes = jnp.where(bad[:, None], out_boxes[0][None, :], out_boxes)
+    out_score = jnp.where(bad, out_score[0], out_score)
+    rois = jnp.concatenate(
+        [jnp.zeros((post_n, 1), out_boxes.dtype), out_boxes], axis=-1)
+    if output_score:
+        return rois, out_score[:, None]
+    return rois
+
+
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                           num_deformable_group=1):
+    """Deformable convolution v1
+    (≙ deformable_convolution.cc / deformable_im2col.h, Dai et al. 2017).
+
+    data (B,C,H,W); offset (B, 2*G*kh*kw, Ho, Wo) ordered (g, kh, kw,
+    [dy,dx]); weight (Co, C, kh, kw). TPU-native: the deformable im2col
+    becomes a batched bilinear gather building (B, Ho, Wo, C*kh*kw), and
+    the conv collapses into ONE (BHoWo, Ckhkw) x (Ckhkw, Co) matmul on the
+    MXU. Fully differentiable (jax AD through the gather weights); jitted
+    + cached per (kernel, stride, pad, dilate, groups)."""
+    fn = _deformable_conv_impl(tuple(kernel), tuple(stride), tuple(pad),
+                               tuple(dilate), int(num_deformable_group),
+                               bias is not None)
+    if bias is not None:
+        return fn(data, offset, weight, bias)
+    return fn(data, offset, weight)
+
+
+@functools.lru_cache(maxsize=None)
+def _deformable_conv_impl(kernel, stride, pad, dilate, num_deformable_group,
+                          has_bias):
+    import jax
+
+    if has_bias:
+        def impl(data, offset, weight, bias):
+            return _deformable_conv_body(data, offset, weight, bias, kernel,
+                                         stride, pad, dilate,
+                                         num_deformable_group)
+    else:
+        def impl(data, offset, weight):
+            return _deformable_conv_body(data, offset, weight, None, kernel,
+                                         stride, pad, dilate,
+                                         num_deformable_group)
+    return jax.jit(impl)
+
+
+def _deformable_conv_body(data, offset, weight, bias, kernel, stride, pad,
+                          dilate, num_deformable_group):
+    import jax
+    jnp = _jnp()
+    B, C, H, W = data.shape
+    kh, kw = kernel
+    Co = weight.shape[0]
+    G = num_deformable_group
+    Ho = (H + 2 * pad[0] - dilate[0] * (kh - 1) - 1) // stride[0] + 1
+    Wo = (W + 2 * pad[1] - dilate[1] * (kw - 1) - 1) // stride[1] + 1
+
+    # base sampling grid (kh*kw taps per output position)
+    oy = jnp.arange(Ho) * stride[0] - pad[0]
+    ox = jnp.arange(Wo) * stride[1] - pad[1]
+    ky = jnp.arange(kh) * dilate[0]
+    kx = jnp.arange(kw) * dilate[1]
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]  # (Ho,1,kh,1)
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]  # (1,Wo,1,kw)
+    base_y = jnp.broadcast_to(base_y, (Ho, Wo, kh, kw)).astype(jnp.float32)
+    base_x = jnp.broadcast_to(base_x, (Ho, Wo, kh, kw)).astype(jnp.float32)
+
+    off = offset.reshape(B, G, kh, kw, 2, Ho, Wo)
+    dy = off[:, :, :, :, 0].transpose(0, 1, 4, 5, 2, 3)  # (B,G,Ho,Wo,kh,kw)
+    dx = off[:, :, :, :, 1].transpose(0, 1, 4, 5, 2, 3)
+    sy = base_y[None, None] + dy                          # (B,G,Ho,Wo,kh,kw)
+    sx = base_x[None, None] + dx
+
+    y0 = jnp.floor(sy)
+    x0 = jnp.floor(sx)
+    wy = sy - y0
+    wx = sx - x0
+
+    def gather(img_g, yy, xx):
+        """img_g (Cg,H,W); yy/xx (Ho,Wo,kh,kw) -> (Ho,Wo,kh,kw,Cg)"""
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        inb = ((yy >= 0) & (yy <= H - 1) & (xx >= 0)
+               & (xx <= W - 1)).astype(img_g.dtype)
+        vals = img_g[:, yi, xi]                      # (Cg,Ho,Wo,kh,kw)
+        return (vals * inb[None]).transpose(1, 2, 3, 4, 0)
+
+    Cg = C // G
+
+    def one(img, syb, sxb, y0b, x0b, wyb, wxb):
+        # img (C,H,W); per deformable group
+        cols = []
+        for g in range(G):
+            ig = img[g * Cg:(g + 1) * Cg]
+            v00 = gather(ig, y0b[g], x0b[g])
+            v01 = gather(ig, y0b[g], x0b[g] + 1)
+            v10 = gather(ig, y0b[g] + 1, x0b[g])
+            v11 = gather(ig, y0b[g] + 1, x0b[g] + 1)
+            wyg = wyb[g][..., None]
+            wxg = wxb[g][..., None]
+            v = (v00 * (1 - wyg) * (1 - wxg) + v01 * (1 - wyg) * wxg
+                 + v10 * wyg * (1 - wxg) + v11 * wyg * wxg)
+            cols.append(v)                            # (Ho,Wo,kh,kw,Cg)
+        return jnp.concatenate(cols, axis=-1)         # (Ho,Wo,kh,kw,C)
+
+    cols = jax.vmap(one)(data, sy, sx, y0, x0, wy, wx)  # (B,Ho,Wo,kh,kw,C)
+    # one MXU matmul: (B*Ho*Wo, kh*kw*C) x (kh*kw*C, Co)
+    cols2 = cols.reshape(B * Ho * Wo, kh * kw * C)
+    wmat = weight.transpose(2, 3, 1, 0).reshape(kh * kw * C, Co)
+    out = cols2 @ wmat
+    out = out.reshape(B, Ho, Wo, Co).transpose(0, 3, 1, 2)
+    if bias is not None:
+        out = out + bias.reshape(1, Co, 1, 1)
+    return out
+
+
+def psroi_pooling(data, rois, spatial_scale, output_dim, pooled_size,
+                  group_size=0):
+    """Position-sensitive ROI pooling (≙ psroi_pooling.cc, R-FCN).
+
+    data (B, output_dim*group*group, H, W); rois (R, 5)
+    [batch_idx, x1, y1, x2, y2] in image coords. Returns
+    (R, output_dim, pooled, pooled): bin (i,j) of output channel c
+    average-pools input channel (c*group + i)*group + j over its bin.
+    Differentiable w.r.t. data; jitted + cached per config."""
+    fn = _psroi_impl(float(spatial_scale), int(output_dim), int(pooled_size),
+                     int(group_size))
+    return fn(data, rois)
+
+
+@functools.lru_cache(maxsize=None)
+def _psroi_impl(spatial_scale, output_dim, pooled_size, group_size):
+    import jax
+
+    def impl(data, rois):
+        return _psroi_body(data, rois, spatial_scale, output_dim,
+                           pooled_size, group_size)
+
+    return jax.jit(impl)
+
+
+def _psroi_body(data, rois, spatial_scale, output_dim, pooled_size,
+                group_size):
+    import jax
+    jnp = _jnp()
+    B, C, H, W = data.shape
+    P = pooled_size
+    G = group_size if group_size > 0 else P
+
+    # fixed sampling lattice per bin (avoids dynamic bin extents under jit):
+    # 4x4 samples per bin, bilinear, averaged — dense enough to match the
+    # reference's exact-sum averaging closely and fully vectorizable
+    S = 4
+    frac = (jnp.arange(S, dtype=jnp.float32) + 0.5) / S
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw = rw / P
+        bh = rh / P
+        img = data[b]                                  # (C,H,W)
+
+        iy = jnp.arange(P, dtype=jnp.float32)
+        ix = jnp.arange(P, dtype=jnp.float32)
+        ys = y1 + (iy[:, None] + frac[None, :]) * bh   # (P,S)
+        xs = x1 + (ix[:, None] + frac[None, :]) * bw   # (P,S)
+        yi = jnp.clip(ys, 0, H - 1)
+        xi = jnp.clip(xs, 0, W - 1)
+        y0 = jnp.floor(yi).astype(jnp.int32)
+        x0 = jnp.floor(xi).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, H - 1)
+        x1i = jnp.minimum(x0 + 1, W - 1)
+        wy = (yi - y0)[:, :, None, None]               # (P,S,1,1)
+        wx = (xi - x0)[None, None, :, :]               # (1,1,P,S)
+
+        # channel map: out channel c, bin (i,j) -> in channel (c*G+gi)*G+gj
+        gi = jnp.minimum((iy).astype(jnp.int32) * G // P, G - 1)   # (P,)
+        gj = jnp.minimum((ix).astype(jnp.int32) * G // P, G - 1)
+        co = jnp.arange(output_dim)
+        cin = (co[:, None, None] * G + gi[None, :, None]) * G \
+            + gj[None, None, :]                        # (O,P,P)
+
+        # gather the 4 corners for all (P,S) x (P,S) sample points
+        def corner(yc, xc):
+            # (C, P,S, P,S)
+            return img[:, yc[:, :, None, None], xc[None, None, :, :]]
+
+        v00 = corner(y0, x0)
+        v01 = corner(y0, x1i)
+        v10 = corner(y1i, x0)
+        v11 = corner(y1i, x1i)
+        val = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+               + v10 * wy * (1 - wx) + v11 * wy * wx)  # (C,P,S,P,S)
+        pooled = val.mean(axis=(2, 4))                 # (C,P,P)
+        return pooled[cin, jnp.arange(P)[None, :, None],
+                      jnp.arange(P)[None, None, :]]    # (O,P,P)
+
+    return jax.vmap(one_roi)(rois)

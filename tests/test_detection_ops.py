@@ -1,0 +1,317 @@
+"""Detection op tail tests (≙ reference tests/python/unittest/test_operator
+MultiBox*/Proposal/deformable cases, src/operator/contrib/*).
+
+Each op is validated against an independent pure-numpy re-implementation of
+the reference C++ semantics (not against the jax code under test).
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import npx
+
+
+def _np_multibox_prior(h, w, sizes, ratios, steps=(-1, -1),
+                       offsets=(0.5, 0.5), clip=False):
+    """Literal transcription of MultiBoxPriorForward (multibox_prior.cc)."""
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    out = []
+    for r in range(h):
+        cy = (r + offsets[0]) * step_y
+        for c in range(w):
+            cx = (c + offsets[1]) * step_x
+            sr0 = np.sqrt(ratios[0])
+            for s in sizes:
+                bw = s * h / w * sr0 / 2
+                bh = s / sr0 / 2
+                out.append([cx - bw, cy - bh, cx + bw, cy + bh])
+            for rr in ratios[1:]:
+                sr = np.sqrt(rr)
+                bw = sizes[0] * h / w * sr / 2
+                bh = sizes[0] / sr / 2
+                out.append([cx - bw, cy - bh, cx + bw, cy + bh])
+    out = np.asarray(out, np.float32)
+    if clip:
+        out = np.clip(out, 0, 1)
+    return out[None]
+
+
+def test_multibox_prior_matches_reference_math():
+    x = mx.np.zeros((1, 8, 6, 9))  # NCHW: H=6, W=9
+    sizes, ratios = (0.4, 0.2), (1.0, 2.0, 0.5)
+    got = npx.multibox_prior(x, sizes=sizes, ratios=ratios).asnumpy()
+    want = _np_multibox_prior(6, 9, sizes, ratios)
+    assert got.shape == (1, 6 * 9 * 4, 4)     # K = 2 + 3 - 1
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_prior_clip_steps_offsets():
+    x = mx.np.zeros((2, 3, 4, 4))
+    got = npx.multibox_prior(x, sizes=(0.9,), ratios=(1.0,), clip=True,
+                             steps=(0.3, 0.3), offsets=(0.0, 0.0)).asnumpy()
+    want = _np_multibox_prior(4, 4, (0.9,), (1.0,), steps=(0.3, 0.3),
+                              offsets=(0.0, 0.0), clip=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert got.min() >= 0 and got.max() <= 1
+
+
+def _iou(a, b):
+    iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    i = iw * ih
+    u = ((a[2] - a[0]) * (a[3] - a[1])
+         + (b[2] - b[0]) * (b[3] - b[1]) - i)
+    return 0.0 if u <= 0 else i / u
+
+
+def _np_multibox_target(anchors, labels, overlap=0.5):
+    """Reference matching (multibox_target.cc:95-287), no mining."""
+    A, G = len(anchors), len(labels)
+    valid = 0
+    for g in range(G):
+        if labels[g][0] == -1:
+            break
+        valid += 1
+    flags = np.full(A, -1)
+    match = np.full(A, -1)
+    gt_done = [False] * valid
+    # bipartite
+    while not all(gt_done):
+        best = (1e-6, -1, -1)
+        for a in range(A):
+            if flags[a] == 1:
+                continue
+            for g in range(valid):
+                if gt_done[g]:
+                    continue
+                iou = _iou(anchors[a], labels[g][1:5])
+                if iou > best[0]:
+                    best = (iou, a, g)
+        if best[1] < 0:
+            break
+        flags[best[1]] = 1
+        match[best[1]] = best[2]
+        gt_done[best[2]] = True
+    # threshold
+    for a in range(A):
+        if flags[a] == 1:
+            continue
+        ious = [_iou(anchors[a], labels[g][1:5]) for g in range(valid)]
+        if not ious:
+            continue
+        g = int(np.argmax(ious))
+        match[a] = g
+        if ious[g] > overlap:
+            flags[a] = 1
+    cls_t = np.zeros(A, np.float32)
+    for a in range(A):
+        if flags[a] == 1:
+            cls_t[a] = labels[match[a]][0] + 1
+    return flags, match, cls_t
+
+
+def test_multibox_target_matching_parity():
+    rng = np.random.RandomState(0)
+    anchors = np.clip(np.sort(rng.uniform(0, 1, (12, 2, 2)), axis=1)
+                      .transpose(0, 2, 1).reshape(12, 4), 0, 1)
+    anchors = anchors[:, [0, 2, 1, 3]].astype(np.float32)
+    anchors.sort(axis=-1)  # ensure xmin<xmax etc. loosely
+    anchors = _np_multibox_prior(3, 4, (0.4, 0.7), (1.0,))[0]  # (12,4)
+    labels = np.array([[[1, 0.1, 0.1, 0.4, 0.45],
+                        [0, 0.55, 0.5, 0.9, 0.95],
+                        [-1, -1, -1, -1, -1]]], np.float32)
+    cls_pred = np.zeros((1, 3, len(anchors)), np.float32)
+
+    loc_t, loc_m, cls_t = npx.multibox_target(
+        mx.np.array(anchors[None]), mx.np.array(labels),
+        mx.np.array(cls_pred))
+    flags, match, cls_ref = _np_multibox_target(anchors, labels[0])
+    np.testing.assert_allclose(cls_t.asnumpy()[0], cls_ref)
+    # masks: 4 ones per positive anchor
+    lm = loc_m.asnumpy()[0].reshape(-1, 4)
+    np.testing.assert_allclose(lm[:, 0], (flags == 1).astype(np.float32))
+
+    # encode roundtrip: decoding the loc target with the matched anchor
+    # must recover the gt box
+    lt = loc_t.asnumpy()[0].reshape(-1, 4)
+    for a in range(len(anchors)):
+        if flags[a] != 1:
+            continue
+        g = labels[0][match[a]][1:5]
+        al, at, ar, ab = anchors[a]
+        aw, ah = ar - al, ab - at
+        ax, ay = (al + ar) / 2, (at + ab) / 2
+        ox = lt[a][0] * 0.1 * aw + ax
+        oy = lt[a][1] * 0.1 * ah + ay
+        ow = np.exp(lt[a][2] * 0.2) * aw
+        oh = np.exp(lt[a][3] * 0.2) * ah
+        np.testing.assert_allclose(
+            [ox - ow / 2, oy - oh / 2, ox + ow / 2, oy + oh / 2], g,
+            rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    anchors = _np_multibox_prior(4, 4, (0.3,), (1.0,))[0]   # (16,4)
+    labels = np.array([[[2, 0.05, 0.05, 0.35, 0.35],
+                        [-1, -1, -1, -1, -1]]], np.float32)
+    # higher logits on even anchors -> they should be picked as negatives
+    cls_pred = np.zeros((1, 4, 16), np.float32)
+    cls_pred[0, 1, ::2] = 5.0
+    _, _, cls_t = npx.multibox_target(
+        mx.np.array(anchors[None]), mx.np.array(labels),
+        mx.np.array(cls_pred), negative_mining_ratio=3.0,
+        negative_mining_thresh=0.5)
+    ct = cls_t.asnumpy()[0]
+    n_pos = int((ct > 0).sum())
+    n_neg = int((ct == 0).sum())
+    n_ign = int((ct == -1).sum())
+    assert n_pos >= 1
+    assert n_neg == min(3 * n_pos, 16 - n_pos)
+    assert n_pos + n_neg + n_ign == 16
+    # mined negatives are the high-logit anchors
+    neg_idx = np.where(ct == 0)[0]
+    assert all(i % 2 == 0 for i in neg_idx)
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = _np_multibox_prior(2, 2, (0.5,), (1.0,))      # (1,4,4)
+    A = 4
+    cls_prob = np.zeros((1, 3, A), np.float32)
+    cls_prob[0, 1, 0] = 0.9    # class 1 strong at anchor 0
+    cls_prob[0, 1, 1] = 0.8    # overlapping duplicate, should be suppressed
+    cls_prob[0, 2, 2] = 0.7    # class 2 at anchor 2 survives (other class)
+    cls_prob[0, 0, 3] = 1.0    # background
+    loc_pred = np.zeros((1, A * 4), np.float32)
+    # shift anchor 1 onto anchor 0 so they overlap
+    anc = anchors[0].copy()
+    anc[1] = anc[0] + np.float32([0.02, 0.02, 0.02, 0.02])
+    out = npx.multibox_detection(
+        mx.np.array(cls_prob), mx.np.array(loc_pred),
+        mx.np.array(anc[None]), nms_threshold=0.5).asnumpy()[0]
+    ids = out[:, 0]
+    # rows sorted by score: [cls1 0.9], [cls2 0.7] kept; dup suppressed
+    assert ids[0] == 0.0 and abs(out[0, 1] - 0.9) < 1e-6
+    assert ids[1] == 1.0 and abs(out[1, 1] - 0.7) < 1e-6
+    assert (ids[2:] == -1).all()
+    # decoded box at zero deltas == anchor
+    np.testing.assert_allclose(out[0, 2:6], anc[0], rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_detection_force_suppress_and_threshold():
+    anc = _np_multibox_prior(2, 2, (0.5,), (1.0,))[0]
+    anc[1] = anc[0] + 0.01
+    cls_prob = np.zeros((1, 3, 4), np.float32)
+    cls_prob[0, 1, 0] = 0.9
+    cls_prob[0, 2, 1] = 0.8   # different class, overlapping
+    cls_prob[0, 1, 2] = 0.005  # below threshold -> background
+    loc_pred = np.zeros((1, 16), np.float32)
+    out = npx.multibox_detection(
+        mx.np.array(cls_prob), mx.np.array(loc_pred), mx.np.array(anc[None]),
+        force_suppress=True, nms_threshold=0.5).asnumpy()[0]
+    assert out[0, 0] == 0.0          # top box kept
+    assert (out[1:, 0] == -1).all()  # cross-class suppressed + low score
+
+
+def test_proposal_shapes_and_ordering():
+    rng = np.random.RandomState(0)
+    K, H, W = 6, 5, 5  # 2 scales x 3 ratios
+    cls_prob = rng.uniform(0, 1, (1, 2 * K, H, W)).astype(np.float32)
+    bbox_pred = (rng.randn(1, 4 * K, H, W) * 0.1).astype(np.float32)
+    im_info = np.array([[80.0, 80.0, 1.0]], np.float32)
+    rois, scores = npx.proposal(
+        mx.np.array(cls_prob), mx.np.array(bbox_pred),
+        mx.np.array(im_info), rpn_pre_nms_top_n=60, rpn_post_nms_top_n=20,
+        scales=(4, 8), ratios=(0.5, 1, 2), feature_stride=16,
+        rpn_min_size=4, output_score=True)
+    rois = rois.asnumpy()
+    scores = scores.asnumpy()
+    assert rois.shape == (20, 5) and scores.shape == (20, 1)
+    assert (rois[:, 0] == 0).all()
+    # boxes clipped to image
+    assert rois[:, 1:].min() >= 0 and rois[:, 1:].max() <= 79.0
+    assert (rois[:, 3] >= rois[:, 1]).all() and (rois[:, 4] >= rois[:, 2]).all()
+    # scores descending where valid
+    s = scores[:, 0]
+    assert (np.diff(s) <= 1e-6).all()
+
+
+def test_deformable_convolution_zero_offset_equals_conv():
+    import jax
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 9, 9).astype(np.float32)
+    wgt = (rng.randn(6, 4, 3, 3) * 0.2).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 7, 7), np.float32)
+    out = npx.deformable_convolution(
+        mx.np.array(x), mx.np.array(off), mx.np.array(wgt),
+        kernel=(3, 3)).asnumpy()
+    want = jax.lax.conv_general_dilated(
+        x, wgt, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(out, np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_convolution_integer_offset_shifts_sampling():
+    x = np.zeros((1, 1, 6, 6), np.float32)
+    x[0, 0, 2, 3] = 1.0
+    wgt = np.zeros((1, 1, 1, 1), np.float32)
+    wgt[0, 0, 0, 0] = 1.0
+    # offset (dy=+1, dx=+2) at every output position -> out[y][x]=x[y+1][x+2]
+    off = np.zeros((1, 2, 6, 6), np.float32)
+    off[0, 0] = 1.0
+    off[0, 1] = 2.0
+    out = npx.deformable_convolution(
+        mx.np.array(x), mx.np.array(off), mx.np.array(wgt),
+        kernel=(1, 1)).asnumpy()
+    want = np.zeros_like(x)
+    want[0, 0, 1, 1] = 1.0
+    np.testing.assert_allclose(out, want, atol=1e-6)
+
+
+def test_deformable_convolution_differentiable():
+    rng = np.random.RandomState(0)
+    x = mx.np.array(rng.randn(1, 2, 6, 6).astype(np.float32))
+    off = mx.np.array((rng.randn(1, 2 * 9, 4, 4) * 0.3).astype(np.float32))
+    wgt = mx.np.array((rng.randn(3, 2, 3, 3) * 0.1).astype(np.float32))
+    x.attach_grad()
+    off.attach_grad()
+    wgt.attach_grad()
+    with mx.autograd.record():
+        y = npx.deformable_convolution(x, off, wgt, kernel=(3, 3))
+        L = (y * y).sum()
+    L.backward()
+    assert float(np.abs(x.grad.asnumpy()).sum()) > 0
+    assert float(np.abs(off.grad.asnumpy()).sum()) > 0
+    assert float(np.abs(wgt.grad.asnumpy()).sum()) > 0
+
+
+def test_psroi_pooling_position_sensitivity():
+    # channels encode (out_channel, bin) identity: pooled value for output
+    # channel c at bin (i,j) must come from input channel (c*G+i)*G+j
+    O, G, P = 2, 2, 2
+    B, H, W = 1, 8, 8
+    C = O * G * G
+    data = np.zeros((B, C, H, W), np.float32)
+    for c in range(C):
+        data[0, c] = c  # constant per channel
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = npx.psroi_pooling(
+        mx.np.array(data), mx.np.array(rois), spatial_scale=1.0,
+        output_dim=O, pooled_size=P, group_size=G).asnumpy()
+    assert out.shape == (1, O, P, P)
+    for c in range(O):
+        for i in range(P):
+            for j in range(P):
+                expect = (c * G + i) * G + j
+                np.testing.assert_allclose(out[0, c, i, j], expect,
+                                           rtol=1e-5)
+
+
+def test_psroi_pooling_roi_batch_index():
+    data = np.zeros((2, 4, 6, 6), np.float32)
+    data[1] = 3.0
+    rois = np.array([[1, 0, 0, 5, 5]], np.float32)
+    out = npx.psroi_pooling(mx.np.array(data), mx.np.array(rois),
+                            spatial_scale=1.0, output_dim=1,
+                            pooled_size=2, group_size=2).asnumpy()
+    np.testing.assert_allclose(out, np.full((1, 1, 2, 2), 3.0))

@@ -101,8 +101,8 @@ def test_export_stablehlo(tmp_path):
     x = mx.np.array(np.random.randn(2, 4).astype(np.float32))
     net(x)
     files = net.export(str(tmp_path / "model"), example_inputs=x)
-    assert isinstance(files, tuple) and len(files) == 2
-    params_file, hlo_file = files
+    assert isinstance(files, tuple) and len(files) == 4
+    params_file, hlo_file = files[0], files[1]
     # without example_inputs: params only, still a tuple
     (only_params,) = net.export(str(tmp_path / "model2"))
     assert os.path.exists(only_params)
