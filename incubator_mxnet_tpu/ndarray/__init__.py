@@ -234,13 +234,18 @@ class NDArray:
     # shape manipulation (methods delegate to the functional layer)
     # ------------------------------------------------------------------
     def reshape(self, *shape, **kwargs):
+        """NumPy semantics (≙ mx.np.ndarray.reshape, multiarray.py:1621):
+        -1 infers, 0 is a literal zero-size dim. The legacy 0=copy-dim
+        magic lives in the module-level `reshape` (mx.nd parity)."""
         from ..ops.registry import invoke
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])
-        # reference reshape magic numbers: -1 infer (np-compatible), 0 copy-dim
-        if 0 in shape:
-            shape = tuple(self.shape[i] if s == 0 else s
-                          for i, s in enumerate(shape))
+        if 0 in shape and self.size != 0:
+            raise MXNetError(
+                f"cannot reshape array of size {self.size} into shape "
+                f"{shape}: 0 is a literal zero-size dim under np "
+                f"semantics; for the legacy 0=copy-dim magic use "
+                f"mx.nd.reshape(a, shape)")
         return invoke(lambda x: x.reshape(shape), (self,), name="reshape")
 
     def reshape_like(self, other):
@@ -393,7 +398,7 @@ class NDArray:
             # write-through view: update the base storage
             base = self._base
             cur = base._arr
-            if nd_key == slice(None, None, None):
+            if _is_plain_slice_all(nd_key):
                 new_base = cur.at[self._base_index].set(value)
             else:
                 sub = cur[self._base_index].at[nd_key].set(value)
@@ -403,7 +408,7 @@ class NDArray:
             self._base_version = base._version
             self._version += 1
         else:
-            if nd_key == slice(None, None, None) and not _np.isscalar(value):
+            if _is_plain_slice_all(nd_key) and not _np.isscalar(value):
                 new = jnp.broadcast_to(jnp.asarray(value, self.dtype), self.shape)
             else:
                 new = self._arr.at[nd_key].set(value)
@@ -539,12 +544,22 @@ def _as_nd(x, device=None, dtype=None):
 
 
 def _index_to_raw(key):
-    """Convert NDArray components of an index into raw arrays."""
-    if isinstance(key, NDArray):
-        return key._arr
+    """Convert NDArray / numpy-array / list components of an index into
+    raw jax arrays (jax rejects non-tuple sequences and raw numpy bool
+    masks would hit ambiguous-truth comparisons downstream)."""
+    def conv(k):
+        if isinstance(k, NDArray):
+            return k._arr
+        if isinstance(k, (list, _np.ndarray)):
+            return _jnp().asarray(k)
+        return k
     if isinstance(key, tuple):
-        return tuple(k._arr if isinstance(k, NDArray) else k for k in key)
-    return key
+        return tuple(conv(k) for k in key)
+    return conv(key)
+
+
+def _is_plain_slice_all(key):
+    return isinstance(key, slice) and key == slice(None, None, None)
 
 
 def _is_basic_index(key):
@@ -594,6 +609,23 @@ def arange(start, stop=None, step=1.0, repeat=1, device=None, dtype=None, ctx=No
     if repeat != 1:
         out = jnp.repeat(out, repeat)
     return _wrap(_place(out, device or ctx))
+
+
+def reshape(a, shape, reverse=False):
+    """Legacy mx.nd.reshape with the reference's magic values
+    (≙ src/operator/tensor/matrix_op.cc Reshape): 0 = copy the input dim,
+    -1 = infer; reverse=True aligns the magic from the right. (np users:
+    use the method/`mx.np.reshape`, pure numpy semantics.)"""
+    if isinstance(shape, int):
+        shape = (shape,)
+    if reverse:
+        in_rev = a.shape[::-1]
+        shape = tuple(in_rev[i] if s == 0 else s
+                      for i, s in enumerate(shape[::-1]))[::-1]
+    else:
+        shape = tuple(a.shape[i] if s == 0 else s
+                      for i, s in enumerate(shape))
+    return a.reshape(shape)
 
 
 def zeros_like(a):

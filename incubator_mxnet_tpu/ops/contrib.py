@@ -331,14 +331,15 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
 
 @functools.lru_cache(maxsize=None)
 def _multibox_detection_impl(clip, threshold, nms_threshold, force_suppress,
-                             variances, nms_topk):
+                             variances, nms_topk, background_id):
     import jax
     jnp = _jnp()
 
     def impl(cls_prob, loc_pred, anchor):
         return _multibox_detection_body(
             jnp, jax, cls_prob, loc_pred, anchor, clip, threshold,
-            nms_threshold, force_suppress, variances, nms_topk)
+            nms_threshold, force_suppress, variances, nms_topk,
+            background_id)
 
     return jax.jit(impl)
 
@@ -359,7 +360,8 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
     import jax
     fn = _multibox_detection_impl(
         bool(clip), float(threshold), float(nms_threshold),
-        bool(force_suppress), tuple(variances), int(nms_topk))
+        bool(force_suppress), tuple(variances), int(nms_topk),
+        int(background_id))
     return fn(jax.lax.stop_gradient(cls_prob),
               jax.lax.stop_gradient(loc_pred),
               jax.lax.stop_gradient(anchor))
@@ -367,7 +369,7 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
 
 def _multibox_detection_body(jnp, jax, cls_prob, loc_pred, anchor, clip,
                              threshold, nms_threshold, force_suppress,
-                             variances, nms_topk):
+                             variances, nms_topk, background_id):
     anc = anchor.reshape(-1, 4)
     A = anc.shape[0]
 
@@ -376,11 +378,18 @@ def _multibox_detection_body(jnp, jax, cls_prob, loc_pred, anchor, clip,
     ax = (anc[:, 0] + anc[:, 2]) * 0.5
     ay = (anc[:, 1] + anc[:, 3]) * 0.5
 
+    num_cls = cls_prob.shape[1]
+
     def one(cprob, lpred):
         lp = lpred.reshape(A, 4)
-        score = jnp.max(cprob[1:], axis=0)          # best fg prob (A,)
-        cid = jnp.argmax(cprob[1:], axis=0) + 1     # 1-based class
-        cid = jnp.where(score < threshold, 0, cid)  # ≙ id>0 && score<thr
+        # mask the background row, take the best remaining class (the
+        # reference declares background_id but hardcodes 0 — here it's
+        # honored; out ids renumber with the background removed)
+        fg = cprob.at[background_id].set(-jnp.inf)
+        score = jnp.max(fg, axis=0)                  # best fg prob (A,)
+        cls = jnp.argmax(fg, axis=0)                 # true class index
+        cid = cls - (cls > background_id).astype(cls.dtype) + 1
+        cid = jnp.where(score < threshold, 0, cid)   # ≙ id>0 && score<thr
         ox = lp[:, 0] * variances[0] * aw + ax
         oy = lp[:, 1] * variances[1] * ah + ay
         ow = jnp.exp(lp[:, 2] * variances[2]) * aw / 2
@@ -753,19 +762,24 @@ def _psroi_body(data, rois, spatial_scale, output_dim, pooled_size,
         cin = (co[:, None, None] * G + gi[None, :, None]) * G \
             + gj[None, None, :]                        # (O,P,P)
 
-        # gather the 4 corners for all (P,S) x (P,S) sample points
+        # gather the 4 corners ONLY for the channel each (c, bin_y, bin_x)
+        # actually pools (indexing the channel map in the same gather
+        # avoids the G^2-times overcompute of sampling all C channels)
+        ch = cin[:, :, None, :, None]                  # (O,P,1,P,1)
+
         def corner(yc, xc):
-            # (C, P,S, P,S)
-            return img[:, yc[:, :, None, None], xc[None, None, :, :]]
+            # (O, P,S, P,S): channel, y-sample, x-sample advanced-indexed
+            return img[ch, yc[None, :, :, None, None],
+                       xc[None, None, None, :, :]]
 
         v00 = corner(y0, x0)
         v01 = corner(y0, x1i)
         v10 = corner(y1i, x0)
         v11 = corner(y1i, x1i)
-        val = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
-               + v10 * wy * (1 - wx) + v11 * wy * wx)  # (C,P,S,P,S)
-        pooled = val.mean(axis=(2, 4))                 # (C,P,P)
-        return pooled[cin, jnp.arange(P)[None, :, None],
-                      jnp.arange(P)[None, None, :]]    # (O,P,P)
+        wyb = wy[None]                                 # (1,P,S,1,1)
+        wxb = wx[None]                                 # (1,1,1,P,S)
+        val = (v00 * (1 - wyb) * (1 - wxb) + v01 * (1 - wyb) * wxb
+               + v10 * wyb * (1 - wxb) + v11 * wyb * wxb)  # (O,P,S,P,S)
+        return val.mean(axis=(2, 4))                   # (O,P,P)
 
     return jax.vmap(one_roi)(rois)

@@ -315,3 +315,19 @@ def test_psroi_pooling_roi_batch_index():
                             spatial_scale=1.0, output_dim=1,
                             pooled_size=2, group_size=2).asnumpy()
     np.testing.assert_allclose(out, np.full((1, 1, 2, 2), 3.0))
+
+
+def test_multibox_detection_background_id():
+    """background_id != 0 must be honored (the reference declares the
+    param; here it works): ids renumber with the bg class removed."""
+    anc = _np_multibox_prior(2, 2, (0.5,), (1.0,))[0]
+    cls_prob = np.zeros((1, 3, 4), np.float32)
+    cls_prob[0, 0, 0] = 0.9     # class 0 = foreground now
+    cls_prob[0, 2, 1] = 0.8     # class 2 = foreground
+    cls_prob[0, 1, 2] = 1.0     # class 1 = background -> not a detection
+    out = npx.multibox_detection(
+        mx.np.array(cls_prob), mx.np.array(np.zeros((1, 16), np.float32)),
+        mx.np.array(anc[None]), background_id=1,
+        nms_threshold=0.9).asnumpy()[0]
+    ids = sorted(out[out[:, 0] >= 0][:, 0])
+    assert ids == [0.0, 1.0]    # class0 -> id0, class2 -> id1

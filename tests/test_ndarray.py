@@ -58,7 +58,7 @@ def test_reshape_transpose():
     assert a.shape == (3, 4)
     assert a.T.shape == (4, 3)
     assert a.reshape(-1).shape == (12,)
-    assert a.reshape((0, -1)).shape == (3, 4)  # reference 0 = copy-dim
+    assert mx.nd.reshape(a, (0, -1)).shape == (3, 4)  # legacy 0 = copy-dim
     assert a.transpose(1, 0).shape == (4, 3)
     assert a.flatten().shape == (3, 4)
     b = mx.np.zeros((1, 3, 1))

@@ -1,0 +1,403 @@
+"""NumPy edge-semantics sweep (≙ reference
+tests/python/unittest/test_numpy_op.py's corner-case coverage:
+zero-size dims, boolean-mask read/assignment, dtype promotion, advanced
+indexing, view/write semantics). Every case checks mx.np against real
+numpy on the same inputs.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+mnp = mx.np
+
+
+def _eq(got, want, **kw):
+    got = got.asnumpy() if hasattr(got, "asnumpy") else got
+    want = np.asarray(want)
+    assert got.shape == want.shape, f"{got.shape} != {want.shape}"
+    np.testing.assert_allclose(got, want, **kw)
+
+
+# --------------------------------------------------------------- zero-size
+class TestZeroSize:
+    def test_creation_shapes(self):
+        for shape in [(0,), (0, 3), (3, 0), (2, 0, 4), (0, 0)]:
+            _eq(mnp.zeros(shape), np.zeros(shape, np.float32))
+            _eq(mnp.ones(shape), np.ones(shape, np.float32))
+            assert mnp.array(np.empty(shape, np.float32)).shape == shape
+
+    def test_reductions_on_empty(self):
+        x = mnp.zeros((0, 3))
+        _eq(mnp.sum(x), np.float32(0.0))
+        _eq(mnp.sum(x, axis=0), np.zeros(3, np.float32))
+        _eq(mnp.prod(x, axis=0), np.ones(3, np.float32))
+        _eq(mnp.sum(x, axis=1), np.zeros((0,), np.float32))
+
+    def test_elementwise_on_empty(self):
+        x = mnp.zeros((0, 4))
+        _eq(x + 1, np.zeros((0, 4), np.float32))
+        _eq(mnp.exp(x), np.zeros((0, 4), np.float32))
+        _eq(x * x, np.zeros((0, 4), np.float32))
+
+    def test_concatenate_with_empty(self):
+        a = mnp.ones((0, 2))
+        b = mnp.ones((3, 2))
+        _eq(mnp.concatenate([a, b], axis=0), np.ones((3, 2), np.float32))
+        _eq(mnp.concatenate([a, a], axis=0), np.ones((0, 2), np.float32))
+
+    def test_reshape_and_transpose_empty(self):
+        x = mnp.zeros((2, 0, 3))
+        assert x.reshape((0, 6)).shape == (0, 6)
+        assert x.transpose((2, 0, 1)).shape == (3, 2, 0)
+        assert x.T.shape == (3, 0, 2)
+
+    def test_matmul_empty(self):
+        a = mnp.ones((0, 4))
+        b = mnp.ones((4, 5))
+        _eq(mnp.dot(a, b), np.zeros((0, 5), np.float32))
+        a2 = mnp.ones((3, 0))
+        b2 = mnp.ones((0, 5))
+        _eq(mnp.dot(a2, b2), np.zeros((3, 5), np.float32))
+
+    def test_stack_split_empty(self):
+        x = mnp.zeros((0, 2))
+        assert mnp.stack([x, x], axis=0).shape == (2, 0, 2)
+        parts = mnp.split(mnp.ones((4, 0)), 2, axis=0)
+        assert len(parts) == 2 and parts[0].shape == (2, 0)
+
+    def test_boolean_mask_on_empty(self):
+        x = mnp.zeros((0,))
+        m = mnp.array(np.zeros((0,), bool))
+        assert x[m].shape == (0,)
+
+
+# --------------------------------------------------------- boolean masking
+class TestBooleanMask:
+    def test_read_1d(self):
+        xn = np.arange(6, dtype=np.float32)
+        m = xn % 2 == 0
+        x = mnp.array(xn)
+        _eq(x[mnp.array(m)], xn[m])
+
+    def test_read_2d_full_mask(self):
+        xn = np.arange(12, dtype=np.float32).reshape(3, 4)
+        m = xn > 5
+        _eq(mnp.array(xn)[mnp.array(m)], xn[m])
+
+    def test_read_axis0_mask(self):
+        xn = np.arange(12, dtype=np.float32).reshape(3, 4)
+        m = np.array([True, False, True])
+        _eq(mnp.array(xn)[mnp.array(m)], xn[m])
+
+    def test_assign_scalar(self):
+        xn = np.arange(6, dtype=np.float32)
+        m = xn > 2
+        x = mnp.array(xn)
+        x[mnp.array(m)] = -1.0
+        xn[m] = -1.0
+        _eq(x, xn)
+
+    def test_assign_array(self):
+        xn = np.arange(6, dtype=np.float32)
+        m = np.array([True, False, True, False, True, False])
+        vals = np.array([10, 20, 30], np.float32)
+        x = mnp.array(xn)
+        x[mnp.array(m)] = mnp.array(vals)
+        xn[m] = vals
+        _eq(x, xn)
+
+    def test_assign_2d_scalar(self):
+        xn = np.arange(12, dtype=np.float32).reshape(3, 4)
+        m = xn % 3 == 0
+        x = mnp.array(xn)
+        x[mnp.array(m)] = 99.0
+        xn[m] = 99.0
+        _eq(x, xn)
+
+    def test_numpy_bool_array_as_index(self):
+        """Raw numpy bool arrays must work as masks too."""
+        xn = np.arange(6, dtype=np.float32)
+        m = xn < 3
+        x = mnp.array(xn)
+        _eq(x[m], xn[m])
+        x[m] = 7.0
+        xn[m] = 7.0
+        _eq(x, xn)
+
+    def test_where(self):
+        xn = np.arange(8, dtype=np.float32)
+        _eq(mnp.where(mnp.array(xn > 3), mnp.array(xn), -mnp.array(xn)),
+            np.where(xn > 3, xn, -xn))
+
+
+# -------------------------------------------------------- dtype promotion
+class TestPromotion:
+    def test_default_dtype_is_float32(self):
+        assert str(mnp.zeros((2,)).dtype) == "float32"
+        assert str(mnp.ones((2,)).dtype) == "float32"
+        assert str(mnp.array([1.5, 2.5]).dtype) in ("float32", "float64")
+
+    def test_int_float_promotes_to_float(self):
+        a = mnp.array(np.array([1, 2], np.int32))
+        b = mnp.array(np.array([0.5, 0.5], np.float32))
+        out = a + b
+        assert str(out.dtype) == "float32"
+        _eq(out, np.array([1.5, 2.5], np.float32))
+
+    def test_int_int_stays_int(self):
+        a = mnp.array(np.array([1, 2], np.int32))
+        b = mnp.array(np.array([3, 4], np.int32))
+        assert str((a + b).dtype) == "int32"
+        assert str((a * b).dtype) == "int32"
+
+    def test_int32_int64(self):
+        # 32-bit default platform width (jax convention; enable
+        # JAX_ENABLE_X64 for true int64) — promotion must still pick the
+        # widest available int
+        a = mnp.array(np.array([1, 2], np.int32))
+        b = mnp.array(np.array([3, 4], np.int64))
+        assert str((a + b).dtype) in ("int32", "int64")
+
+    def test_python_scalar_keeps_array_dtype(self):
+        a = mnp.array(np.array([1, 2], np.int32))
+        assert str((a + 1).dtype) == "int32"
+        f = mnp.array(np.array([1, 2], np.float32))
+        assert str((f + 1).dtype) == "float32"
+        assert str((f + 1.5).dtype) == "float32"
+
+    def test_float_scalar_promotes_int_array(self):
+        a = mnp.array(np.array([1, 2], np.int32))
+        out = a + 0.5
+        assert "float" in str(out.dtype)
+        _eq(out, np.array([1.5, 2.5], np.float32))
+
+    def test_bool_arithmetic(self):
+        a = mnp.array(np.array([True, False]))
+        out = a + a
+        assert str(out.dtype) in ("bool", "int32", "int64")
+        s = mnp.sum(mnp.array(np.array([True, True, False])))
+        assert int(s.asnumpy()) == 2
+
+    def test_true_divide_int(self):
+        a = mnp.array(np.array([3, 4], np.int32))
+        out = a / 2
+        assert "float" in str(out.dtype)
+        _eq(out, np.array([1.5, 2.0], np.float32))
+
+    def test_float16_float32(self):
+        a = mnp.array(np.array([1, 2], np.float16))
+        b = mnp.array(np.array([1, 2], np.float32))
+        assert str((a + b).dtype) == "float32"
+
+    def test_comparison_yields_bool(self):
+        a = mnp.array(np.array([1.0, 2.0], np.float32))
+        assert str((a > 1.0).dtype) == "bool"
+        assert str((a == a).dtype) == "bool"
+
+
+# ------------------------------------------------------ advanced indexing
+class TestAdvancedIndexing:
+    def setup_method(self):
+        self.xn = np.arange(24, dtype=np.float32).reshape(4, 6)
+        self.x = mnp.array(self.xn)
+
+    def test_int_array_rows(self):
+        idx = np.array([2, 0, 3])
+        _eq(self.x[mnp.array(idx)], self.xn[idx])
+        _eq(self.x[idx], self.xn[idx])          # raw numpy index
+        _eq(self.x[[2, 0, 3]], self.xn[[2, 0, 3]])  # python list
+
+    def test_negative_int_array(self):
+        idx = np.array([-1, -4])
+        _eq(self.x[idx], self.xn[idx])
+
+    def test_two_int_arrays(self):
+        r = np.array([0, 1, 3])
+        c = np.array([5, 2, 0])
+        _eq(self.x[r, c], self.xn[r, c])
+
+    def test_slice_plus_array(self):
+        c = np.array([0, 2])
+        _eq(self.x[1:3, c], self.xn[1:3, c])
+
+    def test_newaxis_and_ellipsis(self):
+        _eq(self.x[None], self.xn[None])
+        _eq(self.x[..., 0], self.xn[..., 0])
+        _eq(self.x[None, ..., None], self.xn[None, ..., None])
+
+    def test_negative_step_slice(self):
+        _eq(self.x[::-1], self.xn[::-1])
+        _eq(self.x[:, ::-2], self.xn[:, ::-2])
+        _eq(self.x[3:0:-1, 1:5:2], self.xn[3:0:-1, 1:5:2])
+
+    def test_setitem_int_array(self):
+        x = mnp.array(self.xn)
+        xn = self.xn.copy()
+        x[[0, 2]] = 0.0
+        xn[[0, 2]] = 0.0
+        _eq(x, xn)
+
+    def test_setitem_coordinates(self):
+        x = mnp.array(self.xn)
+        xn = self.xn.copy()
+        x[np.array([0, 1]), np.array([1, 2])] = mnp.array(
+            np.array([-5.0, -6.0], np.float32))
+        xn[np.array([0, 1]), np.array([1, 2])] = [-5.0, -6.0]
+        _eq(x, xn)
+
+    def test_setitem_slice_broadcast(self):
+        x = mnp.array(self.xn)
+        xn = self.xn.copy()
+        x[1:3] = mnp.array(np.arange(6, dtype=np.float32))
+        xn[1:3] = np.arange(6, dtype=np.float32)
+        _eq(x, xn)
+
+    def test_take_along_gather(self):
+        idx = np.array([[0, 1], [2, 3], [1, 0], [5, 4]])
+        _eq(mnp.take_along_axis(self.x, mnp.array(idx), axis=1),
+            np.take_along_axis(self.xn, idx, axis=1))
+
+    def test_view_aliases_base(self):
+        """Basic-slice views alias the base (reference NDArray shared-
+        memory semantics): writes to the base are visible in the view and
+        vice versa."""
+        x = mnp.array(self.xn)
+        v = x[1]
+        x[1] = 0.0
+        _eq(v, np.zeros(6, np.float32))
+        v[2] = 7.0
+        assert float(x.asnumpy()[1, 2]) == 7.0
+
+
+# ---------------------------------------------------------- shape corner
+class TestShapeCorners:
+    def test_scalar_array_item(self):
+        s = mnp.array(3.25)
+        assert s.shape == ()
+        assert float(s.asnumpy()) == 3.25
+        assert s.item() == 3.25
+
+    def test_expand_squeeze(self):
+        x = mnp.zeros((2, 1, 3))
+        assert mnp.squeeze(x, axis=1).shape == (2, 3)
+        assert mnp.expand_dims(x, 0).shape == (1, 2, 1, 3)
+        with pytest.raises(Exception):
+            mnp.squeeze(x, axis=0)
+
+    def test_broadcast_to(self):
+        x = mnp.array(np.arange(3, dtype=np.float32))
+        _eq(mnp.broadcast_to(x, (2, 3)),
+            np.broadcast_to(np.arange(3, dtype=np.float32), (2, 3)))
+
+    def test_reshape_minus_one(self):
+        x = mnp.zeros((4, 6))
+        assert x.reshape((-1,)).shape == (24,)
+        assert x.reshape((2, -1)).shape == (2, 12)
+        assert x.reshape((-1, 8)).shape == (3, 8)
+
+    def test_keepdims_and_axis_tuple(self):
+        xn = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        x = mnp.array(xn)
+        _eq(mnp.sum(x, axis=(0, 2)), xn.sum(axis=(0, 2)))
+        _eq(mnp.sum(x, axis=(0, 2), keepdims=True),
+            xn.sum(axis=(0, 2), keepdims=True))
+        _eq(mnp.mean(x, axis=-1), xn.mean(axis=-1))
+
+    def test_argminmax_and_ties(self):
+        xn = np.array([[3, 1, 1], [2, 2, 0]], np.float32)
+        x = mnp.array(xn)
+        _eq(mnp.argmax(x, axis=1).asnumpy().astype(np.int64),
+            np.argmax(xn, axis=1))
+        _eq(mnp.argmin(x, axis=1).asnumpy().astype(np.int64),
+            np.argmin(xn, axis=1))
+
+    def test_clip_none_bounds(self):
+        xn = np.array([-2.0, 0.5, 3.0], np.float32)
+        x = mnp.array(xn)
+        _eq(mnp.clip(x, 0, None), np.clip(xn, 0, None))
+        _eq(mnp.clip(x, None, 1), np.clip(xn, None, 1))
+
+    def test_nan_propagation(self):
+        xn = np.array([1.0, np.nan, 3.0], np.float32)
+        x = mnp.array(xn)
+        assert np.isnan(mnp.max(x).asnumpy())
+        assert not np.isnan(mnp.nanmax(x).asnumpy()) if hasattr(
+            mnp, "nanmax") else True
+        got = mnp.isnan(x).asnumpy()
+        np.testing.assert_array_equal(got, np.isnan(xn))
+
+
+# ---------------------------------------------------- misc numpy parity
+class TestMiscParity:
+    def test_arange_linspace(self):
+        _eq(mnp.arange(5), np.arange(5, dtype=np.float32))
+        _eq(mnp.arange(1, 7, 2), np.arange(1, 7, 2, dtype=np.float32))
+        _eq(mnp.linspace(0, 1, 5), np.linspace(0, 1, 5, dtype=np.float32))
+
+    def test_einsum(self):
+        an = np.arange(6, dtype=np.float32).reshape(2, 3)
+        bn = np.arange(12, dtype=np.float32).reshape(3, 4)
+        _eq(mnp.einsum("ij,jk->ik", mnp.array(an), mnp.array(bn)),
+            np.einsum("ij,jk->ik", an, bn))
+
+    def test_cumsum_cumprod(self):
+        xn = np.arange(1, 7, dtype=np.float32).reshape(2, 3)
+        x = mnp.array(xn)
+        _eq(mnp.cumsum(x, axis=1), np.cumsum(xn, axis=1))
+        _eq(mnp.cumsum(x), np.cumsum(xn))
+
+    def test_sort_argsort(self):
+        xn = np.array([[3, 1, 2], [0, 5, 4]], np.float32)
+        x = mnp.array(xn)
+        _eq(mnp.sort(x, axis=1), np.sort(xn, axis=1))
+        _eq(mnp.argsort(x, axis=1).asnumpy().astype(np.int64),
+            np.argsort(xn, axis=1, kind="stable"))
+
+    def test_unique(self):
+        xn = np.array([3, 1, 2, 1, 3], np.float32)
+        got = mnp.unique(mnp.array(xn))
+        _eq(got, np.unique(xn))
+
+    def test_tile_repeat(self):
+        xn = np.array([[1, 2]], np.float32)
+        x = mnp.array(xn)
+        _eq(mnp.tile(x, (2, 3)), np.tile(xn, (2, 3)))
+        _eq(mnp.repeat(x, 2, axis=1), np.repeat(xn, 2, axis=1))
+
+    def test_outer_inner(self):
+        an = np.arange(3, dtype=np.float32)
+        bn = np.arange(4, dtype=np.float32)
+        _eq(mnp.outer(mnp.array(an), mnp.array(bn)), np.outer(an, bn))
+
+    def test_divmod_ops(self):
+        an = np.array([7.0, -7.0], np.float32)
+        b = 3.0
+        _eq(mnp.array(an) % b, an % b)
+        _eq(mnp.array(an) // b, an // b)
+
+    def test_maximum_minimum_scalar(self):
+        xn = np.array([-1.0, 2.0], np.float32)
+        _eq(mnp.maximum(mnp.array(xn), 0), np.maximum(xn, 0))
+        _eq(mnp.minimum(mnp.array(xn), 0), np.minimum(xn, 0))
+
+    def test_power_and_neg_base(self):
+        xn = np.array([1.0, 4.0, 9.0], np.float32)
+        _eq(mnp.power(mnp.array(xn), 0.5), np.power(xn, 0.5))
+        _eq(mnp.array(xn) ** 2, xn ** 2)
+
+
+class TestLegacyReshape:
+    def test_copy_dim_left(self):
+        a = mnp.zeros((2, 3, 4))
+        assert mx.nd.reshape(a, (0, -1)).shape == (2, 12)
+        assert mx.nd.reshape(a, (0, 0, 4)).shape == (2, 3, 4)
+
+    def test_copy_dim_reverse(self):
+        a = mnp.zeros((2, 3, 4))
+        assert mx.nd.reshape(a, (-1, 0), reverse=True).shape == (6, 4)
+
+    def test_np_reshape_zero_on_nonempty_raises_clearly(self):
+        a = mnp.zeros((3, 4))
+        with pytest.raises(mx.MXNetError, match="mx.nd.reshape"):
+            a.reshape((0, -1))
