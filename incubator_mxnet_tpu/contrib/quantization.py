@@ -83,7 +83,10 @@ class CalibrationCollector:
 
     def attach(self, net):
         for name, child in _iter_named_blocks(net):
-            h = child.register_forward_hook(self._make_hook(name))
+            reg = getattr(child, "register_forward_hook", None)
+            if reg is None:   # folded-away / already-converted stand-ins
+                continue
+            h = reg(self._make_hook(name))
             self._handles.append(h)
         return self
 
@@ -301,11 +304,15 @@ def _walk_blocks(net):
 
 
 def quantize_net(net, calib_data=None, calib_mode="naive", num_batches=10,
-                 exclude_layers=None):
-    """≙ contrib.quantization.quantize_net: swap Dense/Conv2D children for
-    int8 versions (in place), calibrating activation ranges if data given."""
+                 exclude_layers=None, fold_bn=True):
+    """≙ contrib.quantization.quantize_net: fold inference BatchNorms into
+    their preceding Conv2D/Dense (the quantize_graph_pass.cc rewrite), then
+    swap Dense/Conv2D children for int8 versions (in place), calibrating
+    activation ranges if data given."""
     from ..gluon import nn
     exclude = set(exclude_layers or [])
+    if fold_bn:
+        fold_batch_norm(net)
     thresholds = {}
     if calib_data is not None:
         thresholds = calibrate_net(net, calib_data, calib_mode, num_batches)
@@ -353,3 +360,295 @@ class _BlockAdapter:
 
     def __repr__(self):
         return f"Int8({type(self._impl).__name__})"
+
+
+# ---------------------------------------------------------------------------
+# quantized op family (≙ src/operator/quantization/quantized_*.cc): each op
+# consumes (int8 data, min, max) and produces (int8, min, max), so chains of
+# quantized ops stay on the integer path between layers — the reference's
+# int8 graph. Ranges are python floats (calibration-time constants baked
+# into the XLA program, like the reference's calibrated graph).
+# ---------------------------------------------------------------------------
+
+def _amax_of(mn, mx):
+    return max(abs(mn), abs(mx), 1e-12)
+
+
+def quantized_act(qdata, min_range, max_range, act_type="relu"):
+    """≙ quantized_activation.cc — relu directly on int8 codes (symmetric
+    scale fixes code 0 at real 0, so clip-at-zero is exact)."""
+    if act_type != "relu":
+        raise MXNetError("quantized activation supports relu only "
+                         "(reference quantized_activation.cc is relu-only)")
+
+    def f(q):
+        import jax.numpy as jnp
+        return jnp.maximum(q, 0).astype(jnp.int8)
+    return invoke(f, (_as_nd(qdata),), name="quantized_act"), \
+        0.0, _amax_of(min_range, max_range)
+
+
+def quantized_pooling(qdata, min_range, max_range, pool_type="max",
+                      kernel=(2, 2), stride=None, pad=(0, 0),
+                      layout="NCHW"):
+    """≙ quantized_pooling.cc — max pool stays pure int8; avg pool
+    accumulates in int32 and rounds back (range unchanged)."""
+    from ..ops import nn as _nn
+    stride = stride or kernel
+
+    def f(q):
+        import jax.numpy as jnp
+        if pool_type == "max":
+            # reduce_window wants matching init dtype; widen + narrow back
+            return _nn.pooling(q.astype(jnp.int32), kernel,
+                               pool_type="max", stride=stride,
+                               padding=pad, layout=layout).astype(jnp.int8)
+        acc = _nn.pooling(q.astype(jnp.float32), kernel, pool_type="avg",
+                          stride=stride, padding=pad, layout=layout,
+                          count_include_pad=True)
+        return jnp.clip(jnp.round(acc), -127, 127).astype(jnp.int8)
+    return invoke(f, (_as_nd(qdata),), name="quantized_pooling"), \
+        min_range, max_range
+
+
+def quantized_flatten(qdata, min_range, max_range):
+    """≙ quantized_flatten.cc."""
+    q = _as_nd(qdata)
+    return q.reshape((q.shape[0], -1)), min_range, max_range
+
+
+def quantized_concat(inputs, ranges, axis=1):
+    """≙ quantized_concat.cc: rescale every input onto the widest range,
+    then concat in int8. inputs: list of int8 NDArrays; ranges: list of
+    (min, max)."""
+    amaxes = [_amax_of(mn, mx) for mn, mx in ranges]
+    out_amax = max(amaxes)
+    factors = [a / out_amax for a in amaxes]
+
+    def f(*qs):
+        import jax.numpy as jnp
+        parts = [jnp.clip(jnp.round(q.astype(jnp.float32) * fac),
+                          -127, 127).astype(jnp.int8)
+                 for q, fac in zip(qs, factors)]
+        return jnp.concatenate(parts, axis=axis)
+    out = invoke(f, tuple(_as_nd(q) for q in inputs),
+                 name="quantized_concat")
+    return out, -out_amax, out_amax
+
+
+def quantized_elemwise_add(qa, range_a, qb, range_b):
+    """≙ quantized_elemwise_add.cc: align scales, add in int32,
+    requantize to the sum's range."""
+    amax_a = _amax_of(*range_a)
+    amax_b = _amax_of(*range_b)
+    out_amax = amax_a + amax_b        # exact bound of the sum
+    sa = amax_a / 127.0
+    sb = amax_b / 127.0
+    so = out_amax / 127.0
+
+    def f(a, b):
+        import jax.numpy as jnp
+        real = a.astype(jnp.float32) * sa + b.astype(jnp.float32) * sb
+        return jnp.clip(jnp.round(real / so), -127, 127).astype(jnp.int8)
+    out = invoke(f, (_as_nd(qa), _as_nd(qb)), name="quantized_elemwise_add")
+    return out, -out_amax, out_amax
+
+
+def quantized_elemwise_mul(qa, range_a, qb, range_b):
+    """≙ quantized_elemwise_mul.cc: int32 product, range = product of
+    ranges."""
+    amax_a = _amax_of(*range_a)
+    amax_b = _amax_of(*range_b)
+    out_amax = amax_a * amax_b
+
+    def f(a, b):
+        import jax.numpy as jnp
+        prod = a.astype(jnp.int32) * b.astype(jnp.int32)   # |p| <= 127^2
+        return jnp.clip(jnp.round(prod.astype(jnp.float32) / 127.0),
+                        -127, 127).astype(jnp.int8)
+    out = invoke(f, (_as_nd(qa), _as_nd(qb)), name="quantized_elemwise_mul")
+    return out, -out_amax, out_amax
+
+
+def quantized_batch_norm(qdata, min_range, max_range, gamma, beta,
+                         running_mean, running_var, eps=1e-5,
+                         min_calib=None, max_calib=None):
+    """≙ quantized_batch_norm.cc: inference BN over int8 input, int8
+    output on the calibrated range. The affine transform runs fused in
+    f32 inside the program (XLA keeps it on-chip); output requantizes to
+    [min_calib, max_calib] (defaults: input range)."""
+    in_amax = _amax_of(min_range, max_range)
+    out_amax = _amax_of(min_calib, max_calib) \
+        if (min_calib is not None and max_calib is not None) else in_amax
+    s_in = in_amax / 127.0
+    s_out = out_amax / 127.0
+    args = tuple(_as_nd(a) for a in
+                 (qdata, gamma, beta, running_mean, running_var))
+
+    def f(q, g, b, mu, var):
+        import jax.numpy as jnp
+        shape = (1, -1) + (1,) * (q.ndim - 2)      # NCHW channel axis
+        real = q.astype(jnp.float32) * s_in
+        y = ((real - mu.reshape(shape))
+             / jnp.sqrt(var.reshape(shape) + eps)) * g.reshape(shape) \
+            + b.reshape(shape)
+        return jnp.clip(jnp.round(y / s_out), -127, 127).astype(jnp.int8)
+    out = invoke(f, args, name="quantized_batch_norm")
+    return out, -out_amax, out_amax
+
+
+def quantized_embedding(indices, weight_q, w_min, w_max):
+    """≙ quantized_indexing_op.cc (EmbeddingLookup over an int8 table):
+    gather in int8, dequantize the gathered rows only."""
+    scale = _amax_of(w_min, w_max) / 127.0
+
+    def f(idx, wq):
+        import jax.numpy as jnp
+        rows = jnp.take(wq, idx.astype(jnp.int32), axis=0)
+        return rows.astype(jnp.float32) * scale
+    return invoke(f, (_as_nd(indices), _as_nd(weight_q)),
+                  name="quantized_embedding")
+
+
+def quantized_fully_connected(qx, range_x, qw, range_w, bias=None,
+                              min_calib=None, max_calib=None):
+    """≙ quantized_fully_connected.cc: int8 x int8 -> int32 on the MXU
+    integer path; int8 out on the calibrated range (f32 out when no
+    calib range is given)."""
+    ax = _amax_of(*range_x)
+    aw = _amax_of(*range_w)
+    sx, sw = ax / 127.0, aw / 127.0
+    out_amax = (_amax_of(min_calib, max_calib)
+                if (min_calib is not None and max_calib is not None)
+                else None)
+    args = (_as_nd(qx), _as_nd(qw)) + \
+        (() if bias is None else (_as_nd(bias),))
+
+    def f(x, w, *maybe_bias):
+        import jax
+        import jax.numpy as jnp
+        acc = jax.lax.dot_general(
+            x, w, (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (sx * sw)
+        if maybe_bias:
+            y = y + maybe_bias[0]
+        if out_amax is None:
+            return y
+        return jnp.clip(jnp.round(y * (127.0 / out_amax)),
+                        -127, 127).astype(jnp.int8)
+    out = invoke(f, args, name="quantized_fully_connected")
+    if out_amax is None:
+        return out
+    return out, -out_amax, out_amax
+
+
+# ---------------------------------------------------------------------------
+# graph passes (≙ quantize_graph_pass.cc)
+# ---------------------------------------------------------------------------
+
+def fold_batch_norm(net):
+    """Fold inference-mode BatchNorm into the preceding Conv2D/Dense
+    (≙ the BN-fold rewrite in quantize_graph_pass.cc / oneDNN's
+    conv+bn fusion): w' = w * g/sqrt(var+eps), b' = (b-mu)*g/sqrt(var+eps)
+    + beta. Works on container blocks whose children run sequentially
+    (HybridSequential); returns the count of folded BNs."""
+    from ..gluon import nn
+    folded = 0
+
+    def fold_pair(prev, bn):
+        g = bn.gamma.data().asnumpy() if bn.gamma is not None else 1.0
+        b = bn.beta.data().asnumpy() if bn.beta is not None else 0.0
+        mu = bn.running_mean.data().asnumpy()
+        var = bn.running_var.data().asnumpy()
+        f = g / _np.sqrt(var + bn._eps)
+        w = prev.weight.data().asnumpy()
+        if isinstance(prev, nn.Dense):
+            out_axis = 0                       # Dense weight (O, I)
+        elif prev._layout.startswith("NC"):
+            out_axis = 0                       # OIHW
+        else:
+            out_axis = w.ndim - 1              # HWIO (channels-last conv)
+        bshape = [1] * w.ndim
+        bshape[out_axis] = -1
+        w2 = w * f.reshape(bshape)
+        from .. import np as mxnp
+        prev.weight.set_data(mxnp.array(w2))
+        old_b = (prev.bias.data().asnumpy() if prev.bias is not None
+                 else _np.zeros(w.shape[out_axis], w.dtype))
+        new_b = (old_b - mu) * f + b
+        if prev.bias is not None:
+            prev.bias.set_data(mxnp.array(new_b.astype(w.dtype)))
+        else:
+            # conv created with use_bias=False: materialize the folded bias
+            # (attribute assignment auto-registers the Parameter)
+            from ..gluon.parameter import Parameter
+            prev.bias = Parameter(shape=(w.shape[out_axis],), name="bias")
+            prev.bias.set_data(mxnp.array(new_b.astype(w.dtype)))
+
+    def replace_everywhere(block, name, old, ident):
+        """Swap the folded BN out of BOTH registries: _children (container
+        dispatch) and any instance attribute holding it (custom forward()
+        that calls self.bn directly)."""
+        block._children[name] = ident
+        for attr, val in list(vars(block).items()):
+            if val is old:
+                object.__setattr__(block, attr, ident)
+
+    def walk(block):
+        nonlocal folded
+        names = list(block._children.keys())
+        for i, name in enumerate(names):
+            child = block._children[name]
+            if isinstance(child, nn.BatchNorm) and i > 0:
+                prev = block._children[names[i - 1]]
+                # fold only when `prev` feeds the BN unmodified: a baked
+                # activation (conv(act=...)) would make the fold invalid
+                if isinstance(prev, (nn.Dense, nn.Conv2D)) \
+                        and getattr(prev, "_act_type", None) is None \
+                        and prev.weight._data is not None \
+                        and child.running_mean._data is not None:
+                    fold_pair(prev, child)
+                    replace_everywhere(block, name, child, _Identity())
+                    folded += 1
+                    continue
+            walk(child)
+
+    walk(net)
+    if hasattr(net, "reset_cache"):
+        net.reset_cache()
+    return folded
+
+
+class _Identity:
+    """Stand-in for a folded-away block."""
+
+    _children: dict = {}
+
+    def __init__(self):
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = {}
+        self._forward_pre_hooks = {}
+
+    def __call__(self, x, *a):
+        return x
+
+    def hybridize(self, *a, **kw):
+        pass
+
+    def _iter_params(self, prefix):
+        return iter(())
+
+    def apply(self, fn):
+        fn(self)
+
+    def __repr__(self):
+        return "Identity(folded BatchNorm)"
+
+
+__all__ += ["quantized_act", "quantized_pooling", "quantized_flatten",
+            "quantized_concat", "quantized_elemwise_add",
+            "quantized_elemwise_mul", "quantized_batch_norm",
+            "quantized_embedding", "quantized_fully_connected",
+            "fold_batch_norm"]

@@ -378,8 +378,6 @@ def _multibox_detection_body(jnp, jax, cls_prob, loc_pred, anchor, clip,
     ax = (anc[:, 0] + anc[:, 2]) * 0.5
     ay = (anc[:, 1] + anc[:, 3]) * 0.5
 
-    num_cls = cls_prob.shape[1]
-
     def one(cprob, lpred):
         lp = lpred.reshape(A, 4)
         # mask the background row, take the best remaining class (the

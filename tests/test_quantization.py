@@ -211,3 +211,223 @@ def test_requantize_uses_calibrated_range():
     q8, mn, mxr = q.requantize(q32, -4.0, 4.0)
     # 2^30 = half of int32 range → half of the calibrated range → ~64
     np.testing.assert_allclose(q8.asnumpy(), [64, -64], atol=1)
+
+
+# ---------------------------------------------------------------------------
+# quantized op family (≙ src/operator/quantization/quantized_*.cc)
+# ---------------------------------------------------------------------------
+
+def _quant(xn):
+    from incubator_mxnet_tpu.contrib import quantization as q
+    qx, mn, mx_ = q.quantize_v2(mx.np.array(xn))
+    return q, qx, mn, mx_
+
+
+def test_quantized_act_relu():
+    xn = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    q, qx, mn, mx_ = _quant(xn)
+    qy, omn, omx = q.quantized_act(qx, mn, mx_)
+    y = q.dequantize(qy, omn, omx).asnumpy()
+    np.testing.assert_allclose(y, np.maximum(xn, 0), atol=2 * mx_ / 127)
+    assert omn == 0.0
+
+
+def test_quantized_pooling_max_and_avg():
+    xn = np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32)
+    q, qx, mn, mx_ = _quant(xn)
+    for ptype, ref in (("max", None), ("avg", None)):
+        qy, omn, omx = q.quantized_pooling(qx, mn, mx_, pool_type=ptype,
+                                           kernel=(2, 2))
+        y = q.dequantize(qy, omn, omx).asnumpy()
+        import jax.numpy as jnp
+        from incubator_mxnet_tpu.ops import nn as _nn
+        want = np.asarray(_nn.pooling(jnp.asarray(xn), (2, 2),
+                                      pool_type=ptype))
+        np.testing.assert_allclose(y, want, atol=3 * mx_ / 127)
+
+
+def test_quantized_concat_rescales_to_widest():
+    a = np.random.RandomState(2).randn(2, 3).astype(np.float32)
+    b = 4.0 * np.random.RandomState(3).randn(2, 5).astype(np.float32)
+    q, qa, amn, amx = _quant(a)
+    _, qb, bmn, bmx = _quant(b)
+    qy, omn, omx = q.quantized_concat([qa, qb], [(amn, amx), (bmn, bmx)],
+                                      axis=1)
+    y = q.dequantize(qy, omn, omx).asnumpy()
+    want = np.concatenate([a, b], axis=1)
+    np.testing.assert_allclose(y, want, atol=3 * omx / 127)
+
+
+def test_quantized_elemwise_add_mul():
+    rng = np.random.RandomState(4)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(3, 4).astype(np.float32)
+    q, qa, amn, amx = _quant(a)
+    _, qb, bmn, bmx = _quant(b)
+    qs, smn, smx = q.quantized_elemwise_add(qa, (amn, amx), qb, (bmn, bmx))
+    np.testing.assert_allclose(q.dequantize(qs, smn, smx).asnumpy(), a + b,
+                               atol=4 * smx / 127)
+    qm, mmn, mmx = q.quantized_elemwise_mul(qa, (amn, amx), qb, (bmn, bmx))
+    np.testing.assert_allclose(q.dequantize(qm, mmn, mmx).asnumpy(), a * b,
+                               atol=4 * mmx / 127)
+
+
+def test_quantized_batch_norm():
+    rng = np.random.RandomState(5)
+    xn = rng.randn(2, 4, 5, 5).astype(np.float32)
+    gamma = rng.uniform(0.5, 1.5, 4).astype(np.float32)
+    beta = rng.randn(4).astype(np.float32)
+    mu = rng.randn(4).astype(np.float32) * 0.1
+    var = rng.uniform(0.5, 2.0, 4).astype(np.float32)
+    q, qx, mn, mx_ = _quant(xn)
+    want = ((xn - mu[None, :, None, None])
+            / np.sqrt(var[None, :, None, None] + 1e-5)
+            * gamma[None, :, None, None] + beta[None, :, None, None])
+    amax = float(np.abs(want).max())
+    qy, omn, omx = q.quantized_batch_norm(
+        qx, mn, mx_, mx.np.array(gamma), mx.np.array(beta),
+        mx.np.array(mu), mx.np.array(var), min_calib=-amax, max_calib=amax)
+    y = q.dequantize(qy, omn, omx).asnumpy()
+    np.testing.assert_allclose(y, want, atol=4 * amax / 127)
+
+
+def test_quantized_embedding():
+    rng = np.random.RandomState(6)
+    w = rng.randn(10, 6).astype(np.float32)
+    q, qw, wmn, wmx = _quant(w)
+    idx = mx.np.array(np.array([1, 3, 9], np.int32))
+    y = q.quantized_embedding(idx, qw, wmn, wmx).asnumpy()
+    np.testing.assert_allclose(y, w[[1, 3, 9]], atol=2 * wmx / 127)
+
+
+def test_quantized_fully_connected_chain():
+    """int8-in/int8-out chaining: fc -> relu -> fc stays on int codes."""
+    rng = np.random.RandomState(7)
+    xn = rng.randn(4, 8).astype(np.float32)
+    w1 = rng.randn(16, 8).astype(np.float32) * 0.3
+    w2 = rng.randn(5, 16).astype(np.float32) * 0.3
+    ref = np.maximum(xn @ w1.T, 0) @ w2.T
+
+    q, qx, xmn, xmx = _quant(xn)
+    _, qw1, w1mn, w1mx = _quant(w1)
+    _, qw2, w2mn, w2mx = _quant(w2)
+    h_real = xn @ w1.T
+    h_amax = float(np.abs(h_real).max())
+    qh, hmn, hmx = q.quantized_fully_connected(
+        qx, (xmn, xmx), qw1, (w1mn, w1mx),
+        min_calib=-h_amax, max_calib=h_amax)
+    qh, hmn, hmx = q.quantized_act(qh, hmn, hmx)
+    out = q.quantized_fully_connected(qh, (hmn, hmx), qw2, (w2mn, w2mx))
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=0.15,
+                               atol=0.15 * np.abs(ref).max())
+
+
+def test_fold_batch_norm_pass():
+    """conv+bn fold must preserve the inference function exactly."""
+    from incubator_mxnet_tpu.contrib.quantization import fold_batch_norm
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, use_bias=False),
+            nn.BatchNorm(),
+            nn.Activation("relu"),
+            nn.Dense(4))
+    net.initialize()
+    x = mx.np.array(np.random.RandomState(8).randn(2, 3, 6, 6)
+                    .astype(np.float32))
+    net(x)  # shape inference
+    # give BN non-trivial running stats
+    bn = net._children["1"]
+    bn.running_mean.set_data(mx.np.array(
+        np.random.RandomState(9).randn(8).astype(np.float32) * 0.2))
+    bn.running_var.set_data(mx.np.array(
+        np.random.RandomState(10).uniform(0.5, 2.0, 8).astype(np.float32)))
+    bn.gamma.set_data(mx.np.array(
+        np.random.RandomState(11).uniform(0.5, 1.5, 8).astype(np.float32)))
+    bn.beta.set_data(mx.np.array(
+        np.random.RandomState(12).randn(8).astype(np.float32)))
+    with mx.autograd.predict_mode():
+        before = net(x).asnumpy()
+    n = fold_batch_norm(net)
+    assert n == 1
+    with mx.autograd.predict_mode():
+        after = net(x).asnumpy()
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-4)
+
+
+def test_quantize_net_folds_bn_by_default():
+    from incubator_mxnet_tpu.contrib.quantization import quantize_net
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1, use_bias=False), nn.BatchNorm(),
+            nn.Activation("relu"), nn.Dense(3))
+    net.initialize()
+    x = mx.np.array(np.random.RandomState(13).randn(2, 3, 6, 6)
+                    .astype(np.float32))
+    net(x)
+    with mx.autograd.predict_mode():
+        ref = net(x).asnumpy()
+    quantize_net(net, calib_data=[(x,)], num_batches=1)
+    with mx.autograd.predict_mode():
+        out = net(x).asnumpy()
+    # int8 end-to-end stays close to fp32
+    assert np.abs(out - ref).max() < 0.25 * max(np.abs(ref).max(), 1.0)
+    assert "Identity" in repr(net._children["1"])
+
+
+def test_fold_bn_attribute_registered_and_act_guard():
+    """Fold must also clear attribute references (custom forward calling
+    self.bn) and must NOT fold across a conv's baked activation."""
+    from incubator_mxnet_tpu.contrib.quantization import fold_batch_norm
+
+    class Custom(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(4, 3, padding=1, use_bias=False)
+            self.bn = nn.BatchNorm()
+
+        def forward(self, x):
+            return self.bn(self.conv(x))
+
+    net = Custom()
+    net.initialize()
+    x = mx.np.array(np.random.RandomState(20).randn(2, 3, 6, 6)
+                    .astype(np.float32))
+    net(x)
+    net.bn.running_mean.set_data(mx.np.array(
+        np.random.RandomState(21).randn(4).astype(np.float32) * 0.3))
+    net.bn.running_var.set_data(mx.np.array(
+        np.random.RandomState(22).uniform(0.5, 2.0, 4).astype(np.float32)))
+    with mx.autograd.predict_mode():
+        before = net(x).asnumpy()
+    assert fold_batch_norm(net) == 1
+    with mx.autograd.predict_mode():
+        after = net(x).asnumpy()
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-4)
+
+    # baked activation between conv and BN -> must refuse to fold
+    act_net = nn.HybridSequential()
+    act_net.add(nn.Conv2D(4, 3, padding=1, activation="relu"),
+                nn.BatchNorm())
+    act_net.initialize()
+    act_net(x)
+    assert fold_batch_norm(act_net) == 0
+
+
+def test_fold_bn_nhwc_layout():
+    from incubator_mxnet_tpu.contrib.quantization import fold_batch_norm
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(5, 3, padding=1, layout="NHWC", use_bias=False),
+            nn.BatchNorm(axis=3))
+    net.initialize()
+    x = mx.np.array(np.random.RandomState(23).randn(2, 6, 6, 3)
+                    .astype(np.float32))
+    net(x)
+    bn = net._children["1"]
+    bn.running_mean.set_data(mx.np.array(
+        np.random.RandomState(24).randn(5).astype(np.float32) * 0.2))
+    bn.running_var.set_data(mx.np.array(
+        np.random.RandomState(25).uniform(0.5, 2.0, 5).astype(np.float32)))
+    with mx.autograd.predict_mode():
+        before = net(x).asnumpy()
+    assert fold_batch_norm(net) == 1
+    with mx.autograd.predict_mode():
+        after = net(x).asnumpy()
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-4)
