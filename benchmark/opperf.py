@@ -1,0 +1,313 @@
+"""Per-operator benchmark harness (≙ /root/reference/benchmark/opperf/:
+category-organized fwd/bwd latency tables for the operator surface).
+
+TPU-native design: each op times three ways —
+  * eager      — the imperative dispatch path users hit in a loop
+  * jit        — the op compiled alone (XLA kernel latency; what a fused
+                 graph pays, minus fusion wins)
+  * bwd (jit)  — value_and_grad of the op compiled alone
+
+Measurements synchronize with block_until_ready and report median-of-N.
+Categories mirror the reference's nd_operations modules: unary, binary
+(broadcast + elementwise), gemm, reduction, sorting/searching, random,
+activation, conv/pool, norm, optimizer-update.
+
+Usage:
+  python benchmark/opperf.py                       # all categories, table
+  python benchmark/opperf.py --categories unary gemm --json out.json
+  python benchmark/opperf.py --platform cpu        # force host platform
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _time_fn(fn, args, warmup=3, iters=10):
+    import jax
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append((time.perf_counter() - t0) * 1e6)
+    return statistics.median(ts)
+
+
+def _bench_one(name, fn, arg_arrays, grad_idx=0):
+    """Returns dict with eager/jit/bwd median microseconds."""
+    import jax
+    import jax.numpy as jnp
+
+    dev_args = [jax.device_put(a) for a in arg_arrays]
+    row = {"op": name}
+    row["eager_us"] = round(_time_fn(fn, dev_args), 1)
+    jfn = jax.jit(fn)
+    row["jit_us"] = round(_time_fn(jfn, dev_args), 1)
+    try:
+        def loss(*xs):
+            return jnp.sum(jnp.abs(fn(*xs)))
+        gfn = jax.jit(jax.grad(loss, argnums=grad_idx))
+        row["bwd_us"] = round(_time_fn(gfn, dev_args), 1)
+    except Exception:
+        row["bwd_us"] = None  # non-differentiable op
+    return row
+
+
+def _rand(shape, dtype=np.float32, positive=False):
+    rng = np.random.RandomState(hash(shape) % (2 ** 31))
+    a = rng.uniform(0.5 if positive else -1.0, 1.0, shape)
+    return a.astype(dtype)
+
+
+# --------------------------------------------------------------------------
+# category tables. Default shapes follow the reference's opperf defaults
+# (1024x1024 tensors, 32x3x256x256 conv inputs scaled down to stay quick).
+# --------------------------------------------------------------------------
+
+def cat_unary(jnp, npx):
+    big = (_rand((1024, 1024)),)
+    pos = (_rand((1024, 1024), positive=True),)
+    return [
+        ("exp", lambda x: jnp.exp(x), big),
+        ("log", lambda x: jnp.log(x), pos),
+        ("sqrt", lambda x: jnp.sqrt(x), pos),
+        ("rsqrt", lambda x: 1.0 / jnp.sqrt(x), pos),
+        ("sigmoid", lambda x: 1 / (1 + jnp.exp(-x)), big),
+        ("tanh", lambda x: jnp.tanh(x), big),
+        ("erf", lambda x: __import__("jax").scipy.special.erf(x), big),
+        ("abs", lambda x: jnp.abs(x), big),
+        ("sign", lambda x: jnp.sign(x), big),
+        ("round", lambda x: jnp.round(x), big),
+        ("square", lambda x: x * x, big),
+        ("reciprocal", lambda x: 1.0 / x, pos),
+    ]
+
+
+def cat_binary(jnp, npx):
+    a = _rand((1024, 1024))
+    b = _rand((1024, 1024))
+    col = _rand((1024, 1))
+    return [
+        ("add", lambda x, y: x + y, (a, b)),
+        ("sub", lambda x, y: x - y, (a, b)),
+        ("mul", lambda x, y: x * y, (a, b)),
+        ("div", lambda x, y: x / (y + 2.0), (a, b)),
+        ("pow", lambda x, y: jnp.power(jnp.abs(x) + 0.5, y), (a, b)),
+        ("maximum", lambda x, y: jnp.maximum(x, y), (a, b)),
+        ("broadcast_add", lambda x, y: x + y, (a, col)),
+        ("broadcast_mul", lambda x, y: x * y, (a, col)),
+        ("equal", lambda x, y: (x == y).astype(jnp.float32), (a, b)),
+        ("where", lambda x, y: jnp.where(x > 0, x, y), (a, b)),
+    ]
+
+
+def cat_gemm(jnp, npx):
+    a = _rand((1024, 1024))
+    b = _rand((1024, 1024))
+    bt = _rand((32, 256, 256))
+    return [
+        ("dot_1024", lambda x, y: x @ y, (a, b)),
+        ("dot_bf16_1024",
+         lambda x, y: (x.astype(jnp.bfloat16) @ y.astype(jnp.bfloat16))
+         .astype(jnp.float32), (a, b)),
+        ("batch_dot_32x256", lambda x, y: jnp.einsum("bij,bjk->bik", x, y),
+         (bt, bt)),
+        ("transpose_dot", lambda x, y: x.T @ y, (a, b)),
+    ]
+
+
+def cat_reduction(jnp, npx):
+    a = _rand((1024, 1024))
+    return [
+        ("sum", lambda x: jnp.sum(x), (a,)),
+        ("sum_axis0", lambda x: jnp.sum(x, axis=0), (a,)),
+        ("mean", lambda x: jnp.mean(x), (a,)),
+        ("max", lambda x: jnp.max(x), (a,)),
+        ("argmax_axis1", lambda x: jnp.argmax(x, axis=1), (a,)),
+        ("norm", lambda x: jnp.sqrt(jnp.sum(x * x)), (a,)),
+        ("softmax_axis1",
+         lambda x: __import__("jax").nn.softmax(x, axis=1), (a,)),
+        ("logsumexp",
+         lambda x: __import__("jax").scipy.special.logsumexp(x, axis=1),
+         (a,)),
+    ]
+
+
+def cat_sorting(jnp, npx):
+    a = _rand((1024, 1024))
+    return [
+        ("sort_axis1", lambda x: jnp.sort(x, axis=1), (a,)),
+        ("argsort_axis1", lambda x: jnp.argsort(x, axis=1), (a,)),
+        ("topk_10", lambda x: __import__("jax").lax.top_k(x, 10)[0], (a,)),
+    ]
+
+
+def cat_random(jnp, npx):
+    import jax
+    key = np.zeros(2, np.uint32)
+    return [
+        ("uniform_1M",
+         lambda k: jax.random.uniform(jax.random.wrap_key_data(
+             k.astype(np.uint32)), (1024, 1024)), (key,)),
+        ("normal_1M",
+         lambda k: jax.random.normal(jax.random.wrap_key_data(
+             k.astype(np.uint32)), (1024, 1024)), (key,)),
+        ("bernoulli_1M",
+         lambda k: jax.random.bernoulli(jax.random.wrap_key_data(
+             k.astype(np.uint32)), 0.5, (1024, 1024)), (key,)),
+    ]
+
+
+def cat_activation(jnp, npx):
+    import jax
+    a = _rand((32, 1024))
+    return [
+        ("relu", lambda x: jax.nn.relu(x), (a,)),
+        ("leaky_relu", lambda x: jax.nn.leaky_relu(x), (a,)),
+        ("gelu", lambda x: jax.nn.gelu(x), (a,)),
+        ("softrelu", lambda x: jax.nn.softplus(x), (a,)),
+        ("hard_sigmoid", lambda x: jax.nn.hard_sigmoid(x), (a,)),
+    ]
+
+
+def cat_conv(jnp, npx):
+    import jax
+    x_nhwc = _rand((16, 64, 64, 32))
+    w_hwio = _rand((3, 3, 32, 64)) * 0.1
+
+    def conv(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def conv_bf16(x, w):
+        return jax.lax.conv_general_dilated(
+            x.astype(jnp.bfloat16), w.astype(jnp.bfloat16), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")).astype(jnp.float32)
+
+    def maxpool(x):
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+
+    return [
+        ("conv3x3_nhwc_16x64x64x32", conv, (x_nhwc, w_hwio)),
+        ("conv3x3_bf16", conv_bf16, (x_nhwc, w_hwio)),
+        ("maxpool2x2", maxpool, (x_nhwc,)),
+    ]
+
+
+def cat_norm(jnp, npx):
+    a = _rand((32, 128, 768))
+    g = _rand((768,), positive=True)
+    b = _rand((768,))
+
+    def layernorm(x, gamma, beta):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + 1e-5) * gamma + beta
+
+    def batchnorm_infer(x, gamma, beta):
+        return x * gamma + beta
+
+    return [
+        ("layernorm_32x128x768", layernorm, (a, g, b)),
+        ("batchnorm_infer", batchnorm_infer, (a, g, b)),
+    ]
+
+
+def cat_optimizer(jnp, npx):
+    w = _rand((1024, 1024))
+    gr = _rand((1024, 1024))
+    m = _rand((1024, 1024))
+    v = np.abs(_rand((1024, 1024)))
+
+    def sgd_mom(wt, g, mom):
+        mom2 = 0.9 * mom - 0.01 * g
+        return wt + mom2
+
+    def adam(wt, g, mt, vt):
+        m2 = 0.9 * mt + 0.1 * g
+        v2 = 0.999 * vt + 0.001 * g * g
+        return wt - 0.001 * m2 / (jnp.sqrt(v2) + 1e-8)
+
+    return [
+        ("sgd_momentum_update_1M", sgd_mom, (w, gr, m)),
+        ("adam_update_1M", adam, (w, gr, m, v)),
+    ]
+
+
+CATEGORIES = {
+    "unary": cat_unary,
+    "binary": cat_binary,
+    "gemm": cat_gemm,
+    "reduction": cat_reduction,
+    "sorting": cat_sorting,
+    "random": cat_random,
+    "activation": cat_activation,
+    "conv": cat_conv,
+    "norm": cat_norm,
+    "optimizer": cat_optimizer,
+}
+
+
+def run(categories=None, as_json=None):
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import npx
+
+    platform = jax.devices()[0].platform
+    results = {}
+    for cat in (categories or CATEGORIES):
+        specs = CATEGORIES[cat](jnp, npx)
+        rows = []
+        for name, fn, args in specs:
+            try:
+                rows.append(_bench_one(name, fn, args))
+            except Exception as e:  # keep the table going
+                rows.append({"op": name, "error": str(e)[:120]})
+        results[cat] = rows
+
+    if as_json:
+        with open(as_json, "w") as f:
+            json.dump({"platform": platform, "results": results}, f,
+                      indent=1)
+    # render table
+    print(f"# opperf ({platform})")
+    print(f"{'op':32s} {'eager_us':>10s} {'jit_us':>10s} {'bwd_us':>10s}")
+    for cat, rows in results.items():
+        print(f"-- {cat} " + "-" * 58)
+        for r in rows:
+            if "error" in r:
+                print(f"{r['op']:32s} ERROR {r['error']}")
+                continue
+            bwd = f"{r['bwd_us']:10.1f}" if r["bwd_us"] is not None \
+                else "       n/a"
+            print(f"{r['op']:32s} {r['eager_us']:10.1f} "
+                  f"{r['jit_us']:10.1f} {bwd}")
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--categories", nargs="*", default=None,
+                    choices=list(CATEGORIES))
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--platform", default=None,
+                    help="force a platform (e.g. cpu)")
+    args = ap.parse_args()
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    run(args.categories, args.json)
+
+
+if __name__ == "__main__":
+    main()

@@ -547,12 +547,16 @@ def quantized_fully_connected(qx, range_x, qw, range_w, bias=None,
 # graph passes (≙ quantize_graph_pass.cc)
 # ---------------------------------------------------------------------------
 
-def fold_batch_norm(net):
+def fold_batch_norm(net, aggressive=False):
     """Fold inference-mode BatchNorm into the preceding Conv2D/Dense
     (≙ the BN-fold rewrite in quantize_graph_pass.cc / oneDNN's
     conv+bn fusion): w' = w * g/sqrt(var+eps), b' = (b-mu)*g/sqrt(var+eps)
-    + beta. Works on container blocks whose children run sequentially
-    (HybridSequential); returns the count of folded BNs."""
+    + beta. By default folds only inside (Hybrid)Sequential containers,
+    where child order IS the dataflow; `aggressive=True` extends the
+    adjacency heuristic to custom blocks (caller asserts their forward()
+    consumes the conv output only through the BN). BatchNormReLU folds to
+    a ReLU stand-in; other BatchNorm subclasses are left alone. Returns
+    the count of folded BNs."""
     from ..gluon import nn
     folded = 0
 
@@ -595,23 +599,41 @@ def fold_batch_norm(net):
             if val is old:
                 object.__setattr__(block, attr, ident)
 
+    def can_fold(prev, child):
+        # exact BatchNorm / BatchNormReLU only (other subclasses may carry
+        # extra behavior); `prev` must feed the BN unmodified (no baked
+        # activation) and the BN axis must be prev's channel axis
+        if type(child) not in (nn.BatchNorm, nn.BatchNormReLU):
+            return False
+        if not isinstance(prev, (nn.Dense, nn.Conv2D)):
+            return False
+        if getattr(prev, "_act_type", None) is not None:
+            return False
+        if prev.weight._data is None or child.running_mean._data is None:
+            return False
+        prev_axis = (1 if isinstance(prev, nn.Dense)
+                     else prev._channel_axis())
+        return child._axis == prev_axis
+
     def walk(block):
         nonlocal folded
+        # adjacency in _children == dataflow only for sequential
+        # containers; elsewhere a custom forward() may reuse the pre-BN
+        # value, so fold only inside HybridSequential unless aggressive
+        here_ok = aggressive or isinstance(
+            block, (nn.HybridSequential, nn.Sequential))
         names = list(block._children.keys())
         for i, name in enumerate(names):
             child = block._children[name]
-            if isinstance(child, nn.BatchNorm) and i > 0:
+            if here_ok and i > 0 \
+                    and can_fold(block._children[names[i - 1]], child):
                 prev = block._children[names[i - 1]]
-                # fold only when `prev` feeds the BN unmodified: a baked
-                # activation (conv(act=...)) would make the fold invalid
-                if isinstance(prev, (nn.Dense, nn.Conv2D)) \
-                        and getattr(prev, "_act_type", None) is None \
-                        and prev.weight._data is not None \
-                        and child.running_mean._data is not None:
-                    fold_pair(prev, child)
-                    replace_everywhere(block, name, child, _Identity())
-                    folded += 1
-                    continue
+                is_bn_relu = type(child) is nn.BatchNormReLU
+                fold_pair(prev, child)
+                stand_in = _ReLU() if is_bn_relu else _Identity()
+                replace_everywhere(block, name, child, stand_in)
+                folded += 1
+                continue
             walk(child)
 
     walk(net)
@@ -620,31 +642,28 @@ def fold_batch_norm(net):
     return folded
 
 
-class _Identity:
+class _Identity(_BlockAdapter):
     """Stand-in for a folded-away block."""
 
-    _children: dict = {}
-
     def __init__(self):
-        self._children = {}
-        self._reg_params = {}
-        self._forward_hooks = {}
-        self._forward_pre_hooks = {}
-
-    def __call__(self, x, *a):
-        return x
-
-    def hybridize(self, *a, **kw):
-        pass
-
-    def _iter_params(self, prefix):
-        return iter(())
-
-    def apply(self, fn):
-        fn(self)
+        super().__init__(lambda x: x)
 
     def __repr__(self):
         return "Identity(folded BatchNorm)"
+
+
+class _ReLU(_BlockAdapter):
+    """Stand-in for a folded-away BatchNormReLU (affine part folded into
+    the conv; the activation half survives here)."""
+
+    def __init__(self):
+        def relu(x):
+            from .. import numpy_extension as npx
+            return npx.relu(x)
+        super().__init__(relu)
+
+    def __repr__(self):
+        return "ReLU(folded BatchNormReLU)"
 
 
 __all__ += ["quantized_act", "quantized_pooling", "quantized_flatten",

@@ -277,3 +277,18 @@ def test_visualization(tmp_path):
     dot = visualization.plot_network(net, save_path=str(tmp_path / "g.dot"))
     assert "digraph" in dot and "Dense" in dot
     assert (tmp_path / "g.dot").exists()
+
+
+def test_opperf_harness_smoke():
+    """The per-op benchmark harness must run and produce rows (opperf
+    parity, /root/reference/benchmark/opperf)."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+    from benchmark import opperf
+    res = opperf.run(categories=["optimizer"])
+    rows = res["optimizer"]
+    assert len(rows) == 2
+    for r in rows:
+        assert "error" not in r, r
+        assert r["jit_us"] > 0

@@ -397,7 +397,10 @@ def test_fold_bn_attribute_registered_and_act_guard():
         np.random.RandomState(22).uniform(0.5, 2.0, 4).astype(np.float32)))
     with mx.autograd.predict_mode():
         before = net(x).asnumpy()
-    assert fold_batch_norm(net) == 1
+    # custom (non-sequential) blocks fold only when the caller asserts the
+    # dataflow with aggressive=True
+    assert fold_batch_norm(net) == 0
+    assert fold_batch_norm(net, aggressive=True) == 1
     with mx.autograd.predict_mode():
         after = net(x).asnumpy()
     np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-4)
@@ -431,3 +434,39 @@ def test_fold_bn_nhwc_layout():
     with mx.autograd.predict_mode():
         after = net(x).asnumpy()
     np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-4)
+
+
+def test_fold_bn_relu_keeps_activation():
+    from incubator_mxnet_tpu.contrib.quantization import fold_batch_norm
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1, use_bias=False), nn.BatchNormReLU())
+    net.initialize()
+    x = mx.np.array(np.random.RandomState(30).randn(2, 3, 6, 6)
+                    .astype(np.float32))
+    net(x)
+    bn = net._children["1"]
+    bn.running_mean.set_data(mx.np.array(
+        np.random.RandomState(31).randn(4).astype(np.float32) * 0.3))
+    bn.running_var.set_data(mx.np.array(
+        np.random.RandomState(32).uniform(0.5, 2.0, 4).astype(np.float32)))
+    with mx.autograd.predict_mode():
+        before = net(x).asnumpy()
+    assert (before >= 0).all()          # BatchNormReLU clamps
+    assert fold_batch_norm(net) == 1
+    with mx.autograd.predict_mode():
+        after = net(x).asnumpy()
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-4)
+    assert "ReLU" in repr(net._children["1"])
+
+
+def test_fold_bn_axis_mismatch_refused():
+    from incubator_mxnet_tpu.contrib.quantization import fold_batch_norm
+    net = nn.HybridSequential()
+    # NHWC conv (channel axis 3) + default BatchNorm(axis=1): must refuse
+    net.add(nn.Conv2D(6, 3, padding=1, layout="NHWC", use_bias=False),
+            nn.BatchNorm())
+    net.initialize()
+    x = mx.np.array(np.random.RandomState(33).randn(2, 6, 6, 3)
+                    .astype(np.float32))
+    net(x)
+    assert fold_batch_norm(net) == 0
