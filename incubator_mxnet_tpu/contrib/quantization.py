@@ -611,9 +611,11 @@ def fold_batch_norm(net, aggressive=False):
             return False
         if prev.weight._data is None or child.running_mean._data is None:
             return False
-        prev_axis = (1 if isinstance(prev, nn.Dense)
-                     else prev._channel_axis())
-        return child._axis == prev_axis
+        if isinstance(prev, nn.Dense):
+            prev_axis, nd = 1, 2
+        else:
+            prev_axis, nd = prev._channel_axis(), len(prev._layout)
+        return child._axis % nd == prev_axis
 
     def walk(block):
         nonlocal folded

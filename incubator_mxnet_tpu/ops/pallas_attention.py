@@ -82,6 +82,132 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale,
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
 
 
+def _kernel_with_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                     acc_ref, *, scale, causal, block_q, block_k, nk,
+                     causal_offset=0):
+    """Forward kernel that also emits the log-sum-exp per query row — the
+    residual the flash backward kernels consume."""
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+
+    _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, scale=scale,
+            causal=causal, block_q=block_q, block_k=block_k, nk=nk,
+            causal_offset=causal_offset)
+
+    ki = pl.program_id(2)
+
+    @pl.when(ki == nk - 1)
+    def _emit_lse():
+        lse = jnp.where(l_ref[:] > 0.0,
+                        m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-37)),
+                        _NEG_INF)
+        lse_ref[0] = lse.astype(lse_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, scale, causal, block_q, block_k, nk,
+                   causal_offset=0):
+    """dq = sum_k  ds @ k * scale,  ds = p * (dO v^T - delta),
+    p = exp(s - lse). Grid (bh, nq, nk), k innermost; dq accumulates in
+    VMEM scratch (standard flash attention backward, Dao et al. 2022)."""
+    import jax
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + causal_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])                       # (bq, bk)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1 + causal_offset)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k, nq, causal_offset=0):
+    """dv = sum_q p^T @ dO;  dk = sum_q ds^T @ q * scale.
+    Grid (bh, nk, nq), q innermost; dk/dv accumulate in VMEM scratch."""
+    import jax
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + causal_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])                       # (bq, bk)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, d)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bk, d)
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1 + causal_offset)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 def _blockwise(q, k, v, scale, causal, block_k=512):
     """Differentiable blockwise attention: lax.scan over k blocks with
     online-softmax merging. Same math as the Pallas kernel, O(T·block_k)
@@ -174,16 +300,152 @@ def _flash_forward_kernel(q, k, v, causal, scale, block_q, block_k,
     )(q, k, v)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
-                    block_k=512, interpret=False):
+def _flash_forward_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Forward returning (o, lse) — the training-path entry."""
+    import jax
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nq = tq // block_q
+    nk = tk // block_k
+    kernel = functools.partial(_kernel_with_lse, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, nk=nk,
+                               causal_offset=tk - tq)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _flash_backward(q, k, v, do, lse, delta, causal, scale, block_q,
+                    block_k, interpret):
+    """Pallas dq + dkv kernels (flash attention backward as two sweeps)."""
+    import jax
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nq = tq // block_q
+    nk = tk // block_k
+    off = tk - tq
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk,
+                          causal_offset=off),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq,
+                          causal_offset=off),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+def _auto_blocks(tq, tk, d, vmem_budget=8 * 1024 * 1024):
+    """Pick (block_q, block_k): the largest power-of-two tiles that DIVIDE
+    the sequence lengths (halving preserves divisibility, so the kernel —
+    not the dense fallback — runs for any even-pow2-factor length) and
+    whose working set — q/k/v/do tiles, the (bq, bk) score tile, and f32
+    accumulators — fits the VMEM budget. Bigger tiles amortize HBM
+    traffic; the cap keeps double-buffering viable."""
+    def fits(bq, bk):
+        tiles = (bq * d * 4 * 2          # q tile + do tile
+                 + bk * d * 4 * 4        # k, v tiles + dk/dv accums
+                 + bq * bk * 4 * 2       # score + ds tiles
+                 + bq * d * 4)           # acc
+        return tiles * 2 <= vmem_budget  # x2: double buffering headroom
+
+    def pow2_divisor(n, cap=1024):
+        return min(n & -n, cap)          # largest 2^k dividing n
+
+    bq = pow2_divisor(tq)
+    while bq > 8:
+        bk = pow2_divisor(tk)
+        while bk > 8 and not fits(bq, bk):
+            bk //= 2
+        if fits(bq, bk):
+            return bq, bk
+        bq //= 2
+    bk = pow2_divisor(tk)
+    while bk > 8 and not fits(bq, bk):
+        bk //= 2
+    return bq, bk
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, interpret=False):
     """Blockwise attention. q: (bh, Tq, d), k/v: (bh, Tk, d) raw jax arrays.
 
-    Forward uses the Pallas kernel on TPU (or interpret=True anywhere);
-    reverse-mode AD routes through a custom_vjp whose backward differentiates
-    the blockwise lax.scan formulation — O(T·block) memory both ways.
-    Falls back to the einsum composition off-TPU / on ragged shapes.
-    """
+    Forward AND backward are Pallas kernels on TPU (or interpret=True
+    anywhere): forward emits (o, lse); backward runs the two-sweep flash
+    gradient (dq sweep over k blocks, dk/dv sweep over q blocks) — no
+    (T, T) score matrix in either direction. Block sizes default to the
+    VMEM-budget autotune (_auto_blocks); pass block_q/block_k to pin.
+    Falls back to the differentiable blockwise scan off-TPU and to the
+    einsum composition on ragged shapes."""
     import jax
+    import jax.numpy as jnp
 
     bh, tq, d = q.shape
     tk = k.shape[1]
@@ -192,28 +454,35 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
 
     on_tpu = any(dev.platform != "cpu" for dev in jax.devices())
     if not (on_tpu or interpret):
-        return _blockwise(q, k, v, scale, causal, block_k)
+        return _blockwise(q, k, v, scale, causal,
+                          block_k if block_k else 512)
 
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
+    auto_q, auto_k = _auto_blocks(tq, tk, d)
+    block_q = min(block_q or auto_q, tq)
+    block_k = min(block_k or auto_k, tk)
     if tq % block_q or tk % block_k:
         # ragged tails: fall back (padding support comes with masked loads)
         return _reference(q, k, v, scale, causal)
 
     @jax.custom_vjp
     def _fa(q, k, v):
+        # inference/primal path: the lse-free kernel (no wasted residual
+        # output); the vjp fwd below runs the lse-emitting twin
         return _flash_forward_kernel(q, k, v, causal, scale, block_q,
                                      block_k, interpret)
 
     def _fa_fwd(q, k, v):
-        return _fa(q, k, v), (q, k, v)
+        o, lse = _flash_forward_lse(q, k, v, causal, scale, block_q,
+                                    block_k, interpret)
+        return o, (q, k, v, o, lse)
 
     def _fa_bwd(res, ct):
-        q, k, v = res
-        _, vjp = jax.vjp(
-            lambda a, b, c: _blockwise(a, b, c, scale, causal, block_k),
-            q, k, v)
-        return vjp(ct)
+        q, k, v, o, lse = res
+        # delta = rowsum(dO * O) per query (the softmax-normalizer term)
+        delta = jnp.sum(ct.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        return _flash_backward(q, k, v, ct, lse, delta, causal, scale,
+                               block_q, block_k, interpret)
 
     _fa.defvjp(_fa_fwd, _fa_bwd)
     return _fa(q, k, v)

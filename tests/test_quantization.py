@@ -470,3 +470,20 @@ def test_fold_bn_axis_mismatch_refused():
                     .astype(np.float32))
     net(x)
     assert fold_batch_norm(net) == 0
+
+
+def test_fold_bn_negative_axis_normalized():
+    from incubator_mxnet_tpu.contrib.quantization import fold_batch_norm
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(5, 3, padding=1, layout="NHWC", use_bias=False),
+            nn.BatchNorm(axis=-1))       # -1 == 3 for 4-D input
+    net.initialize()
+    x = mx.np.array(np.random.RandomState(40).randn(2, 6, 6, 3)
+                    .astype(np.float32))
+    net(x)
+    with mx.autograd.predict_mode():
+        before = net(x).asnumpy()
+    assert fold_batch_norm(net) == 1
+    with mx.autograd.predict_mode():
+        after = net(x).asnumpy()
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-4)
