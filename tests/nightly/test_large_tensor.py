@@ -1,0 +1,73 @@
+"""Large-tensor (> 2^32 elements) support, nightly
+(≙ /root/reference/tests/nightly/test_large_array.py /
+test_large_vector.py: int64 indexing paths).
+
+Gated on MXNET_TEST_LARGE_TENSOR=1 — a single int8 case allocates ~4.3GB
+host-side. TPU-native note: XLA buffer sizes/offsets are 64-bit
+internally; what needs widening is the SCALAR index domain, which is
+jax's x64 mode — the runtime analogue of the reference's
+USE_INT64_TENSOR_SIZE rebuild. This module flips it on for its tests and
+restores it after.
+
+Run: MXNET_TEST_LARGE_TENSOR=1 python -m pytest tests/nightly/test_large_tensor.py -q
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+
+LARGE = 2 ** 32 + 8     # > int32 element count
+HALF = 2 ** 31 + 4      # > int32 max index
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("MXNET_TEST_LARGE_TENSOR") != "1",
+    reason="set MXNET_TEST_LARGE_TENSOR=1 (allocates >4GB)")
+
+
+@pytest.fixture(autouse=True)
+def _int64_index_mode():
+    """int64 scalar indexing (≙ the reference's USE_INT64_TENSOR_SIZE)."""
+    import jax
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    yield
+    jax.config.update("jax_enable_x64", prev)
+
+
+def test_create_index_and_reduce_beyond_2_32():
+    x = mx.np.zeros((LARGE,), dtype="int8")
+    assert x.size == LARGE
+    # point writes/reads at positions beyond int32 range
+    x[LARGE - 1] = 7
+    x[HALF] = 3
+    assert int(x[LARGE - 1].asnumpy()) == 7
+    assert int(x[HALF].asnumpy()) == 3
+    # jnp int reductions accumulate wide enough; no 34GB
+    # astype copy needed
+    assert int(x.sum().asnumpy()) == 10
+
+
+def test_slice_and_argmax_beyond_2_31():
+    x = mx.np.zeros((HALF,), dtype="int8")
+    x[HALF - 2] = 5
+    tail = x[HALF - 4:]
+    assert tail.shape == (4,)
+    np.testing.assert_array_equal(tail.asnumpy(), [0, 0, 5, 0])
+    # argmax index itself exceeds int32
+    am = int(mx.np.argmax(x).asnumpy())
+    assert am == HALF - 2
+
+
+def test_2d_with_large_leading_dim():
+    rows = 2 ** 31 // 16 + 3
+    x = mx.np.zeros((rows, 32), dtype="int8")   # > 2^32 elements total
+    x[rows - 1, 31] = 9
+    s = mx.np.sum(x, axis=0)
+    assert int(s[31].asnumpy()) == 9
