@@ -43,6 +43,7 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: str = "bfloat16"
     use_ring_attention: bool = False  # ring attention over 'sp' (shard_map)
+    ring_flash: bool = False          # flash kernels per ring hop (TPU)
     tie_embeddings: bool = True
     # Mixture-of-experts FFN (0 = dense MLP). In a sharded step the experts
     # live one-per-rank along `ep_axis` (DeepSpeed-MoE style co-location on
@@ -174,8 +175,9 @@ def _attention(x, layer, cfg, mask=None, mesh=None):
 
         spec = P("dp", "tp", "sp", None)
         o = _shard_map(
-            lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name="sp",
-                                              causal=True),
+            lambda q_, k_, v_: ring_attention(
+                q_, k_, v_, axis_name="sp", causal=True,
+                use_flash=cfg.ring_flash),
             mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_rep=False)(q, k, v)
     else:

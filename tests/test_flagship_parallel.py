@@ -172,3 +172,25 @@ def test_pipeline_step_rejects_moe_and_ring():
     mesh = Mesh(np.array(devs).reshape(2, 2), ("pp", "dp"))
     with pytest.raises(ValueError):
         tfm.make_pipeline_train_step(cfg, mesh, num_microbatches=2)
+
+
+def test_ring_flash_flagship_matches_dense():
+    """forward(use_ring_attention + ring_flash) == dense: the Pallas-hop
+    ring (interpret mode on CPU) inside the full flagship model."""
+    kw = dict(vocab_size=128, num_layers=1, d_model=64, num_heads=4,
+              d_ff=128, max_seq_len=64, dtype="float32")
+    cfg_dense = tfm.TransformerConfig(**kw)
+    cfg_ring = tfm.TransformerConfig(use_ring_attention=True,
+                                     ring_flash=True, **kw)
+    params = tfm.init_params(jax.random.PRNGKey(2), cfg_dense)
+    tokens = np.random.randint(0, 128, (4, 32)).astype(np.int32)
+
+    ref = tfm.forward(params, tokens, cfg_dense)
+    mesh = _mesh()
+    with mesh:
+        sp_params = _shard_params(params, cfg_ring, mesh)
+        toks = jax.device_put(tokens, NamedSharding(mesh, P("dp", None)))
+        out = jax.jit(lambda p, t: tfm.forward(p, t, cfg_ring, mesh))(
+            sp_params, toks)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=3e-3, atol=3e-3)
