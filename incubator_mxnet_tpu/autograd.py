@@ -121,13 +121,19 @@ class Node:
     `fn`/`inputs`/`single_out` are kept so create_graph can re-linearize the
     op as a function of its primals (vjp closures capture residuals as
     constants, so higher-order grads need a fresh jax.vjp through the tape).
+
+    Bulked (deferred) ops tape with `vjp_fn=None` plus the forward's stable
+    `key`: backward re-linearizes from the (still pending) primal inputs so
+    the vjp lands in the same bulked segment — recompute-based, XLA CSEs the
+    duplicated forward — one compiled program for the whole fwd+bwd chain.
     """
 
     __slots__ = ("vjp_fn", "parents", "out_avals", "name", "fn", "inputs",
-                 "single_out")
+                 "single_out", "key", "cached_vjp", "inputs_raw")
 
     def __init__(self, vjp_fn, parents, out_avals, name="", fn=None,
-                 inputs=None, single_out=False):
+                 inputs=None, single_out=False, key=None, cached_vjp=None,
+                 inputs_raw=None):
         self.vjp_fn = vjp_fn
         self.parents = parents
         self.out_avals = out_avals  # [(shape, dtype), ...] per output
@@ -135,11 +141,38 @@ class Node:
         self.fn = fn
         self.inputs = inputs
         self.single_out = single_out
+        self.key = key
+        self.cached_vjp = cached_vjp
+        # snapshot of the raw input buffers at record time: backward
+        # re-linearization must see the values the forward saw, even if the
+        # user mutates the NDArrays in between (buffers are immutable, so
+        # holding them is the faithful residual-capture equivalent)
+        self.inputs_raw = inputs_raw
+
+    def _primals(self, create_graph):
+        if self.inputs_raw is not None and not create_graph:
+            return tuple(self.inputs_raw)
+        return tuple(self.inputs)
 
     def apply_vjp(self, cts, create_graph=False):
         """Compute input cotangents given output cotangents (NDArray list)."""
         from .ops.registry import invoke
-        if create_graph and self.fn is not None:
+        if self.cached_vjp is not None and self.vjp_fn is None \
+                and not create_graph:
+            # bulked cached-op (HybridBlock): the jitted recompute-VJP runs
+            # over the real primal args so it defers like any other op
+            n_in = len(self.inputs)
+            cv = self.cached_vjp
+
+            def cvjp(*a):
+                return cv(tuple(a[:n_in]), tuple(a[n_in:]))
+
+            kk = ("cvjp", self.key) if self.key is not None else None
+            with _Scope(recording=False):
+                return invoke(cvjp, self._primals(False) + tuple(cts),
+                              name=f"backward_{self.name}", multi_out=True,
+                              key=kk)
+        if self.fn is not None and (create_graph or self.vjp_fn is None):
             import jax
             fn, n_in, single = self.fn, len(self.inputs), self.single_out
 
@@ -148,13 +181,20 @@ class Node:
                 _, vjp = jax.vjp(fn, *primals)
                 return vjp(cs[0] if single else tuple(cs))
 
-            with _Scope(recording=True):
-                return invoke(relinearized, tuple(self.inputs) + tuple(cts),
-                              name=f"backward_{self.name}", multi_out=True)
+            kk = ("vjp", self.key, single, n_in) if self.key is not None \
+                else None
+            with _Scope(recording=create_graph):
+                return invoke(relinearized,
+                              self._primals(create_graph) + tuple(cts),
+                              name=f"backward_{self.name}", multi_out=True,
+                              key=kk)
         with _Scope(recording=False):
+            # residual-capturing vjp closures are one-shot: keep them out of
+            # the bulking caches (key=False) — identity-keying them would
+            # recompile per call and pin residual buffers
             return invoke(self.vjp_fn, tuple(cts),
                           name=f"backward_{self.name}", multi_out=True,
-                          _vjp_tuple=True)
+                          _vjp_tuple=True, key=False)
 
 
 def mark_variables(variables, gradients=None, grad_reqs="write"):

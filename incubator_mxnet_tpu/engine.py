@@ -9,9 +9,15 @@ aliases, documenting the mapping:
   Engine::PushAsync       -> implicit: every jax op call is async-dispatched
   Engine::WaitForVar      -> NDArray.wait_to_read (block_until_ready)
   Engine::WaitForAll      -> mx.waitall()
-  op bulking (BulkFlush)  -> jax.jit / hybridize (true fusion, not batching)
+  op bulking (StartBulk/  -> REAL here (ops/segment.py): consecutive eager
+   StopBulk, engine.h:310)   ops defer into a Segment and flush as ONE cached
+                             XLA program — amortizes per-dispatch latency AND
+                             gets full cross-op fusion. set_bulk_size(N) caps
+                             the segment length; 0 disables (immediate mode).
+                             Default: MXNET_ENGINE_BULK_SIZE (4096).
   NaiveEngine env toggle  -> MXNET_ENGINE_TYPE honored: 'NaiveEngine' makes
-                             every invoke block (debug determinism)
+                             every invoke block (debug determinism; disables
+                             bulking)
 """
 from __future__ import annotations
 
@@ -19,21 +25,35 @@ from contextlib import contextmanager
 
 from .base import get_env
 
-__all__ = ["bulk", "set_bulk_size", "current_bulk_size", "is_naive",
-           "set_naive", "wait_for_all"]
+__all__ = ["bulk", "set_bulk_size", "current_bulk_size", "effective_bulk_size",
+           "is_naive", "set_naive", "wait_for_all"]
 
-_bulk_size = [0]
+_bulk_size = [None]  # None = follow MXNET_ENGINE_BULK_SIZE
 
 
 def set_bulk_size(size):
-    """≙ mx.engine.set_bulk_size. Advisory only: XLA fuses via jit."""
-    prev = _bulk_size[0]
+    """≙ mx.engine.set_bulk_size: max ops deferred per bulked segment
+    (0 = immediate dispatch). Flushes the pending segment so the new limit
+    applies from the next op."""
+    prev = current_bulk_size()
     _bulk_size[0] = int(size)
+    from .ops.segment import flush_all
+    flush_all()
     return prev
 
 
 def current_bulk_size():
-    return _bulk_size[0]
+    if _bulk_size[0] is not None:
+        return _bulk_size[0]
+    try:
+        return int(get_env("MXNET_ENGINE_BULK_SIZE", "4096") or 4096)
+    except (TypeError, ValueError):
+        return 4096
+
+
+def effective_bulk_size():
+    """Bulk size in force right now: 0 under NaiveEngine."""
+    return 0 if is_naive() else current_bulk_size()
 
 
 @contextmanager

@@ -456,7 +456,7 @@ class HybridBlock(Block):
         for p_idx, new_val in zip(meta["aux_indices"], aux_new):
             arr = params[p_idx].data()
             with autograd.pause():
-                arr._set_arr(new_val._arr)
+                arr._set_arr(new_val._data)  # adopt without materializing
         out = meta["treedef"](outs)
         for hook in self._forward_hooks.values():
             hook(self, args, out)

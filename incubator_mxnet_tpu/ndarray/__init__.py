@@ -22,6 +22,7 @@ import numpy as _np
 from .. import autograd
 from ..base import MXNetError, name_to_dtype, dtype_to_name, numeric_types
 from ..device import Device, current_device
+from ..ops.segment import _LazyVal, flush_all as _flush_segments
 
 __all__ = [
     "NDArray", "array", "zeros", "ones", "full", "empty", "arange",
@@ -38,6 +39,20 @@ def _jnp():
 def _wrap(data, device=None):
     """Wrap a raw jax/numpy array into an NDArray without copying."""
     return NDArray(data, device=device, _raw=True)
+
+
+def _wrap_lazy(lazyval):
+    """Wrap a pending (deferred) op output into an NDArray. The buffer
+    materializes at the first `_arr` access (segment flush)."""
+    nd = NDArray.__new__(NDArray)
+    nd._entry = None
+    nd._var = None
+    nd._base = None
+    nd._base_index = None
+    nd._base_version = 0
+    nd._version = 0
+    nd._data = lazyval
+    return nd
 
 
 def _place(arr, device):
@@ -65,7 +80,7 @@ class NDArray:
         self._base_index = None
         self._base_version = 0
         self._version = 0
-        if _raw and isinstance(source_array, jax.Array):
+        if _raw and isinstance(source_array, (jax.Array, _LazyVal)):
             self._data = source_array
         else:
             if isinstance(source_array, NDArray):
@@ -79,38 +94,51 @@ class NDArray:
     # ------------------------------------------------------------------
     @property
     def _arr(self):
+        d = self._data
+        if type(d) is _LazyVal:
+            self._data = d = d.force() if d.value is None else d.value
         base = self._base
         if base is not None and self._base_version != base._version:
             self._data = base._arr[self._base_index]
             self._base_version = base._version
-        return self._data
+            return self._data
+        return d
 
     def _set_arr(self, new_data):
         self._data = new_data
         self._version += 1
+
+    @property
+    def _aval(self):
+        """Shape/dtype carrier without forcing a pending buffer."""
+        d = self._data
+        if type(d) is _LazyVal and d.value is None:
+            return d.aval
+        return self._arr
 
     # ------------------------------------------------------------------
     # basic properties
     # ------------------------------------------------------------------
     @property
     def shape(self):
-        return tuple(self._arr.shape)
+        return tuple(self._aval.shape)
 
     @property
     def dtype(self):
-        return self._arr.dtype
+        return self._aval.dtype
 
     @property
     def size(self):
-        return int(self._arr.size)
+        import math
+        return int(math.prod(self._aval.shape))
 
     @property
     def ndim(self):
-        return self._arr.ndim
+        return len(self._aval.shape)
 
     @property
     def itemsize(self):
-        return self._arr.dtype.itemsize
+        return _np.dtype(self._aval.dtype).itemsize
 
     @property
     def T(self):
@@ -118,6 +146,8 @@ class NDArray:
 
     @property
     def device(self):
+        if type(self._data) is _LazyVal and self._data.value is None:
+            return current_device()  # pending buffers land on the default device
         d = self._arr.devices().pop() if hasattr(self._arr, "devices") else None
         if d is None or d.platform == "cpu":
             return Device("cpu", getattr(d, "id", 0) if d else 0)
@@ -214,8 +244,11 @@ class NDArray:
         return self
 
     def detach(self):
-        out = _wrap(self._arr)
-        return out
+        if self._base is None:
+            d = self._data  # share the (possibly pending) buffer — immutable
+            return _wrap_lazy(d) if type(d) is _LazyVal and d.value is None \
+                else _wrap(d if type(d) is not _LazyVal else d.value)
+        return _wrap(self._arr)
 
     def attach_grad(self, grad_req="write", stype=None):
         """Allocate a grad buffer and mark as autograd leaf
@@ -392,6 +425,17 @@ class NDArray:
     def __setitem__(self, key, value):
         jnp = _jnp()
         if isinstance(value, NDArray):
+            # full-slice overwrite with a matching buffer: adopt it without
+            # materializing (keeps `grad[:] = ct` / param updates deferred —
+            # buffers are immutable so sharing is safe)
+            if (_is_plain_slice_all(key) and self._base is None
+                    and value._base is None
+                    and value.shape == self.shape
+                    and value.dtype == self.dtype):
+                d = value._data
+                self._set_arr(d.value if type(d) is _LazyVal
+                              and d.value is not None else d)
+                return
             value = value._arr
         nd_key = _index_to_raw(key)
         if self._base is not None and _is_basic_index(self._base_index):
@@ -653,11 +697,13 @@ def stack(*arrays, axis=0):
 def waitall():
     """≙ Engine::WaitForAll / mx.nd.waitall: barrier on all pending work.
 
-    PJRT has no global 'wait for everything' call; blocking on every live
-    array is the faithful equivalent (a dummy computation only proves the
-    stream accepts work, not that queued computations finished).
+    PJRT has no global 'wait for everything' call; flushing the pending op
+    segment then blocking on every live array is the faithful equivalent (a
+    dummy computation only proves the stream accepts work, not that queued
+    computations finished).
     """
     import jax
+    _flush_segments()
     for a in jax.live_arrays():
         a.block_until_ready()
 

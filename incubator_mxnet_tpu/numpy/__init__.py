@@ -16,6 +16,7 @@ from ..ndarray import (NDArray, _wrap, _as_nd, waitall,
                        array, zeros, ones, full, empty, arange,
                        save, load)
 from ..ops.registry import invoke, register_op
+from ..ops import segment as _segment
 from . import random
 from . import linalg
 
@@ -71,16 +72,26 @@ def _make_wrapper(name, submodule=None):
         leaves, treedef = jtu.tree_flatten((args, kwargs))
         arr_pos = [i for i, l in enumerate(leaves) if isinstance(l, NDArray)]
         arrs = tuple(leaves[i] for i in arr_pos)
+        # placeholder-out the array leaves so the closure (which the bulking
+        # replay cache retains) holds no buffer references
+        statics = [None if isinstance(l, NDArray) else l for l in leaves]
 
         def call(*raws):
-            ls = list(leaves)
+            ls = list(statics)
             for i, r in zip(arr_pos, raws):
                 ls[i] = r
             a, kw = jtu.tree_unflatten(treedef, ls)
             out = jfn(*a, **kw)
             return tuple(out) if isinstance(out, (list, tuple)) else out
 
-        out = invoke(call, arrs, name=name)
+        # stable bulking key: op name + arg structure + every static leaf
+        # (array leaves are traced, so they stay out of the key)
+        try:
+            skey = ("np", name, submodule, treedef, tuple(arr_pos),
+                    _segment.canon(tuple(statics)))
+        except _segment.Reject:
+            skey = None
+        out = invoke(call, arrs, name=name, key=skey)
         if device is not None and isinstance(out, NDArray):
             out = out.as_in_context(device)
         return out

@@ -149,8 +149,10 @@ def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
         name="batch_norm", multi_out=True)
     if training and isinstance(running_mean, NDArray):
         with _autograd.pause():
-            running_mean._set_arr(nm.detach()._arr)
-            running_var._set_arr(nv.detach()._arr)
+            # adopt the (possibly still pending) buffers — no materialization,
+            # so a bulked eager step keeps BN stat updates in the segment
+            running_mean._set_arr(nm._data)
+            running_var._set_arr(nv._data)
     return out
 
 

@@ -1,12 +1,23 @@
-"""Single-choke-point op dispatch with autograd taping.
+"""Single-choke-point op dispatch with autograd taping and op bulking.
 
 Reference: Imperative::Invoke → SetShapeType → PushFCompute
-(src/imperative/imperative.cc:49-140, imperative_utils.h:648). TPU-native:
-`invoke(fn, args)` unwraps NDArrays, runs the jax function (XLA handles shape
-and dtype inference; PJRT dispatch is already async — the ThreadedEngine's
-var-dependency scheduling collapses into XLA buffer futures), and, when
-autograd is recording, captures a `jax.vjp` closure as the tape node
-(≙ Imperative::RecordOp, imperative.cc:210).
+(src/imperative/imperative.cc:49-140, imperative_utils.h:648) plus the
+engine's op-bulking API (include/mxnet/engine.h:310-317,
+src/imperative/cached_op.h:330). TPU-native: `invoke(fn, args)` unwraps
+NDArrays and either
+
+  * defers the jax call into the current bulking Segment (ops/segment.py) —
+    consecutive eager ops compile and dispatch as ONE cached XLA program at
+    the next materialization point, amortizing per-dispatch latency the way
+    the reference's engine bulking does; or
+  * runs the jax function immediately (NaiveEngine, bulking disabled, or the
+    op is not deferrable), where PJRT dispatch is already async.
+
+When autograd is recording, the tape node for a bulked op stores the forward
+callable + inputs and re-linearizes at backward time (`jax.vjp` inside the
+backward segment — recompute-based, XLA CSEs the duplicated forward); the
+immediate path captures a `jax.vjp` closure as before (≙ Imperative::RecordOp,
+imperative.cc:210).
 """
 from __future__ import annotations
 
@@ -14,6 +25,7 @@ import numpy as _np
 
 from .. import autograd
 from ..base import MXNetError
+from . import segment as _seg
 
 _OP_REGISTRY = {}
 
@@ -77,6 +89,17 @@ def _amp_cast(r, dtype):
     return r
 
 
+def _amp_wrap(fn, dtype, cast_pos):
+    """Move the autocast inside the traced callable (bulked path): casts the
+    exact positions the eager `_amp_cast` loop would cast."""
+    def wrapped(*xs):
+        xs = list(xs)
+        for i in cast_pos:
+            xs[i] = xs[i].astype(dtype)
+        return fn(*xs)
+    return wrapped
+
+
 _engine_mod = None
 
 
@@ -100,8 +123,12 @@ def _is_float_dtype(dtype):
         return False
 
 
+def _aval_is_float(aval):
+    return _is_float_dtype(aval.dtype)
+
+
 def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False,
-           cached_vjp=None):
+           cached_vjp=None, key=None):
     """Execute `fn` on arrays, wrapping results and taping when recording.
 
     `fn` is a pure jax function of the array-positional args (static/scalar
@@ -113,16 +140,32 @@ def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False,
     for large cached graphs) and tapes this callable instead. Used by
     HybridBlock's cached op, where the backward is a jitted
     recompute-based VJP compiled once per shape.
+
+    key: optional stable identity key for the op (hashable). Enables the
+    bulking path even when `fn`'s identity cannot be derived automatically;
+    callers guarantee equal keys imply identical computations for
+    equal-shaped args. Pass key=False to force immediate dispatch (one-shot
+    callables that must never enter the bulking caches).
     """
     import jax
-    from ..ndarray import NDArray, _wrap
+    from ..ndarray import NDArray, _wrap, _wrap_lazy
 
     raw = []
     tracked_any = False
+    lazy_any = False
     parents = []
     for a in args:
         if isinstance(a, NDArray):
-            raw.append(a._arr)
+            if a._base is not None:
+                raw.append(a._arr)   # view: force refresh against its base
+            else:
+                d = a._data
+                if type(d) is _seg._LazyVal:
+                    if d.value is not None:
+                        a._data = d = d.value
+                    else:
+                        lazy_any = True
+                raw.append(d)
             if a._var is not None:
                 parents.append(("var", a))
                 tracked_any = True
@@ -139,16 +182,59 @@ def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False,
         inner = fn
         fn = lambda *xs: inner(tuple(xs))
 
+    amp_dt = _amp_dtype(name)
+    recording = autograd.is_recording() and tracked_any
+    naive = _engine_naive()
+
+    # ------------------------------------------------------------------
+    # bulked (deferred) path. Tracer args mean we're already inside someone
+    # else's trace (hybridize cache build, replay tracing, eval_shape) —
+    # compose into that trace via the immediate path instead of deferring.
+    # ------------------------------------------------------------------
+    if key is not False and not naive and _seg.enabled() \
+            and not any(isinstance(r, jax.core.Tracer) for r in raw):
+        k = key if key is not None else _seg.derive_key(fn)
+        if k is not None:
+            bfn = fn
+            if amp_dt is not None:
+                cast_pos = tuple(
+                    i for i, r in enumerate(raw)
+                    if ((type(r) is _seg._LazyVal and _aval_is_float(r.aval)
+                         and str(r.aval.dtype) != amp_dt)
+                        or (isinstance(r, (jax.Array, _np.ndarray))
+                            and not (isinstance(r, _np.ndarray)
+                                     and r.dtype == jax.dtypes.float0)
+                            and _is_float_dtype(r.dtype)
+                            and str(r.dtype) != amp_dt)))
+                if cast_pos:
+                    bfn = _amp_wrap(fn, amp_dt, cast_pos)
+                k = (k, "amp", amp_dt, cast_pos)
+            res = _seg.enqueue(bfn, raw, k, name=name)
+            if res is not None:
+                treedef, lazies = res
+                return _finish_bulked(treedef, lazies, bfn, k, args, parents,
+                                      recording, cached_vjp, raw, name,
+                                      multi_out)
+        if lazy_any:
+            for i, r in enumerate(raw):
+                if type(r) is _seg._LazyVal:
+                    raw[i] = r.force()
+    elif lazy_any:
+        for i, r in enumerate(raw):
+            if type(r) is _seg._LazyVal:
+                raw[i] = r.force()
+
+    # ------------------------------------------------------------------
+    # immediate path
+    # ------------------------------------------------------------------
     # AMP autocast: cast float inputs per the op's list classification
     # (≙ the reference's list-driven wrapper injection, amp/amp.py:105-176)
-    amp_dt = _amp_dtype(name)
     if amp_dt is not None:
         raw = [_amp_cast(r, amp_dt) for r in raw]
 
-    recording = autograd.is_recording() and tracked_any
     if not recording:
         out = fn(*raw)
-        if _engine_naive():  # MXNET_ENGINE_TYPE=NaiveEngine: block per op
+        if naive:  # MXNET_ENGINE_TYPE=NaiveEngine: block per op
             jax.block_until_ready(out)
         if isinstance(out, (tuple, list)):
             # None entries = symbolic-zero cotangents from a cached vjp
@@ -163,7 +249,7 @@ def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False,
         tape_fn = lambda cts: cached_vjp(raw_t, tuple(cts))
     else:
         outs, vjp_fn = jax.vjp(fn, *raw)
-    if _engine_naive():
+    if naive:
         jax.block_until_ready(outs)
     single = not isinstance(outs, (tuple, list))
     outs_t = (outs,) if single else tuple(outs)
@@ -185,3 +271,33 @@ def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False,
     if single and not multi_out:
         return wrapped[0]
     return wrapped
+
+
+def _finish_bulked(treedef, lazies, bfn, k, args, parents, recording,
+                   cached_vjp, raw, name, multi_out):
+    """Wrap a deferred op's lazy outputs and tape it when recording."""
+    import jax.tree_util as jtu
+    from ..ndarray import _wrap_lazy
+
+    single = treedef.num_leaves == 1 and jtu.treedef_is_leaf(treedef)
+    wrapped = [_wrap_lazy(lv) for lv in lazies]
+
+    if recording:
+        any_float = any(_aval_is_float(lv.aval) for lv in lazies)
+        if any_float:
+            node = autograd.Node(
+                None, parents,
+                [(tuple(lv.aval.shape), lv.aval.dtype) for lv in lazies],
+                name=name, fn=bfn, inputs=tuple(args), single_out=single,
+                key=k, cached_vjp=cached_vjp, inputs_raw=tuple(raw))
+            for i, w in enumerate(wrapped):
+                w._entry = (node, i)
+
+    if single:
+        return (wrapped[0],) if multi_out else wrapped[0]
+    # rebuild the output structure (tuple/list, with None passthrough)
+    out = jtu.tree_unflatten(treedef, wrapped)
+    if isinstance(out, (tuple, list)):
+        res = tuple(out)
+        return res if (multi_out or len(res) != 1) else res[0]
+    return (out,) if multi_out else out

@@ -1,0 +1,497 @@
+"""Deferred op bulking — the engine's answer to per-dispatch latency.
+
+Reference: the dependency engine's op-bulking API (include/mxnet/engine.h:310-317
+``Engine::{Start,Stop}Bulk``) and the CachedOp bulking knob
+(src/imperative/cached_op.h:330): consecutive imperative ops are batched into one
+engine op because per-op dispatch overhead — not compute — bounds imperative-mode
+throughput.
+
+TPU-native design: consecutive ``invoke()`` calls accumulate into a ``Segment`` —
+a small SSA graph of *pending* jax calls, shape-checked immediately via
+``jax.eval_shape`` (so user errors still surface at the call site) but not
+executed. When a value is materialized (``asnumpy``, ``wait_to_read``,
+``item``, crossing into non-traced code), the whole segment flushes as ONE
+jitted XLA program. The compiled replay is cached on a structural key (per-op
+identity keys + argument avals + output liveness), so a steady-state training
+loop pays O(1) dispatches per iteration regardless of op count — the same
+amortization the reference's engine bulking buys, but with full XLA fusion
+across the bulk instead of mere queue batching.
+
+Op identity keys are derived automatically from the dispatched callable:
+``functools.partial`` over a stable function with hashable statics, or a
+closure whose cells canonicalize to hashables (code objects are per-definition-
+site constants, so ``(code, cells, defaults)`` fully determines the
+computation). Anything unkeyable — closures over arrays, value-dependent
+shapes — falls back to the immediate eager path, preserving semantics.
+
+Staleness contract: statics that canonicalize by object identity (callables,
+functors, bound-method receivers) follow the same rules as ``jax.jit`` /
+``hybridize``: the computation is cached against the object's identity, so
+mutating such an object's attributes after the first call does not retrace.
+This is exactly the reference CachedOp contract (re-hybridize after mutating
+a block); use ``engine.set_bulk_size(0)`` or NaiveEngine for fully dynamic
+closures.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import types
+import weakref
+from collections import OrderedDict
+
+import numpy as _np
+
+from ..base import get_env
+
+__all__ = ["enabled", "enqueue", "derive_key", "flush_all", "current_size",
+           "Reject", "canon"]
+
+_MAX_OPS_DEFAULT = 4096
+_REPLAY_CACHE_CAP = 96
+_AVAL_CACHE_CAP = 65536
+
+
+class Reject(Exception):
+    """Raised when a value cannot be canonicalized into a stable cache key."""
+
+
+_jax_data_classes = None
+
+
+def _jax_data_types():
+    global _jax_data_classes
+    if _jax_data_classes is None:
+        import jax
+        _jax_data_classes = (jax.Array, jax.core.Tracer)
+    return _jax_data_classes
+
+
+# ---------------------------------------------------------------------------
+# key derivation
+# ---------------------------------------------------------------------------
+_HASHABLE_LEAVES = (type(None), bool, int, float, complex, str, bytes, type,
+                    _np.dtype, range, slice, frozenset)
+
+
+def canon(x):
+    """Canonicalize a static value into a hashable key token, or Reject.
+
+    Arrays (NDArray / jax / numpy) are rejected: a closure capturing an array
+    is a hidden data dependency that must be traced, never baked into a key.
+    Functions and other identity-hashable objects key by identity — safe
+    because identity implies the same behavior (and the cache holds a strong
+    reference, so ids cannot be reused).
+    """
+    if isinstance(x, _HASHABLE_LEAVES):
+        return x
+    tx = type(x)
+    if tx in (tuple, list):
+        return (tx.__name__, tuple(canon(v) for v in x))
+    if tx is dict:
+        return ("d", tuple(sorted((k, canon(v)) for k, v in x.items())))
+    if tx in (set, frozenset):
+        return ("s", tuple(sorted(map(canon, x), key=repr)))
+    if isinstance(x, _np.generic):  # numpy scalar: hashable, value-stable
+        return x
+    if isinstance(x, _np.ndarray):
+        raise Reject
+    if isinstance(x, functools.partial):
+        # partials captured as *statics* (vjp closures, per-call wrappers)
+        # typically wrap residual buffers and are one-shot: identity-keying
+        # them would recompile every call AND pin device memory in the caches
+        raise Reject
+    if isinstance(x, _jax_data_types()):  # jax.Array / tracers: must be traced
+        raise Reject
+    if hasattr(x, "_entry") and hasattr(x, "_data"):  # duck-typed NDArray
+        raise Reject
+    try:
+        hash(x)
+    except TypeError:
+        raise Reject from None
+    return x
+
+
+def derive_key(fn):
+    """Best-effort stable identity key for a dispatched callable, or None."""
+    if isinstance(fn, functools.partial):
+        fk = derive_key(fn.func)
+        if fk is None:
+            return None
+        try:
+            return ("p", fk, canon(fn.args), canon(fn.keywords))
+        except Reject:
+            return None
+    if isinstance(fn, types.MethodType):
+        try:
+            return ("m", fn.__func__.__code__, canon(fn.__self__))
+        except Reject:
+            return None
+    if isinstance(fn, types.FunctionType):
+        try:
+            cells = tuple(canon(c.cell_contents)
+                          for c in (fn.__closure__ or ()))
+            dflts = canon(fn.__defaults__)
+        except (Reject, ValueError):  # ValueError: empty cell
+            return None
+        return ("f", fn.__code__, cells, dflts)
+    if isinstance(fn, types.BuiltinFunctionType):
+        return ("b", fn)
+    if callable(fn):
+        # callable object (jitted wrapper, functor): identity key. Safe:
+        # same object => same behavior; cache strong-refs it so the id
+        # cannot be recycled.
+        try:
+            hash(fn)
+        except TypeError:
+            return None
+        return ("o", fn)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# segment machinery
+# ---------------------------------------------------------------------------
+class _LazyVal:
+    """A pending op output: aval now, concrete buffer after flush."""
+
+    __slots__ = ("seg", "op_idx", "leaf_idx", "aval", "value", "__weakref__")
+
+    def __init__(self, seg, op_idx, leaf_idx, aval):
+        self.seg = seg
+        self.op_idx = op_idx
+        self.leaf_idx = leaf_idx
+        self.aval = aval
+        self.value = None
+
+    def force(self):
+        if self.value is None:
+            self.seg.flush()
+            if self.value is None:
+                raise self.seg.error or RuntimeError(
+                    "deferred op output was garbage-collected before flush")
+        return self.value
+
+
+class _PendingOp:
+    __slots__ = ("key", "fn", "handles", "desc", "baked", "out_refs", "name")
+
+    def __init__(self, key, fn, handles, desc, baked, name):
+        self.key = key
+        self.fn = fn
+        self.handles = handles    # ('c', slot) | ('s', op, leaf) | ('b', i)
+        self.desc = desc          # hashable per-arg descriptors for seg_key
+        self.baked = baked
+        self.out_refs = []        # weakrefs to _LazyVals
+        self.name = name
+
+
+class Segment:
+    __slots__ = ("ops", "consts", "const_ids", "flushed", "error", "lock",
+                 "__weakref__")
+
+    def __init__(self):
+        self.ops = []
+        self.consts = []
+        self.const_ids = {}
+        self.flushed = False
+        self.error = None
+        self.lock = threading.RLock()
+        with _registry_lock:
+            _live_segments.add(self)
+
+    def const_slot(self, a, dedupe_id=None):
+        if dedupe_id is not None:
+            slot = self.const_ids.get(dedupe_id)
+            if slot is not None:
+                return slot
+        slot = len(self.consts)
+        self.consts.append(a)
+        if dedupe_id is not None:
+            self.const_ids[dedupe_id] = slot
+        return slot
+
+    def flush(self):
+        with self.lock:
+            return self._flush_locked()
+
+    def _flush_locked(self):
+        if self.flushed:
+            if self.error is not None:
+                raise self.error
+            return
+        self.flushed = True
+        _maybe_clear_current(self)
+        if not self.ops:
+            return
+        import jax
+        import jax.tree_util as jtu
+
+        outs_spec = []
+        strong = []
+        key_parts = []
+        for i, op in enumerate(self.ops):
+            mask = []
+            for j, wr in enumerate(op.out_refs):
+                lv = wr()
+                alive = lv is not None
+                mask.append(alive)
+                if alive:
+                    outs_spec.append((i, j))
+                    strong.append(lv)
+            key_parts.append((op.key, tuple(op.desc), tuple(mask)))
+        seg_key = tuple(key_parts)
+
+        entry = _replay_cache_get(seg_key)
+        if entry is None:
+            ops_snap = list(self.ops)
+            spec = list(outs_spec)
+
+            def replay(consts):
+                env = {}
+                for i, op in enumerate(ops_snap):
+                    args = []
+                    for h in op.handles:
+                        k = h[0]
+                        if k == "c":
+                            args.append(consts[h[1]])
+                        elif k == "s":
+                            args.append(env[(h[1], h[2])])
+                        else:
+                            args.append(op.baked[h[1]])
+                    out = op.fn(*args)
+                    for j, leaf in enumerate(jtu.tree_leaves(out)):
+                        env[(i, j)] = leaf
+                return [env[s] for s in spec]
+
+            entry = jax.jit(replay)
+            _replay_cache_put(seg_key, entry)
+
+        _tls.suspended = getattr(_tls, "suspended", 0) + 1
+        try:
+            results = entry(self.consts)
+        except Exception as e:  # deferred-error semantics (SURVEY §5.3):
+            self.error = e      # the error surfaces at the wait point
+            self.ops = None
+            self.consts = None
+            self.const_ids = None
+            raise
+        finally:
+            _tls.suspended -= 1
+        for lv, r in zip(strong, results):
+            lv.value = r
+        # release the graph so intermediate buffers free eagerly
+        self.ops = None
+        self.consts = None
+        self.const_ids = None
+
+
+# ---------------------------------------------------------------------------
+# module state
+# ---------------------------------------------------------------------------
+_tls = threading.local()
+_registry_lock = threading.Lock()
+_live_segments = weakref.WeakSet()  # every unflushed segment, any thread
+_replay_cache = OrderedDict()   # seg_key -> jitted replay
+_aval_cache = OrderedDict()     # (op_key, arg aval keys) -> (treedef, leaf avals)
+
+
+def _replay_cache_get(key):
+    entry = _replay_cache.get(key)
+    if entry is not None:
+        _replay_cache.move_to_end(key)
+    return entry
+
+
+def _replay_cache_put(key, entry):
+    _replay_cache[key] = entry
+    while len(_replay_cache) > _REPLAY_CACHE_CAP:
+        _replay_cache.popitem(last=False)
+
+
+def _current(create=True):
+    seg = getattr(_tls, "seg", None)
+    if (seg is None or seg.flushed) and create:
+        seg = Segment()
+        _tls.seg = seg
+    return seg
+
+
+def _maybe_clear_current(seg):
+    if getattr(_tls, "seg", None) is seg:
+        _tls.seg = None
+
+
+def flush_all():
+    """Flush every thread's pending segment (≙ Engine::WaitForAll prefix).
+    Like the reference's WaitForAll, ops pushed concurrently after this call
+    starts are not covered."""
+    with _registry_lock:
+        segs = list(_live_segments)
+    err = None
+    for seg in segs:
+        if not seg.flushed:
+            try:
+                seg.flush()
+            except Exception as e:   # surface after flushing the rest
+                err = e
+    if err is not None:
+        raise err
+
+
+def current_size():
+    seg = getattr(_tls, "seg", None)
+    return 0 if seg is None or seg.flushed or seg.ops is None else len(seg.ops)
+
+
+def enabled():
+    """Bulking active? Controlled by the engine facade (set_bulk_size /
+    MXNET_ENGINE_BULK_SIZE; 0 disables), forced off under NaiveEngine and
+    while abstract evaluation / replay tracing is in flight (re-entrant
+    invokes — e.g. a custom Function's python backward — must run
+    immediately)."""
+    if getattr(_tls, "suspended", 0):
+        return False
+    from .. import engine
+    return engine.effective_bulk_size() > 0
+
+
+def _max_ops():
+    from .. import engine
+    return engine.effective_bulk_size()
+
+
+# ---------------------------------------------------------------------------
+# enqueue
+# ---------------------------------------------------------------------------
+_SCALAR_TYPES = (bool, int, float, complex)
+
+
+def _is_float0(a):
+    import jax
+    return isinstance(a, _np.ndarray) and a.dtype == jax.dtypes.float0
+
+
+def enqueue(fn, raw, key, name=""):
+    """Append one op to the current segment.
+
+    `raw`: positional args — concrete jax/numpy arrays, _LazyVal handles,
+    python scalars, or canonicalizable statics. Returns (treedef,
+    lazy_nd_leaves) on success, or None when the op cannot be deferred
+    (caller falls back to immediate execution).
+    """
+    import jax
+
+    seg = _current()
+    if seg.ops is not None and len(seg.ops) >= _max_ops():
+        seg.flush()
+        seg = _current()
+    with seg.lock:
+        return _enqueue_locked(seg, fn, raw, key, name)
+
+
+def _enqueue_locked(seg, fn, raw, key, name):
+    import jax
+
+    handles, desc, baked, eval_args, akeys = [], [], [], [], []
+    try:
+        for a in raw:
+            if type(a) is _LazyVal:
+                if a.value is not None:
+                    a = a.value
+                elif a.seg is not seg:
+                    a = a.force()   # cross-segment: materialize
+            if type(a) is _LazyVal:
+                handles.append(("s", a.op_idx, a.leaf_idx))
+                desc.append(("s", a.op_idx, a.leaf_idx))
+                sh, dt = tuple(a.aval.shape), a.aval.dtype
+                eval_args.append(jax.ShapeDtypeStruct(sh, dt))
+                akeys.append(("s", sh, str(dt)))
+            elif isinstance(a, jax.Array):
+                slot = seg.const_slot(a, dedupe_id=id(a))
+                sh, dt = tuple(a.shape), a.dtype
+                weak = bool(getattr(a, "weak_type", False))
+                handles.append(("c", slot))
+                desc.append(("c", slot, sh, str(dt), weak))
+                eval_args.append(a if a.ndim == 0 else
+                                 jax.ShapeDtypeStruct(sh, dt))
+                akeys.append(("a", sh, str(dt), weak))
+            elif isinstance(a, _np.ndarray):
+                if a.dtype == jax.dtypes.float0:
+                    # symbolic-zero cotangent: always zeros — bake as static
+                    bidx = len(baked)
+                    baked.append(a)
+                    tok = ("f0", tuple(a.shape))
+                    handles.append(("b", bidx))
+                    desc.append(tok)
+                    eval_args.append(a)
+                    akeys.append(tok)
+                else:
+                    slot = seg.const_slot(a, dedupe_id=id(a))
+                    sh = tuple(a.shape)
+                    handles.append(("c", slot))
+                    desc.append(("c", slot, sh, str(a.dtype), False))
+                    eval_args.append(jax.ShapeDtypeStruct(sh, a.dtype))
+                    akeys.append(("a", sh, str(a.dtype), False))
+            elif type(a) in _SCALAR_TYPES:
+                # runtime scalar arg: weak-typed under jit exactly as in
+                # the eager call, and value changes don't recompile
+                slot = seg.const_slot(a)
+                handles.append(("c", slot))
+                desc.append(("c", slot, "py", type(a)))
+                eval_args.append(a)
+                akeys.append(("py", type(a)))
+            elif isinstance(a, _np.generic):
+                slot = seg.const_slot(a)
+                handles.append(("c", slot))
+                desc.append(("c", slot, "npg", a.dtype.str))
+                eval_args.append(a)
+                akeys.append(("npg", a.dtype.str))
+            else:
+                tok = ("bk", canon(a))
+                bidx = len(baked)
+                baked.append(a)
+                handles.append(("b", bidx))
+                desc.append(tok)
+                eval_args.append(a)
+                akeys.append(tok)
+    except Reject:
+        return None
+
+    aval_key = (key, tuple(akeys))
+    cached = _aval_cache.get(aval_key)
+    if cached is None:
+        import jax.tree_util as jtu
+        _tls.suspended = getattr(_tls, "suspended", 0) + 1
+        try:
+            out_struct = jax.eval_shape(fn, *eval_args)
+        except Exception:
+            return None   # not abstractly evaluable (value-dependent shape,
+            # genuine user error, ...): the eager fallback re-raises for real
+        finally:
+            _tls.suspended -= 1
+        leaves, treedef = jtu.tree_flatten(out_struct)
+        if not all(hasattr(l, "shape") and hasattr(l, "dtype")
+                   for l in leaves):
+            return None
+        cached = (treedef, tuple(leaves))
+        _aval_cache[aval_key] = cached
+        while len(_aval_cache) > _AVAL_CACHE_CAP:
+            _aval_cache.popitem(last=False)
+    treedef, leaf_avals = cached
+
+    if seg.flushed:
+        # a re-entrant materialization during abstract eval flushed the
+        # segment under us; symbolic handles are stale — the caller falls
+        # back to immediate execution (lazy args are concrete now)
+        return None
+
+    op = _PendingOp(key, fn, handles, desc, baked, name)
+    op_idx = len(seg.ops)
+    lazies = []
+    for j, aval in enumerate(leaf_avals):
+        lv = _LazyVal(seg, op_idx, j, aval)
+        op.out_refs.append(weakref.ref(lv))
+        lazies.append(lv)
+    seg.ops.append(op)
+    return treedef, lazies

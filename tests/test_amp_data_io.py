@@ -221,9 +221,14 @@ def test_runtime_features():
 
 def test_engine_facade():
     from incubator_mxnet_tpu import engine
+    default = engine.current_bulk_size()
+    assert default > 0  # bulking is on by default (MXNET_ENGINE_BULK_SIZE)
     with engine.bulk(16):
         assert engine.current_bulk_size() == 16
-    assert engine.current_bulk_size() == 0
+    assert engine.current_bulk_size() == default
+    prev = engine.set_bulk_size(0)   # 0 = immediate dispatch
+    assert engine.effective_bulk_size() == 0
+    engine.set_bulk_size(prev)
     engine.wait_for_all()
 
 

@@ -1,0 +1,156 @@
+"""Op-bulking (deferred segment) semantics.
+
+Covers the engine's bulking path (ops/segment.py): deferral + flush-on-
+materialize, replay-cache reuse across loop iterations, autograd over bulked
+ops (incl. in-place mutation between forward and backward), re-entrant custom
+Functions, cross-thread waitall coverage, and the disable knobs.
+
+Reference anchors: engine bulking API include/mxnet/engine.h:310-317,
+cached-op bulking src/imperative/cached_op.h:330, WaitForAll semantics
+src/engine/threaded_engine.h.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import engine
+from incubator_mxnet_tpu.ops import segment
+
+
+def test_defers_and_flushes_on_materialize():
+    a = mx.np.array(np.ones((8, 8), np.float32))
+    b = a * 2.0 + 1.0
+    c = b.sum()
+    assert segment.current_size() >= 2          # pending, not executed
+    assert c.shape == () and b.shape == (8, 8)  # metadata without flush
+    assert segment.current_size() >= 2
+    assert float(c.asnumpy()) == 8 * 8 * 3.0    # flush happens here
+    assert segment.current_size() == 0
+
+
+def test_replay_cache_reused_across_iterations():
+    x = mx.np.array(np.arange(16, dtype=np.float32).reshape(4, 4))
+    before = len(segment._replay_cache)
+    results = []
+    for i in range(5):
+        y = ((x + 1.0) * 2.0).sum()
+        results.append(float(y.asnumpy()))
+    after = len(segment._replay_cache)
+    assert after - before <= 1                  # one compiled replay, reused
+    assert all(r == results[0] for r in results)
+
+
+def test_bulked_autograd_matches_immediate():
+    xs = np.random.RandomState(0).randn(6, 6).astype(np.float32)
+
+    def run():
+        x = mx.np.array(xs)
+        x.attach_grad()
+        with mx.autograd.record():
+            y = ((x * x + 3.0) * x).sum()
+        y.backward()
+        return x.grad.asnumpy()
+
+    g_bulked = run()
+    prev = engine.set_bulk_size(0)
+    try:
+        g_imm = run()
+    finally:
+        engine.set_bulk_size(prev)
+    np.testing.assert_allclose(g_bulked, 3 * xs * xs + 3.0, rtol=1e-5)
+    np.testing.assert_allclose(g_bulked, g_imm, rtol=1e-6)
+
+
+def test_inplace_mutation_between_fwd_and_bwd():
+    """Backward must see the values the forward saw (residual snapshot),
+    even though bulked nodes re-linearize instead of capturing vjp closures."""
+    x = mx.np.array(np.full((4,), 3.0, np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (x * x).sum()
+    x[:] = mx.np.zeros((4,))     # mutate AFTER forward, BEFORE backward
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((4,), 6.0))
+
+
+def test_custom_function_under_bulking():
+    class Square(mx.autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self._saved
+            return 2.0 * x * dy
+
+    x = mx.np.array(np.arange(4, dtype=np.float32))
+    x.attach_grad()
+    for _ in range(2):   # twice: the one-shot closures must not poison caches
+        with mx.autograd.record():
+            y = Square()(x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.asnumpy(),
+                                   2 * np.arange(4, dtype=np.float32))
+
+
+def test_waitall_covers_other_threads():
+    done = {}
+
+    def worker():
+        a = mx.np.array(np.ones((4,), np.float32))
+        done["out"] = a + 41.0     # left pending in the worker's segment
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    mx.waitall()                   # must flush the worker's segment too
+    d = done["out"]._data
+    assert not isinstance(d, segment._LazyVal) or d.value is not None
+    np.testing.assert_allclose(done["out"].asnumpy(), 42.0)
+
+
+def test_trace_time_errors_surface_at_call_site():
+    a = mx.np.array(np.ones((3, 4), np.float32))
+    b = mx.np.array(np.ones((5, 4), np.float32))
+    with pytest.raises(Exception):
+        mx.np.matmul(a, b)         # shape error: eval_shape fails -> eager
+    # raises at the call, not at a later flush
+
+
+def test_bulk_size_zero_is_immediate():
+    prev = engine.set_bulk_size(0)
+    try:
+        a = mx.np.array(np.ones((2, 2), np.float32))
+        b = a + 1.0
+        assert segment.current_size() == 0
+        assert not isinstance(b._data, segment._LazyVal)
+    finally:
+        engine.set_bulk_size(prev)
+
+
+def test_amp_autocast_in_bulked_path():
+    from incubator_mxnet_tpu import amp
+    amp.init("bfloat16")
+    try:
+        a = mx.np.array(np.ones((16, 16), np.float32))
+        w = mx.np.array(np.ones((16, 16), np.float32))
+        out = mx.npx.fully_connected(a, w, no_bias=True, flatten=False)
+        assert str(out.dtype) == "bfloat16"
+    finally:
+        amp.uninit()
+
+
+def test_grad_adopt_keeps_update_deferred():
+    """grad[:] = ct and full-slice param updates share buffers without
+    materializing, so the whole train step stays in one segment."""
+    x = mx.np.array(np.ones((4, 4), np.float32))
+    w = mx.np.array(np.full((4, 4), 2.0, np.float32))
+    w.attach_grad()
+    with mx.autograd.record():
+        L = (x @ w).sum()
+    L.backward()
+    w[:] = w - 0.1 * w.grad
+    assert segment.current_size() > 0         # still pending
+    np.testing.assert_allclose(w.asnumpy(), np.full((4, 4), 2.0 - 0.4))
