@@ -72,6 +72,34 @@ int MXImperativeInvoke(const char *op_name, int num_inputs,
                        int *num_outputs, NDArrayHandle **outputs);
 int MXFreeHandleArray(NDArrayHandle *arr);
 
+/* ---- autograd (≙ reference MXAutograd*, include/mxnet/c_api.h:1308) --- */
+/* grad_req codes follow the reference OpReqType: 0=null, 1=write, 3=add. */
+int MXAutogradSetIsRecording(int is_recording, int *prev);
+int MXAutogradSetIsTraining(int is_training, int *prev);
+int MXAutogradIsRecording(int *out);
+int MXAutogradIsTraining(int *out);
+int MXAutogradMarkVariables(int num, NDArrayHandle *vars,
+                            const int *grad_reqs);
+/* head_grads may be NULL (ones-like seeds, reference semantics). */
+int MXAutogradBackward(int num_heads, NDArrayHandle *heads,
+                       NDArrayHandle *head_grads, int retain_graph);
+/* borrowed-style: *out is a NEW handle to the grad buffer (free it). */
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+
+/* ---- kvstore (≙ reference MXKVStore*, include/mxnet/c_api.h:2347) ----- */
+typedef void *KVStoreHandle;
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInit(KVStoreHandle handle, int num, const int *keys,
+                  NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle handle, int num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+/* pull writes into the provided (pre-created) output arrays */
+int MXKVStorePull(KVStoreHandle handle, int num, const int *keys,
+                  NDArrayHandle *outs, int priority);
+int MXKVStoreGetRank(KVStoreHandle handle, int *out);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *out);
+
 /* ---- predictor (HybridBlock.export consumer) -------------------------- */
 /* prefix form: "path/net-0000"; triple form: explicit artifact paths. */
 int MXPredCreateFromPrefix(const char *prefix, PredictorHandle *out);

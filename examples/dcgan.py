@@ -1,0 +1,109 @@
+"""DCGAN on synthetic 32x32 images (≙ example/gluon/dc_gan/dcgan.py).
+
+Generator = Conv2DTranspose stack, discriminator = strided Conv2D stack;
+alternating G/D updates with BCE loss — the adversarial-training pattern of
+the reference example, runnable offline on synthetic "ring" images:
+
+    python examples/dcgan.py [--epochs 2] [--batch-size 64]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn
+
+
+def build_generator(ngf=32, nz=64):
+    net = nn.HybridSequential()
+    net.add(
+        nn.Conv2DTranspose(ngf * 4, 4, 1, 0, use_bias=False),  # 1 -> 4
+        nn.BatchNorm(), nn.Activation("relu"),
+        nn.Conv2DTranspose(ngf * 2, 4, 2, 1, use_bias=False),  # 4 -> 8
+        nn.BatchNorm(), nn.Activation("relu"),
+        nn.Conv2DTranspose(ngf, 4, 2, 1, use_bias=False),      # 8 -> 16
+        nn.BatchNorm(), nn.Activation("relu"),
+        nn.Conv2DTranspose(1, 4, 2, 1, use_bias=False),        # 16 -> 32
+        nn.Activation("tanh"))
+    return net
+
+
+def build_discriminator(ndf=32):
+    net = nn.HybridSequential()
+    net.add(
+        nn.Conv2D(ndf, 4, 2, 1, use_bias=False),
+        nn.LeakyReLU(0.2),
+        nn.Conv2D(ndf * 2, 4, 2, 1, use_bias=False),
+        nn.BatchNorm(), nn.LeakyReLU(0.2),
+        nn.Conv2D(ndf * 4, 4, 2, 1, use_bias=False),
+        nn.BatchNorm(), nn.LeakyReLU(0.2),
+        nn.Conv2D(1, 4, 1, 0, use_bias=False))
+    return net
+
+
+def real_batch(rng, n):
+    """Synthetic 'real' distribution: soft rings of random radius."""
+    yy, xx = np.mgrid[0:32, 0:32]
+    imgs = np.empty((n, 1, 32, 32), np.float32)
+    for i in range(n):
+        r = rng.uniform(6, 13)
+        d = np.sqrt((yy - 16) ** 2 + (xx - 16) ** 2)
+        imgs[i, 0] = np.tanh(3.0 * np.exp(-((d - r) ** 2) / 6.0) - 1.0)
+    return imgs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=40)
+    ap.add_argument("--nz", type=int, default=64)
+    args = ap.parse_args()
+
+    mx.seed(0)
+    rng = np.random.RandomState(0)
+    G, D = build_generator(nz=args.nz), build_discriminator()
+    G.initialize(mx.initializer.Normal(0.02))
+    D.initialize(mx.initializer.Normal(0.02))
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    gt = gluon.Trainer(G.collect_params(), "adam",
+                       {"learning_rate": 2e-4, "beta1": 0.5})
+    dt = gluon.Trainer(D.collect_params(), "adam",
+                       {"learning_rate": 2e-4, "beta1": 0.5})
+
+    bs = args.batch_size
+    ones = mx.np.ones((bs,))
+    zeros = mx.np.zeros((bs,))
+    for epoch in range(args.epochs):
+        for it in range(args.iters):
+            z = mx.np.array(rng.randn(bs, args.nz, 1, 1).astype(np.float32))
+            real = mx.np.array(real_batch(rng, bs))
+            # --- D step: real -> 1, fake -> 0
+            with mx.autograd.record():
+                out_r = D(real).reshape((bs,))
+                fake = G(z)
+                out_f = D(fake.detach()).reshape((bs,))
+                dl = (loss_fn(out_r, ones) + loss_fn(out_f, zeros)).mean()
+            dl.backward()
+            dt.step(bs)
+            # --- G step: fool D
+            with mx.autograd.record():
+                out = D(G(z)).reshape((bs,))
+                gl = loss_fn(out, ones).mean()
+            gl.backward()
+            gt.step(bs)
+            if it % 10 == 0:
+                print(f"epoch {epoch} iter {it}: "
+                      f"D={float(dl.asnumpy()):.3f} "
+                      f"G={float(gl.asnumpy()):.3f}")
+    print("dcgan done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,162 @@
+"""BiLSTM-CRF sequence tagger (≙ example/gluon/lstm_crf/lstm_crf.py).
+
+The CRF layer is written the TPU way: the forward algorithm's partition
+function is a `lax.scan` over time with log-sum-exp accumulation (instead of
+the reference's per-step python loop over NDArrays), so the whole
+loss — embeddings -> BiLSTM -> emissions -> CRF negative log-likelihood —
+traces into one XLA program. Viterbi decoding scans with max/argmax carry.
+
+    python examples/lstm_crf.py [--epochs 60]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn, rnn
+
+START, STOP = "<s>", "</s>"
+
+
+class BiLSTMCRF(gluon.HybridBlock):
+    def __init__(self, vocab_size, tag2idx, embed_dim=6, hidden=4):
+        super().__init__()
+        self.tag2idx = tag2idx
+        self.n_tags = len(tag2idx)
+        self.embedding = nn.Embedding(vocab_size, embed_dim)
+        self.lstm = rnn.LSTM(hidden // 2, bidirectional=True)
+        self.hidden2tag = nn.Dense(self.n_tags, flatten=False)
+        # transitions[i, j]: score of j -> i
+        self.transitions = gluon.Parameter(
+            "transitions", shape=(self.n_tags, self.n_tags))
+        self.transitions.initialize(mx.initializer.Uniform(0.1))
+
+    def emissions(self, sentence):
+        emb = self.embedding(sentence).expand_dims(1)   # (T, 1, E)
+        out = self.lstm(emb).reshape((sentence.shape[0], -1))
+        return self.hidden2tag(out)                     # (T, K)
+
+    def _scan_partition(self, feats):
+        """log Z via lax.scan (forward algorithm)."""
+        import jax
+        import jax.numpy as jnp
+        from incubator_mxnet_tpu.ops.registry import invoke
+        trans = self.transitions.data()
+        K = self.n_tags
+        start, stop = self.tag2idx[START], self.tag2idx[STOP]
+
+        def f(feats_raw, trans_raw):
+            init = jnp.full((K,), -10000.0)
+            init = init.at[start].set(0.0)
+
+            def step(alpha, emit):
+                # alpha[j] + trans[i, j] + emit[i] -> logsumexp over j
+                scores = alpha[None, :] + trans_raw + emit[:, None]
+                return jax.scipy.special.logsumexp(scores, axis=1), None
+
+            alpha, _ = jax.lax.scan(step, init, feats_raw)
+            return jax.scipy.special.logsumexp(alpha + trans_raw[stop])
+
+        return invoke(f, (feats, trans), name="crf_partition")
+
+    def _score(self, feats, tags):
+        import jax.numpy as jnp
+        from incubator_mxnet_tpu.ops.registry import invoke
+        trans = self.transitions.data()
+        start, stop = self.tag2idx[START], self.tag2idx[STOP]
+
+        def f(feats_raw, trans_raw, tags_raw):
+            prev = jnp.concatenate(
+                [jnp.array([start], tags_raw.dtype), tags_raw[:-1]])
+            t_scores = trans_raw[tags_raw, prev].sum()
+            e_scores = jnp.take_along_axis(
+                feats_raw, tags_raw[:, None], axis=1).sum()
+            return t_scores + e_scores + trans_raw[stop, tags_raw[-1]]
+
+        return invoke(f, (feats, trans, tags), name="crf_score")
+
+    def neg_log_likelihood(self, sentence, tags):
+        feats = self.emissions(sentence)
+        return self._scan_partition(feats) - self._score(feats, tags)
+
+    def viterbi(self, sentence):
+        import jax
+        import jax.numpy as jnp
+        feats = self.emissions(sentence)
+        trans = self.transitions.data()
+        K = self.n_tags
+        start, stop = self.tag2idx[START], self.tag2idx[STOP]
+
+        def f(feats_raw, trans_raw):
+            init = jnp.full((K,), -10000.0).at[start].set(0.0)
+
+            def step(v, emit):
+                scores = v[None, :] + trans_raw          # (K, K)
+                best = jnp.argmax(scores, axis=1)
+                v2 = jnp.max(scores, axis=1) + emit
+                return v2, best
+
+            v, back = jax.lax.scan(step, init, feats_raw)
+            last = jnp.argmax(v + trans_raw[stop])
+
+            def walk(tag, bp):
+                return bp[tag], tag
+
+            _, path = jax.lax.scan(walk, last, back, reverse=True)
+            return path
+
+        from incubator_mxnet_tpu.ops.registry import invoke
+        return invoke(f, (feats, trans), name="crf_viterbi")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=60)
+    args = ap.parse_args()
+
+    training_data = [
+        ("the wall street journal reported today that apple corporation "
+         "made money".split(), "B I I I O O O B I O O".split()),
+        ("georgia tech is a university in georgia".split(),
+         "B I O O O O B".split()),
+    ]
+    word2idx = {}
+    for sent, _ in training_data:
+        for w in sent:
+            word2idx.setdefault(w, len(word2idx))
+    tag2idx = {"B": 0, "I": 1, "O": 2, START: 3, STOP: 4}
+
+    model = BiLSTMCRF(len(word2idx), tag2idx)
+    model.initialize()
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "wd": 1e-4})
+
+    data = [(mx.np.array([word2idx[w] for w in s], dtype="int32"),
+             mx.np.array([tag2idx[t] for t in ts], dtype="int32"))
+            for s, ts in training_data]
+    for epoch in range(args.epochs):
+        total = 0.0
+        for sent, tags in data:
+            with mx.autograd.record():
+                loss = model.neg_log_likelihood(sent, tags)
+            loss.backward()
+            trainer.step(1)
+            total += float(loss.asnumpy())
+        if epoch % 10 == 0:
+            print(f"epoch {epoch}: nll={total:.3f}")
+
+    for sent, tags in data:
+        pred = model.viterbi(sent).asnumpy().tolist()
+        print("pred:", pred, "gold:", tags.asnumpy().tolist())
+        assert pred == tags.asnumpy().tolist(), "tagger failed to fit"
+    print("lstm_crf done")
+
+
+if __name__ == "__main__":
+    main()

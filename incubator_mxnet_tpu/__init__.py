@@ -42,10 +42,13 @@ _LAZY = ("gluon", "optimizer", "kvstore", "parallel", "amp", "profiler",
 
 
 def __getattr__(name):
+    if name == "kv":   # reference alias: mx.kv is mx.kvstore
+        name = "kvstore"
     if name in _LAZY:
         import importlib
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
+        globals().setdefault("kv" if name == "kvstore" else name, mod)
         return mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 

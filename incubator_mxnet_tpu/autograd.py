@@ -375,6 +375,7 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode,
             out.append(g)
         if not retain_graph:
             _free_tape(heads)
+            _sever_nodes(order)
         return out
 
     # Write into marked variables per grad_req (kWriteTo/kAddTo/kNullOp).
@@ -392,6 +393,13 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode,
         var.fresh = True
     if not retain_graph:
         _free_tape(heads)
+        _sever_nodes(order)
+    # backward() bounds an iteration for hand-rolled loops (no Trainer):
+    # flush oversized segments here so each compile stays loop-shaped
+    # instead of accumulating to the hard op cap
+    from .ops import segment as _segment
+    if _segment.current_size() > 256:
+        _segment.flush_all()
     return None
 
 
@@ -402,6 +410,21 @@ def _free_tape(heads):
         entry = getattr(h, "_entry", None)
         if entry is not None:
             h._entry = None
+
+
+def _sever_nodes(order):
+    """Break the NDArray._entry <-> Node.inputs reference cycle once the
+    backward pass has consumed the tape. Without this, every recorded
+    intermediate survives until a *cyclic* GC run — residual buffers free
+    late AND (under op bulking) segment liveness becomes GC-timing-dependent,
+    destabilizing the replay-cache keys into per-iteration recompiles."""
+    for n in order:
+        n.inputs = None
+        n.inputs_raw = None
+        n.parents = ()
+        n.vjp_fn = None
+        n.fn = None
+        n.cached_vjp = None
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,

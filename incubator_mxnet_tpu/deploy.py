@@ -221,6 +221,86 @@ def _capi_waitall():
     waitall()
 
 
+# -- autograd group (≙ MXAutograd*, reference c_api.h:1308) ----------------
+_GRAD_REQ_OF_CODE = {0: "null", 1: "write", 2: "write", 3: "add"}
+
+
+def _capi_autograd_set_recording(flag):
+    from . import autograd
+    return int(autograd.set_recording(bool(flag)))
+
+
+def _capi_autograd_set_training(flag):
+    from . import autograd
+    return int(autograd.set_training(bool(flag)))
+
+
+def _capi_autograd_is_recording():
+    from . import autograd
+    return autograd.is_recording()
+
+
+def _capi_autograd_is_training():
+    from . import autograd
+    return autograd.is_training()
+
+
+def _capi_autograd_mark_variables(variables, req_codes):
+    from . import autograd
+    reqs = [_GRAD_REQ_OF_CODE[int(c)] for c in req_codes]
+    for v, r in zip(variables, reqs):
+        v.attach_grad(grad_req=r)
+    return True
+
+
+def _capi_autograd_backward(heads, head_grads, retain_graph):
+    from . import autograd
+    autograd.backward(list(heads),
+                      list(head_grads) if head_grads is not None else None,
+                      retain_graph=bool(retain_graph))
+    return True
+
+
+def _capi_ndarray_get_grad(nd):
+    g = nd.grad
+    if g is None:
+        raise MXNetError("array has no gradient buffer "
+                         "(not marked, or backward not run)")
+    return g
+
+
+# -- kvstore group (≙ MXKVStore*, reference c_api.h:2347) ------------------
+def _capi_kv_create(type_str):
+    from .kvstore import create
+    return create(type_str)
+
+
+def _capi_kv_init(kv, keys, vals, _priority):
+    for k, v in zip(keys, vals):
+        kv.init(int(k), v)
+    return True
+
+
+def _capi_kv_push(kv, keys, vals, priority):
+    for k, v in zip(keys, vals):
+        kv.push(int(k), v, priority=priority)
+    return True
+
+
+def _capi_kv_pull(kv, keys, outs, priority):
+    for k, o in zip(keys, outs):
+        kv.pull(int(k), out=o, priority=priority)
+    return True
+
+
+def _capi_kv_rank(kv):
+    return int(kv.rank)
+
+
+def _capi_kv_size(kv):
+    return int(kv.num_workers)
+
+
 def _capi_pred_create(jaxport_path, params_path, manifest_path):
     return ExportedModel(jaxport=jaxport_path, params=params_path,
                          manifest=manifest_path)

@@ -1,3 +1,3 @@
 """gluon.contrib (≙ python/mxnet/gluon/contrib): estimator + extras."""
 from . import estimator
-from .fused import FusedTrainStep
+from .fused import FusedTrainStep, FusedInferStep

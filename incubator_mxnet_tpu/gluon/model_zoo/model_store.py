@@ -1,0 +1,198 @@
+"""Pretrained-weight store: offline loading + reference-format conversion.
+
+Reference: python/mxnet/gluon/model_zoo/model_store.py (download + cache of
+pretrained .params). This environment has no network egress, so the store is
+strictly local: `get_model_file(name, root)` resolves `<root>/<name>.npz`
+(native container) or `<root>/<name>.params` (the reference's binary format,
+parsed by `load_params_file`), and `pretrained=True` on any model-zoo
+constructor loads from there. `tools/convert_model.py` converts a reference
+checkpoint into the npz zoo.
+
+Binary format (studied from /root/reference/src/ndarray/ndarray.cc:1852-2143
+and include/mxnet/tuple.h:731 — reimplemented, not copied):
+
+  file   := u64 0x112 | u64 0 | vec<ndarray> | vec<string names>
+  vec<T> := u64 count | T...          (dmlc::Stream container serialization)
+  string := u64 length | bytes
+  ndarray(V2/V3) := u32 magic(0xF993fac9|0xF993faca) | i32 stype
+                  | shape | ctx(i32 dev_type, i32 dev_id) | i32 type_flag
+                  | raw data
+  shape  := i32 ndim | i64 * ndim
+  type_flag follows mshadow: 0=f32 1=f64 2=f16 3=u8 4=i32 5=i8 6=i64
+                             7=bool 8=i16 9=u16 10=u32 11=u64 12=bf16
+"""
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as _np
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "load_params_file", "save_params_file",
+           "convert_params_to_npz", "load_pretrained"]
+
+_LIST_MAGIC = 0x112
+_V2_MAGIC = 0xF993FAC9
+_V3_MAGIC = 0xF993FACA
+_V1_MAGIC = 0xF993FAC8
+
+_DTYPE_OF_FLAG = {0: _np.float32, 1: _np.float64, 2: _np.float16,
+                  3: _np.uint8, 4: _np.int32, 5: _np.int8, 6: _np.int64,
+                  7: _np.bool_, 8: _np.int16, 9: _np.uint16,
+                  10: _np.uint32, 11: _np.uint64}
+_FLAG_OF_DTYPE = {_np.dtype(v): k for k, v in _DTYPE_OF_FLAG.items()}
+
+
+def default_root():
+    return os.path.expanduser(
+        os.environ.get("MXNET_HOME",
+                       os.path.join("~", ".mxnet")) + "/models")
+
+
+def get_model_file(name, root=None):
+    """Resolve a local pretrained-weight file for `name` (npz preferred,
+    reference .params accepted). ≙ model_store.get_model_file minus the
+    download: this build is offline by design."""
+    root = root or default_root()
+    for ext in (".npz", ".params"):
+        p = os.path.join(root, name + ext)
+        if os.path.exists(p):
+            return p
+    raise MXNetError(
+        f"no pretrained weights for {name!r} under {root} "
+        f"(looked for {name}.npz / {name}.params). This build has no "
+        "network egress: place a weight file there, or convert a reference "
+        "checkpoint with tools/convert_model.py")
+
+
+# ---------------------------------------------------------------------------
+# reference .params binary format
+# ---------------------------------------------------------------------------
+class _Reader:
+    def __init__(self, data):
+        self.d = data
+        self.i = 0
+
+    def take(self, n):
+        if self.i + n > len(self.d):
+            raise MXNetError("truncated .params file")
+        out = self.d[self.i:self.i + n]
+        self.i += n
+        return out
+
+    def u32(self):
+        return struct.unpack("<I", self.take(4))[0]
+
+    def i32(self):
+        return struct.unpack("<i", self.take(4))[0]
+
+    def u64(self):
+        return struct.unpack("<Q", self.take(8))[0]
+
+
+def _read_one_ndarray(r):
+    magic = r.u32()
+    if magic in (_V2_MAGIC, _V3_MAGIC):
+        stype = r.i32()
+        if stype != 0:    # kDefaultStorage
+            raise MXNetError(
+                "sparse arrays in .params are unsupported (dense-only TPU "
+                "build; cast_storage the checkpoint first)")
+        ndim = r.i32()
+        shape = struct.unpack(f"<{ndim}q", r.take(8 * ndim))
+    elif magic == _V1_MAGIC:
+        ndim = r.i32()
+        shape = struct.unpack(f"<{ndim}q", r.take(8 * ndim))
+    else:
+        # legacy: magic IS ndim, dims are u32
+        ndim = magic
+        if ndim > 32:
+            raise MXNetError(f"corrupt .params (ndim={ndim})")
+        shape = struct.unpack(f"<{ndim}I", r.take(4 * ndim))
+    if len(shape) == 0:
+        return _np.zeros((), _np.float32)
+    r.i32()   # ctx dev_type
+    r.i32()   # ctx dev_id
+    flag = r.i32()
+    if flag not in _DTYPE_OF_FLAG:
+        raise MXNetError(f"unsupported dtype flag {flag} in .params")
+    dt = _np.dtype(_DTYPE_OF_FLAG[flag])
+    n = int(_np.prod(shape))
+    arr = _np.frombuffer(r.take(n * dt.itemsize), dtype=dt).reshape(shape)
+    return arr.copy()
+
+
+def load_params_file(path):
+    """Parse a reference-format .params file -> dict {name: np.ndarray}."""
+    with open(path, "rb") as f:
+        r = _Reader(f.read())
+    if r.u64() != _LIST_MAGIC:
+        raise MXNetError(f"{path}: not an NDArray list file (bad magic)")
+    r.u64()                      # reserved
+    n = r.u64()
+    arrays = [_read_one_ndarray(r) for _ in range(n)]
+    n_keys = r.u64()
+    names = []
+    for _ in range(n_keys):
+        ln = r.u64()
+        names.append(r.take(ln).decode())
+    if names and len(names) != len(arrays):
+        raise MXNetError(f"{path}: key/array count mismatch")
+    if not names:
+        names = [f"arg:arr_{i}" for i in range(len(arrays))]
+    # the reference prefixes "arg:"/"aux:" in Module-era files; strip
+    return {nm.split(":", 1)[-1]: a for nm, a in zip(names, arrays)}
+
+
+def save_params_file(path, params):
+    """Write a reference-compatible .params (V2 records, cpu context)."""
+    out = bytearray()
+    out += struct.pack("<QQ", _LIST_MAGIC, 0)
+    items = list(params.items())
+    out += struct.pack("<Q", len(items))
+    for _, arr in items:
+        arr = _np.ascontiguousarray(arr)
+        if arr.dtype not in _FLAG_OF_DTYPE:
+            arr = arr.astype(_np.float32)
+        out += struct.pack("<I", _V2_MAGIC)
+        out += struct.pack("<i", 0)                      # default storage
+        out += struct.pack("<i", arr.ndim)
+        out += struct.pack(f"<{arr.ndim}q", *arr.shape)
+        out += struct.pack("<ii", 1, 0)                  # cpu:0
+        out += struct.pack("<i", _FLAG_OF_DTYPE[arr.dtype])
+        out += arr.tobytes()
+    out += struct.pack("<Q", len(items))
+    for nm, _ in items:
+        b = nm.encode()
+        out += struct.pack("<Q", len(b)) + b
+    with open(path, "wb") as f:
+        f.write(bytes(out))
+    return path
+
+
+def convert_params_to_npz(params_path, npz_path, name_map=None):
+    """Convert a reference .params checkpoint into the npz zoo format.
+
+    name_map: optional {reference_name: target_name} renaming (real
+    reference zoo files use layer-name keys that may differ from this
+    framework's structured names)."""
+    params = load_params_file(params_path)
+    if name_map:
+        params = {name_map.get(k, k): v for k, v in params.items()}
+    _np.savez(npz_path, **params)
+    return npz_path
+
+
+def load_pretrained(net, name, root=None):
+    """Load pretrained weights into an initialized model-zoo net."""
+    path = get_model_file(name, root)
+    if path.endswith(".params"):
+        arrays = load_params_file(path)
+        import tempfile
+        tmp = tempfile.NamedTemporaryFile(suffix=".npz", delete=False)
+        _np.savez(tmp.name, **arrays)
+        path = tmp.name
+    net.load_parameters(path)
+    return net

@@ -32,12 +32,15 @@ __all__ = [
 ]
 
 
-def _check_pretrained(pretrained):
+def _load_pretrained(net, name, pretrained, root=None):
+    """pretrained=True loads local weights from the offline model store
+    (≙ model_store.get_model_file download+cache, minus the download: this
+    environment has no network egress; see
+    gluon/model_zoo/model_store.py for the format + converter)."""
     if pretrained:
-        raise MXNetError(
-            "pretrained weights require network download which this "
-            "environment does not provide; call net.load_parameters(path) "
-            "with a locally available file")
+        from .model_store import load_pretrained
+        load_pretrained(net, name, root=root)
+    return net
 
 
 # ---------------------------------------------------------------------------
@@ -266,14 +269,15 @@ _resnet_spec = {
 }
 
 
-def get_resnet(version, num_layers, pretrained=False, **kwargs):
-    _check_pretrained(pretrained)
+def get_resnet(version, num_layers, pretrained=False, root=None, **kwargs):
     block_type, layers, channels = _resnet_spec[num_layers]
     if version == 1:
         block = BasicBlockV1 if block_type == "basic_block" else BottleneckV1
-        return ResNetV1(block, layers, channels, **kwargs)
+        return _load_pretrained(ResNetV1(block, layers, channels, **kwargs),
+                                f"resnet{num_layers}_v1", pretrained, root)
     block = BasicBlockV2 if block_type == "basic_block" else BottleneckV2
-    return ResNetV2(block, layers, channels, **kwargs)
+    return _load_pretrained(ResNetV2(block, layers, channels, **kwargs),
+                            f"resnet{num_layers}_v2", pretrained, root)
 
 
 def resnet18_v1(**kw): return get_resnet(1, 18, **kw)
@@ -314,9 +318,8 @@ class AlexNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def alexnet(pretrained=False, **kwargs):
-    _check_pretrained(pretrained)
-    return AlexNet(**kwargs)
+def alexnet(pretrained=False, root=None, **kwargs):
+    return _load_pretrained(AlexNet(**kwargs), "alexnet", pretrained, root)
 
 
 # ---------------------------------------------------------------------------
@@ -350,10 +353,11 @@ _vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
              19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
 
 
-def get_vgg(num_layers, pretrained=False, **kwargs):
-    _check_pretrained(pretrained)
+def get_vgg(num_layers, pretrained=False, root=None, **kwargs):
     layers, filters = _vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    bn = "_bn" if kwargs.get("batch_norm") else ""
+    return _load_pretrained(VGG(layers, filters, **kwargs),
+                            f"vgg{num_layers}{bn}", pretrained, root)
 
 
 def vgg11(**kw): return get_vgg(11, **kw)
@@ -427,14 +431,14 @@ class SqueezeNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def squeezenet1_0(pretrained=False, **kw):
-    _check_pretrained(pretrained)
-    return SqueezeNet("1.0", **kw)
+def squeezenet1_0(pretrained=False, root=None, **kw):
+    return _load_pretrained(SqueezeNet("1.0", **kw), "squeezenet1.0",
+                            pretrained, root)
 
 
-def squeezenet1_1(pretrained=False, **kw):
-    _check_pretrained(pretrained)
-    return SqueezeNet("1.1", **kw)
+def squeezenet1_1(pretrained=False, root=None, **kw):
+    return _load_pretrained(SqueezeNet("1.1", **kw), "squeezenet1.1",
+                            pretrained, root)
 
 
 # ---------------------------------------------------------------------------
@@ -508,10 +512,10 @@ _densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
                   201: (64, 32, [6, 12, 48, 32])}
 
 
-def get_densenet(num_layers, pretrained=False, **kwargs):
-    _check_pretrained(pretrained)
+def get_densenet(num_layers, pretrained=False, root=None, **kwargs):
     init_f, growth, cfg = _densenet_spec[num_layers]
-    return DenseNet(init_f, growth, cfg, **kwargs)
+    return _load_pretrained(DenseNet(init_f, growth, cfg, **kwargs),
+                            f"densenet{num_layers}", pretrained, root)
 
 
 def densenet121(**kw): return get_densenet(121, **kw)
@@ -608,44 +612,36 @@ class MobileNetV2(HybridBlock):
         return self.output(self.features(x))
 
 
-def mobilenet1_0(pretrained=False, **kw):
-    _check_pretrained(pretrained)
-    return MobileNet(1.0, **kw)
+def mobilenet1_0(pretrained=False, root=None, **kw):
+    return _load_pretrained(MobileNet(1.0, **kw), "mobilenet1.0", pretrained, root)
 
 
-def mobilenet0_75(pretrained=False, **kw):
-    _check_pretrained(pretrained)
-    return MobileNet(0.75, **kw)
+def mobilenet0_75(pretrained=False, root=None, **kw):
+    return _load_pretrained(MobileNet(0.75, **kw), "mobilenet0.75", pretrained, root)
 
 
-def mobilenet0_5(pretrained=False, **kw):
-    _check_pretrained(pretrained)
-    return MobileNet(0.5, **kw)
+def mobilenet0_5(pretrained=False, root=None, **kw):
+    return _load_pretrained(MobileNet(0.5, **kw), "mobilenet0.5", pretrained, root)
 
 
-def mobilenet0_25(pretrained=False, **kw):
-    _check_pretrained(pretrained)
-    return MobileNet(0.25, **kw)
+def mobilenet0_25(pretrained=False, root=None, **kw):
+    return _load_pretrained(MobileNet(0.25, **kw), "mobilenet0.25", pretrained, root)
 
 
-def mobilenet_v2_1_0(pretrained=False, **kw):
-    _check_pretrained(pretrained)
-    return MobileNetV2(1.0, **kw)
+def mobilenet_v2_1_0(pretrained=False, root=None, **kw):
+    return _load_pretrained(MobileNetV2(1.0, **kw), "mobilenetv2_1.0", pretrained, root)
 
 
-def mobilenet_v2_0_75(pretrained=False, **kw):
-    _check_pretrained(pretrained)
-    return MobileNetV2(0.75, **kw)
+def mobilenet_v2_0_75(pretrained=False, root=None, **kw):
+    return _load_pretrained(MobileNetV2(0.75, **kw), "mobilenetv2_0.75", pretrained, root)
 
 
-def mobilenet_v2_0_5(pretrained=False, **kw):
-    _check_pretrained(pretrained)
-    return MobileNetV2(0.5, **kw)
+def mobilenet_v2_0_5(pretrained=False, root=None, **kw):
+    return _load_pretrained(MobileNetV2(0.5, **kw), "mobilenetv2_0.5", pretrained, root)
 
 
-def mobilenet_v2_0_25(pretrained=False, **kw):
-    _check_pretrained(pretrained)
-    return MobileNetV2(0.25, **kw)
+def mobilenet_v2_0_25(pretrained=False, root=None, **kw):
+    return _load_pretrained(MobileNetV2(0.25, **kw), "mobilenetv2_0.25", pretrained, root)
 
 
 # ---------------------------------------------------------------------------
@@ -778,9 +774,8 @@ class Inception3(HybridBlock):
         return self.output(self.features(x))
 
 
-def inception_v3(pretrained=False, **kw):
-    _check_pretrained(pretrained)
-    return Inception3(**kw)
+def inception_v3(pretrained=False, root=None, **kw):
+    return _load_pretrained(Inception3(**kw), "inceptionv3", pretrained, root)
 
 
 # ---------------------------------------------------------------------------

@@ -157,11 +157,15 @@ class Trainer:
                     self._optimizer.create_state_multi_precision(i, p.data())
                 self._states_created[i] = True
             items.append((i, p.data(), p.grad(), self._states[i]))
-        # one fused XLA computation for all params when the rule supports it
-        # (≙ multi_sgd_update etc.); falls back to per-param kernels
-        if not self._optimizer.fused_update_all(items):
-            for i, w, g, s in items:
-                self._optimizer.update_multi_precision(i, w, g, s)
+        # one fused XLA computation for all params when the rule supports
+        # it (≙ multi_sgd_update etc.). Under engine op-bulking the update
+        # joins the deferred segment, so the WHOLE iteration (fwd+bwd+
+        # update) is one self-feeding donated program; otherwise a
+        # standalone jitted multi-tensor update; else per-param kernels.
+        if not self._optimizer.fused_update_all_bulked(items):
+            if not self._optimizer.fused_update_all(items):
+                for i, w, g, s in items:
+                    self._optimizer.update_multi_precision(i, w, g, s)
         # only mark grads consumed once the updates have been issued
         for i, w, g, s in items:
             if w._var is not None:

@@ -494,7 +494,13 @@ class ImageRecordIter(DataIter):
                     Image.fromarray(img.astype(_np.uint8)).resize(
                         (nw, nh), Image.BILINEAR))
             except ImportError:
-                pass
+                # numpy nearest-neighbor resize fallback so the crop
+                # geometry invariants (ih >= h, iw >= w) always hold
+                ys = _np.clip((_np.arange(nh) + 0.5) * (ih / nh) - 0.5,
+                              0, ih - 1).round().astype(_np.int64)
+                xs_ = _np.clip((_np.arange(nw) + 0.5) * (iw / nw) - 0.5,
+                               0, iw - 1).round().astype(_np.int64)
+                img = img[ys][:, xs_]
             ih, iw = img.shape[:2]
             y0 = rng.randint(0, ih - h + 1) if self._rand_crop else (ih - h) // 2
             x0 = rng.randint(0, iw - w + 1) if self._rand_crop else (iw - w) // 2

@@ -122,12 +122,105 @@ class KVStore(KVStoreBase):
 
     @staticmethod
     def _cross_process_sum(agg):
-        """Sum a value across processes (≙ ps-lite server aggregation)."""
+        """Sum ONE value across processes (small-key / fallback path)."""
         from jax.experimental import multihost_utils
         from ..ndarray import NDArray, array
         raw = agg._arr if isinstance(agg, NDArray) else agg
         gathered = multihost_utils.process_allgather(raw)  # (P, *shape)
         return array(_np.asarray(gathered).sum(axis=0))
+
+    _BUCKET_BYTES = 4 << 20   # ≙ kvstore_dist key-sharding granularity
+
+    def _cross_process_sum_many(self, aggs):
+        """Bucketed fused allreduce across processes.
+
+        ≙ src/kvstore/kvstore_dist.h:262-382 — the reference shards big keys
+        and batches small ones so the wire sees few large messages. Here:
+        gradients are flattened and concatenated into ~4MB buckets; each
+        bucket is ONE device-path collective (a global-mesh jit whose sum
+        over the process axis XLA lowers to AllReduce over ICI/DCN), not a
+        per-key host round-trip. Buckets dispatch asynchronously, so
+        bucket k+1's transfer overlaps bucket k's reduction (the priority
+        overlap the reference gets from engine priorities). Falls back to
+        the host path when the topology is irregular.
+        """
+        import jax
+        import jax.numpy as jnp
+        from ..ndarray import NDArray, _wrap
+
+        if len(aggs) == 1:
+            return [self._cross_process_sum(aggs[0])]
+        raws = [a._arr if isinstance(a, NDArray) else jnp.asarray(a)
+                for a in aggs]
+        try:
+            reduce_flat = self._world_allreduce()
+        except Exception:
+            return [self._cross_process_sum(a) for a in aggs]
+
+        # bucket by dtype, ~4MB each, preserving order within dtype
+        order = list(range(len(raws)))
+        results = [None] * len(raws)
+        by_dtype = {}
+        for i in order:
+            by_dtype.setdefault(str(raws[i].dtype), []).append(i)
+        for _, idxs in by_dtype.items():
+            bucket, nbytes = [], 0
+            pending = []
+            for i in idxs:
+                sz = raws[i].size * raws[i].dtype.itemsize
+                if bucket and nbytes + sz > self._BUCKET_BYTES:
+                    pending.append(bucket)
+                    bucket, nbytes = [], 0
+                bucket.append(i)
+                nbytes += sz
+            if bucket:
+                pending.append(bucket)
+            reduced = []
+            for bucket in pending:   # async dispatch: transfers overlap
+                flat = jnp.concatenate([raws[i].reshape(-1)
+                                        for i in bucket])
+                reduced.append((bucket, reduce_flat(flat)))
+            for bucket, red in reduced:
+                off = 0
+                for i in bucket:
+                    n = raws[i].size
+                    results[i] = _wrap(
+                        red[off:off + n].reshape(raws[i].shape))
+                    off += n
+        return results
+
+    def _world_allreduce(self):
+        """jit'd flat-vector sum over a global device mesh spanning all
+        processes (XLA AllReduce, ≙ the NCCL ring the reference's
+        kvstore_nccl uses)."""
+        fn = getattr(self, "_world_allreduce_fn", None)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        import numpy as onp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = jax.devices()
+        mesh = Mesh(onp.array(devs), ("world",))
+        repl = NamedSharding(mesh, P())
+        spec = NamedSharding(mesh, P("world"))
+        summed = jax.jit(lambda x: jnp.sum(x, axis=0), out_shardings=repl)
+
+        def reduce_flat(flat):
+            W = len(devs)
+            # this process's contribution rides its first local device;
+            # other local devices contribute exact zeros
+            shards = []
+            for i, d in enumerate(jax.local_devices()):
+                v = flat if i == 0 else jnp.zeros_like(flat)
+                shards.append(jax.device_put(v[None], d))
+            garr = jax.make_array_from_single_device_arrays(
+                (W, flat.shape[0]), spec, shards)
+            return summed(garr).addressable_data(0)
+
+        self._world_allreduce_fn = reduce_flat
+        return reduce_flat
 
     @staticmethod
     def _bcast_from_root(v):
@@ -155,17 +248,24 @@ class KVStore(KVStoreBase):
 
     def push(self, key, value, priority=0):
         keys, values = _pairs(key, value)
+        aggs = []
         for k, v in zip(keys, values):
             if self._compression is not None:
+                # compression happens BEFORE the wire (≙ gradient_compression
+                # on the dist push path, src/kvstore/kvstore_dist.h:262-382):
+                # each worker quantizes with error-feedback, the collective
+                # sums the quantized values
                 vs = v if isinstance(v, (list, tuple)) else [v]
                 v = [self._compression.compress((k, i), g)
                      for i, g in enumerate(vs)]
-            agg = _aggregate(v)
-            if self._dist_active():
-                # ≙ dist_sync: the server's sum over workers. Every process
-                # contributes its local aggregate and receives the global
-                # sum, so updater/optimizer runs identically everywhere.
-                agg = self._cross_process_sum(agg)
+            aggs.append(_aggregate(v))
+        if self._dist_active():
+            # ≙ dist_sync: the server's sum over workers, as ONE fused
+            # bucketed collective set over all pushed keys. Every process
+            # contributes its local aggregate and receives the global sum,
+            # so updater/optimizer runs identically everywhere.
+            aggs = self._cross_process_sum_many(aggs)
+        for k, v, agg in zip(keys, values, aggs):
             if self._updater is not None:
                 if k not in self._store:
                     self._store[k] = _one(v).copy()

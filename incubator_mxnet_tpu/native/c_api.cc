@@ -160,6 +160,7 @@ extern "C" {
 
 typedef void *NDArrayHandle;
 typedef void *PredictorHandle;
+typedef void *KVStoreHandle;
 
 const char *MXGetLastError(void) { return g_last_error.c_str(); }
 
@@ -245,6 +246,10 @@ int MXNDArrayZeros(const int64_t *shape, int ndim, int dtype,
 
 int MXNDArrayFree(NDArrayHandle handle) {
   if (!handle) return 0;
+  // freeing after MXTPUShutdown (interpreter finalized) must be a graceful
+  // no-op, not UB: take the init mutex and re-check like ensure_runtime()
+  std::lock_guard<std::mutex> lock(g_init_mutex);
+  if (g_shutdown || !Py_IsInitialized()) return 0;
   Gil gil;
   Py_DECREF(reinterpret_cast<PyObject *>(handle));
   return 0;
@@ -333,6 +338,180 @@ int MXImperativeInvoke(const char *op_name, int num_inputs,
 
 int MXFreeHandleArray(NDArrayHandle *arr) {
   std::free(arr);
+  return 0;
+}
+
+// ---- autograd group (≙ reference MXAutograd*, c_api.h:1308) -------------
+
+namespace {
+int flag_call(const char *fn, int value, int *prev) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, PyLong_FromLong(value));
+  PyObject *r = call_deploy(fn, args);
+  if (!r) return -1;
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int flag_query(const char *fn, int *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *r = call_deploy(fn, PyTuple_New(0));
+  if (!r) return -1;
+  *out = static_cast<int>(PyObject_IsTrue(r));
+  Py_DECREF(r);
+  return 0;
+}
+}  // namespace
+
+int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  return flag_call("_capi_autograd_set_recording", is_recording, prev);
+}
+
+int MXAutogradSetIsTraining(int is_training, int *prev) {
+  return flag_call("_capi_autograd_set_training", is_training, prev);
+}
+
+int MXAutogradIsRecording(int *out) {
+  return flag_query("_capi_autograd_is_recording", out);
+}
+
+int MXAutogradIsTraining(int *out) {
+  return flag_query("_capi_autograd_is_training", out);
+}
+
+int MXAutogradMarkVariables(int num, NDArrayHandle *vars,
+                            const int *grad_reqs) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *reqs = PyList_New(num);
+  for (int i = 0; i < num; ++i)
+    PyList_SET_ITEM(reqs, i, PyLong_FromLong(grad_reqs ? grad_reqs[i] : 1));
+  PyObject *args = PyTuple_New(2);
+  PyTuple_SET_ITEM(args, 0, handles_to_list(num, vars));
+  PyTuple_SET_ITEM(args, 1, reqs);
+  PyObject *r = call_deploy("_capi_autograd_mark_variables", args);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradBackward(int num_heads, NDArrayHandle *heads,
+                       NDArrayHandle *head_grads, int retain_graph) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *args = PyTuple_New(3);
+  PyTuple_SET_ITEM(args, 0, handles_to_list(num_heads, heads));
+  if (head_grads) {
+    PyTuple_SET_ITEM(args, 1, handles_to_list(num_heads, head_grads));
+  } else {
+    Py_INCREF(Py_None);
+    PyTuple_SET_ITEM(args, 1, Py_None);
+  }
+  PyTuple_SET_ITEM(args, 2, PyBool_FromLong(retain_graph));
+  PyObject *r = call_deploy("_capi_autograd_backward", args);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *args = PyTuple_New(1);
+  PyObject *h = reinterpret_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(args, 0, h);
+  PyObject *g = call_deploy("_capi_ndarray_get_grad", args);
+  if (!g) return -1;
+  *out = g;
+  return 0;
+}
+
+// ---- kvstore group (≙ reference MXKVStore*, c_api.h:2347) ---------------
+
+namespace {
+PyObject *keys_to_list(int num, const int *keys) {
+  PyObject *l = PyList_New(num);
+  for (int i = 0; i < num; ++i)
+    PyList_SET_ITEM(l, i, PyLong_FromLong(keys[i]));
+  return l;
+}
+
+int kv_keyed_call(const char *fn, KVStoreHandle handle, int num,
+                  const int *keys, NDArrayHandle *vals, int priority) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *args = PyTuple_New(4);
+  PyObject *h = reinterpret_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(args, 0, h);
+  PyTuple_SET_ITEM(args, 1, keys_to_list(num, keys));
+  PyTuple_SET_ITEM(args, 2, handles_to_list(num, vals));
+  PyTuple_SET_ITEM(args, 3, PyLong_FromLong(priority));
+  PyObject *r = call_deploy(fn, args);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+}  // namespace
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, PyUnicode_FromString(type ? type : "local"));
+  PyObject *kv = call_deploy("_capi_kv_create", args);
+  if (!kv) return -1;
+  *out = kv;
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle handle) { return MXNDArrayFree(handle); }
+
+int MXKVStoreInit(KVStoreHandle handle, int num, const int *keys,
+                  NDArrayHandle *vals) {
+  return kv_keyed_call("_capi_kv_init", handle, num, keys, vals, 0);
+}
+
+int MXKVStorePush(KVStoreHandle handle, int num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  return kv_keyed_call("_capi_kv_push", handle, num, keys, vals, priority);
+}
+
+int MXKVStorePull(KVStoreHandle handle, int num, const int *keys,
+                  NDArrayHandle *outs, int priority) {
+  return kv_keyed_call("_capi_kv_pull", handle, num, keys, outs, priority);
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *args = PyTuple_New(1);
+  PyObject *h = reinterpret_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(args, 0, h);
+  PyObject *r = call_deploy("_capi_kv_rank", args);
+  if (!r) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *args = PyTuple_New(1);
+  PyObject *h = reinterpret_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(args, 0, h);
+  PyObject *r = call_deploy("_capi_kv_size", args);
+  if (!r) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
   return 0;
 }
 

@@ -201,12 +201,39 @@ bool ProcessOne(const uint8_t* payload, uint64_t len, const AugmentParams& ap,
 
   const float sx = static_cast<float>(w) / nw;
   const float sy = static_cast<float>(h) / nh;
+  // fold [0,1] scaling and mean/std into one affine per channel:
+  // out = v_u8 * a[c] + b[c]
   const float inv255 = 1.0f / 255.0f;
-  float mean[3] = {ap.mean ? ap.mean[0] : 0.f, ap.mean ? ap.mean[1] : 0.f,
-                   ap.mean ? ap.mean[2] : 0.f};
-  float istd[3] = {ap.stdv ? 1.f / ap.stdv[0] : 1.f,
-                   ap.stdv ? 1.f / ap.stdv[1] : 1.f,
-                   ap.stdv ? 1.f / ap.stdv[2] : 1.f};
+  float a[3], b[3];
+  for (int c = 0; c < 3; ++c) {
+    float mean_c = ap.mean ? ap.mean[c] : 0.f;
+    float istd_c = ap.stdv ? 1.f / ap.stdv[c] : 1.f;
+    a[c] = inv255 * istd_c;
+    b[c] = -mean_c * istd_c;
+  }
+
+  // separable bilinear: the x-mapping is row-invariant, so precompute the
+  // horizontal taps once; each output row then does one vectorizable
+  // vertical blend over the needed source span plus a 2-tap horizontal
+  // gather (≙ the reference's single-pass augmenter, but ~4x fewer flops
+  // per pixel than the naive 4-tap form)
+  std::vector<int> tx0(ap.out_w), tx1(ap.out_w);
+  std::vector<float> twx(ap.out_w);
+  int ix_lo = w, ix_hi = 0;
+  for (int x = 0; x < ap.out_w; ++x) {
+    float fx = (x0 + x + 0.5f) * sx - 0.5f;
+    if (fx < 0) fx = 0;
+    if (fx > w - 1) fx = static_cast<float>(w - 1);
+    int i0 = static_cast<int>(fx);
+    int i1 = i0 + 1 < w ? i0 + 1 : i0;
+    tx0[x] = i0;
+    tx1[x] = i1;
+    twx[x] = fx - i0;
+    if (i0 < ix_lo) ix_lo = i0;
+    if (i1 > ix_hi) ix_hi = i1;
+  }
+  const int span = (ix_hi - ix_lo + 1) * 3;
+  std::vector<float> vrow(span);
   const uint8_t* src = rgb.data();
   for (int y = 0; y < ap.out_h; ++y) {
     float fy = (y0 + y + 0.5f) * sy - 0.5f;
@@ -215,24 +242,26 @@ bool ProcessOne(const uint8_t* payload, uint64_t len, const AugmentParams& ap,
     int iy0 = static_cast<int>(fy);
     int iy1 = iy0 + 1 < h ? iy0 + 1 : iy0;
     float wy = fy - iy0;
-    const uint8_t* r0 = src + static_cast<size_t>(iy0) * w * 3;
-    const uint8_t* r1 = src + static_cast<size_t>(iy1) * w * 3;
+    const uint8_t* r0 = src + (static_cast<size_t>(iy0) * w + ix_lo) * 3;
+    const uint8_t* r1 = src + (static_cast<size_t>(iy1) * w + ix_lo) * 3;
+    float* vr = vrow.data();
+    if (wy == 0.f) {
+      for (int k = 0; k < span; ++k) vr[k] = r0[k];
+    } else {
+      const float cy = 1.f - wy;
+      for (int k = 0; k < span; ++k)
+        vr[k] = cy * r0[k] + wy * r1[k];
+    }
     float* drow = dst + static_cast<size_t>(y) * ap.out_w * 3;
     for (int x = 0; x < ap.out_w; ++x) {
       int xo = mirror ? (ap.out_w - 1 - x) : x;
-      float fx = (x0 + x + 0.5f) * sx - 0.5f;
-      if (fx < 0) fx = 0;
-      if (fx > w - 1) fx = static_cast<float>(w - 1);
-      int ix0 = static_cast<int>(fx);
-      int ix1 = ix0 + 1 < w ? ix0 + 1 : ix0;
-      float wx = fx - ix0;
-      float w00 = (1 - wy) * (1 - wx), w01 = (1 - wy) * wx;
-      float w10 = wy * (1 - wx), w11 = wy * wx;
-      for (int c = 0; c < 3; ++c) {
-        float v = w00 * r0[ix0 * 3 + c] + w01 * r0[ix1 * 3 + c] +
-                  w10 * r1[ix0 * 3 + c] + w11 * r1[ix1 * 3 + c];
-        drow[xo * 3 + c] = (v * inv255 - mean[c]) * istd[c];
-      }
+      const float* p0 = vr + (tx0[x] - ix_lo) * 3;
+      const float* p1 = vr + (tx1[x] - ix_lo) * 3;
+      const float wx = twx[x], cx = 1.f - wx;
+      float* o = drow + xo * 3;
+      o[0] = (cx * p0[0] + wx * p1[0]) * a[0] + b[0];
+      o[1] = (cx * p0[1] + wx * p1[1]) * a[1] + b[1];
+      o[2] = (cx * p0[2] + wx * p1[2]) * a[2] + b[2];
     }
   }
   return true;

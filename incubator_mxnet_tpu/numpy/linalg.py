@@ -45,4 +45,118 @@ import jax.numpy as _jnp_mod  # noqa: E402
 for _n in _NAMES:
     if hasattr(_jnp_mod.linalg, _n):
         globals()[_n] = _make(_n)
-__all__ = [n for n in _NAMES if n in globals()]
+
+
+# ---------------------------------------------------------------------------
+# reference la_op family (src/operator/tensor/la_op.cc — BLAS3/LAPACK ops the
+# generic jnp.linalg surface doesn't name): syrk, trmm, trsm, potrf, potri,
+# gelqf, syevd, gemm2. Same calling conventions as mx.nd.linalg.*.
+# ---------------------------------------------------------------------------
+def _la(fn, name, args):
+    return invoke(fn, args, name="linalg." + name)
+
+
+def syrk(A, transpose=False, alpha=1.0):
+    """alpha * A @ A.T (or A.T @ A when transpose) ≙ linalg_syrk."""
+    import jax.numpy as jnp
+
+    def f(a):
+        prod = (jnp.matmul(jnp.swapaxes(a, -1, -2), a) if transpose
+                else jnp.matmul(a, jnp.swapaxes(a, -1, -2)))
+        return alpha * prod
+    return _la(f, "syrk", (A,))
+
+
+def trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Triangular matrix multiply ≙ linalg_trmm: B <- alpha * op(tri(A)) B."""
+    import jax.numpy as jnp
+
+    def f(a, b):
+        t = jnp.tril(a) if lower else jnp.triu(a)
+        if transpose:
+            t = jnp.swapaxes(t, -1, -2)
+        return alpha * (jnp.matmul(b, t) if rightside else jnp.matmul(t, b))
+    return _la(f, "trmm", (A, B))
+
+
+def trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Triangular solve ≙ linalg_trsm: solve op(tri(A)) X = alpha B."""
+    from jax.scipy.linalg import solve_triangular
+
+    def f(a, b):
+        import jax.numpy as jnp
+        t = jnp.tril(a) if lower else jnp.triu(a)
+        if rightside:
+            # X op(A) = alpha B  <=>  op(A)^T X^T = alpha B^T
+            x = solve_triangular(jnp.swapaxes(t, -1, -2),
+                                 jnp.swapaxes(alpha * b, -1, -2),
+                                 lower=not lower, trans=1 if transpose else 0)
+            return jnp.swapaxes(x, -1, -2)
+        return solve_triangular(t, alpha * b, lower=lower,
+                                trans=1 if transpose else 0)
+    return _la(f, "trsm", (A, B))
+
+
+def potrf(A, lower=True):
+    """Cholesky factor ≙ linalg_potrf."""
+    import jax.numpy as jnp
+
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return L if lower else jnp.swapaxes(L, -1, -2)
+    return _la(f, "potrf", (A,))
+
+
+def potri(A, lower=True):
+    """Inverse from the Cholesky factor ≙ linalg_potri: given L (or U),
+    return (L L^T)^-1."""
+    import jax.numpy as jnp
+
+    def f(a):
+        L = a if lower else jnp.swapaxes(a, -1, -2)
+        n = a.shape[-1]
+        eye = jnp.broadcast_to(jnp.eye(n, dtype=a.dtype), a.shape)
+        from jax.scipy.linalg import solve_triangular
+        Linv = solve_triangular(L, eye, lower=True)
+        return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv)
+    return _la(f, "potri", (A,))
+
+
+def gelqf(A):
+    """LQ factorization ≙ linalg_gelqf: A = L Q with Q orthonormal rows.
+    Via QR of A^T (XLA-native): A^T = Q' R'  =>  A = R'^T Q'^T."""
+    import jax.numpy as jnp
+
+    def f(a):
+        q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+        return (jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2))
+    return _la(f, "gelqf", (A,))
+
+
+def syevd(A):
+    """Symmetric eigendecomposition ≙ linalg_syevd: returns (U, lam) with
+    A = U^T diag(lam) U (reference row-eigenvector convention)."""
+    import jax.numpy as jnp
+
+    def f(a):
+        lam, v = jnp.linalg.eigh(a)
+        return (jnp.swapaxes(v, -1, -2), lam)
+    return _la(f, "syevd", (A,))
+
+
+def gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
+    """General matmul with transpose flags ≙ linalg_gemm2."""
+    import jax.numpy as jnp
+
+    def f(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return alpha * jnp.matmul(a, b)
+    return _la(f, "gemm2", (A, B))
+
+
+_LA_OPS = ["syrk", "trmm", "trsm", "potrf", "potri", "gelqf", "syevd",
+           "gemm2"]
+__all__ = [n for n in _NAMES if n in globals()] + _LA_OPS

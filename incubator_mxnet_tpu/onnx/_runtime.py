@@ -1,0 +1,347 @@
+"""Minimal ONNX reader + numpy evaluator for the exporter's op subset.
+
+Test-support runtime (≙ the role onnxruntime plays in the reference's
+tests/python-pytest/onnx/): loads the wire format written by onnx/_proto.py
+(or any conforming ONNX file using the same subset) and executes it with
+numpy, so export correctness is proven numerically without the onnx pip
+package. NOT a serving path — serving is jax.export/StableHLO.
+"""
+from __future__ import annotations
+
+import math
+import struct
+
+import numpy as _np
+
+from ..base import MXNetError
+
+_NP_OF_DT = {1: _np.float32, 2: _np.uint8, 3: _np.int8, 4: _np.uint16,
+             5: _np.int16, 6: _np.int32, 7: _np.int64, 9: _np.bool_,
+             10: _np.float16, 11: _np.float64, 12: _np.uint32,
+             13: _np.uint64}
+
+
+# ---------------------------------------------------------------------------
+# wire-format reader
+# ---------------------------------------------------------------------------
+def _read_varint(buf, i):
+    shift, val = 0, 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field_no, wire_type, value) over a message buffer."""
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wire == 1:
+            v = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise MXNetError(f"unsupported wire type {wire}")
+        yield field, wire, v
+
+
+def _parse_tensor(buf):
+    dims, dtype, name, raw = [], 1, "", b""
+    for f, w, v in _fields(buf):
+        if f == 1:
+            dims.append(v)
+        elif f == 2:
+            dtype = v
+        elif f == 8:
+            name = v.decode()
+        elif f == 9:
+            raw = v
+    arr = _np.frombuffer(raw, dtype=_NP_OF_DT[dtype]).reshape(dims).copy()
+    return name, arr
+
+
+def _parse_attr(buf):
+    name, val = "", None
+    ints, floats = [], []
+    for f, w, v in _fields(buf):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            val = float(v)
+        elif f == 3:
+            ints.append(v)
+        elif f == 4:
+            val = v.decode()
+        elif f == 5:
+            val = _parse_tensor(v)[1]
+        elif f == 7:
+            floats.append(float(v))
+        elif f == 8:
+            ints.append(v)
+    if floats:
+        val = floats
+    elif ints:
+        val = ints[0] if len(ints) == 1 else ints
+    return name, val
+
+
+class _Node:
+    __slots__ = ("op", "inputs", "outputs", "attrs")
+
+
+def _parse_node(buf):
+    n = _Node()
+    n.inputs, n.outputs, n.attrs, n.op = [], [], {}, ""
+    for f, w, v in _fields(buf):
+        if f == 1:
+            n.inputs.append(v.decode())
+        elif f == 2:
+            n.outputs.append(v.decode())
+        elif f == 4:
+            n.op = v.decode()
+        elif f == 5:
+            k, val = _parse_attr(v)
+            n.attrs[k] = val
+    return n
+
+
+def _parse_value_info(buf):
+    name, shape = "", []
+    for f, w, v in _fields(buf):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            for f2, _, v2 in _fields(v):
+                if f2 == 1:                      # tensor_type
+                    for f3, _, v3 in _fields(v2):
+                        if f3 == 2:              # shape
+                            for f4, _, v4 in _fields(v3):
+                                if f4 == 1:      # dim
+                                    for f5, _, v5 in _fields(v4):
+                                        if f5 == 1:
+                                            shape.append(v5)
+    return name, shape
+
+
+class Graph:
+    pass
+
+
+def load_graph(path):
+    with open(path, "rb") as f:
+        buf = f.read()
+    graph_buf = None
+    for f_, w, v in _fields(buf):
+        if f_ == 7:
+            graph_buf = v
+    if graph_buf is None:
+        raise MXNetError("no GraphProto in file")
+    g = Graph()
+    g.nodes, g.inits = [], {}
+    g.input_name = g.output_name = None
+    g.input_shape = g.output_shape = None
+    for f_, w, v in _fields(graph_buf):
+        if f_ == 1:
+            g.nodes.append(_parse_node(v))
+        elif f_ == 5:
+            name, arr = _parse_tensor(v)
+            g.inits[name] = arr
+        elif f_ == 11:
+            g.input_name, g.input_shape = _parse_value_info(v)
+        elif f_ == 12:
+            g.output_name, g.output_shape = _parse_value_info(v)
+    return g
+
+
+# ---------------------------------------------------------------------------
+# numpy evaluator
+# ---------------------------------------------------------------------------
+def _im2col(x, kh, kw, sh, sw, ph0, pw0, ph1, pw1, dh=1, dw=1):
+    n, c, h, w = x.shape
+    x = _np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    eh = (kh - 1) * dh + 1
+    ew = (kw - 1) * dw + 1
+    oh = (x.shape[2] - eh) // sh + 1
+    ow = (x.shape[3] - ew) // sw + 1
+    cols = _np.empty((n, c, kh, kw, oh, ow), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            cols[:, :, i, j] = x[:, :, i * dh:i * dh + oh * sh:sh,
+                                 j * dw:j * dw + ow * sw:sw]
+    return cols, oh, ow
+
+
+def _conv(x, wgt, attrs):
+    sh, sw = attrs.get("strides", [1, 1])
+    pads = attrs.get("pads", [0, 0, 0, 0])
+    dh, dw = attrs.get("dilations", [1, 1])
+    groups = attrs.get("group", 1)
+    oc, icg, kh, kw = wgt.shape
+    n, c, _, _ = x.shape
+    outs = []
+    ocg = oc // groups
+    for g_ in range(groups):
+        xg = x[:, g_ * (c // groups):(g_ + 1) * (c // groups)]
+        wg = wgt[g_ * ocg:(g_ + 1) * ocg]
+        cols, oh, ow = _im2col(xg, kh, kw, sh, sw,
+                               pads[0], pads[1], pads[2], pads[3], dh, dw)
+        out = _np.einsum("ncijhw,ocij->nohw", cols, wg,
+                         optimize=True)
+        outs.append(out)
+    return _np.concatenate(outs, axis=1)
+
+
+def _pool(x, attrs, kind):
+    kh, kw = attrs["kernel_shape"]
+    sh, sw = attrs.get("strides", [1, 1])
+    pads = attrs.get("pads", [0, 0, 0, 0])
+    if kind == "max":
+        fill = -_np.inf
+    else:
+        fill = 0.0
+    n, c, h, w = x.shape
+    xp = _np.full((n, c, h + pads[0] + pads[2], w + pads[1] + pads[3]),
+                  fill, x.dtype)
+    xp[:, :, pads[0]:pads[0] + h, pads[1]:pads[1] + w] = x
+    oh = (xp.shape[2] - kh) // sh + 1
+    ow = (xp.shape[3] - kw) // sw + 1
+    stack = _np.stack([xp[:, :, i:i + oh * sh:sh, j:j + ow * sw:sw]
+                       for i in range(kh) for j in range(kw)], 0)
+    if kind == "max":
+        return stack.max(0)
+    return stack.mean(0)
+
+
+_erf = _np.vectorize(math.erf, otypes=[_np.float32])
+
+
+def run(path_or_graph, inputs):
+    """Execute the graph on a dict {input_name: ndarray}; returns outputs."""
+    g = (path_or_graph if isinstance(path_or_graph, Graph)
+         else load_graph(path_or_graph))
+    env = dict(g.inits)
+    env.update(inputs)
+
+    for nd in g.nodes:
+        i = [env[k] for k in nd.inputs]
+        a = nd.attrs
+        op = nd.op
+        if op == "Add":
+            o = i[0] + i[1]
+        elif op == "Sub":
+            o = i[0] - i[1]
+        elif op == "Mul":
+            o = i[0] * i[1]
+        elif op == "Div":
+            o = i[0] / i[1]
+        elif op == "Max":
+            o = _np.maximum(i[0], i[1])
+        elif op == "Min":
+            o = _np.minimum(i[0], i[1])
+        elif op == "Pow":
+            o = _np.power(i[0], i[1])
+        elif op == "Neg":
+            o = -i[0]
+        elif op == "Exp":
+            o = _np.exp(i[0])
+        elif op == "Log":
+            o = _np.log(i[0])
+        elif op == "Tanh":
+            o = _np.tanh(i[0])
+        elif op == "Sigmoid":
+            o = 1.0 / (1.0 + _np.exp(-i[0]))
+        elif op == "Sqrt":
+            o = _np.sqrt(i[0])
+        elif op == "Reciprocal":
+            o = 1.0 / i[0]
+        elif op == "Abs":
+            o = _np.abs(i[0])
+        elif op == "Sign":
+            o = _np.sign(i[0])
+        elif op == "Floor":
+            o = _np.floor(i[0])
+        elif op == "Ceil":
+            o = _np.ceil(i[0])
+        elif op == "Erf":
+            o = _erf(i[0]).astype(i[0].dtype)
+        elif op == "Identity":
+            o = i[0]
+        elif op == "Transpose":
+            o = i[0].transpose(a["perm"])
+        elif op == "Reshape":
+            o = i[0].reshape([int(s) for s in i[1]])
+        elif op == "Expand":
+            o = _np.broadcast_to(i[0], [int(s) for s in i[1]]).copy()
+        elif op == "Cast":
+            o = i[0].astype(_NP_OF_DT[a["to"]])
+        elif op == "Where":
+            o = _np.where(i[0], i[1], i[2])
+        elif op == "Concat":
+            o = _np.concatenate(i, axis=a["axis"])
+        elif op == "ReduceSum":
+            axes = tuple(int(x) for x in _np.atleast_1d(i[1]))
+            o = i[0].sum(axis=axes, keepdims=bool(a.get("keepdims", 1)))
+        elif op == "ReduceMax":
+            axes = a["axes"]
+            axes = tuple(axes) if isinstance(axes, list) else (axes,)
+            o = i[0].max(axis=axes, keepdims=bool(a.get("keepdims", 1)))
+        elif op == "ReduceMin":
+            axes = a["axes"]
+            axes = tuple(axes) if isinstance(axes, list) else (axes,)
+            o = i[0].min(axis=axes, keepdims=bool(a.get("keepdims", 1)))
+        elif op == "ArgMax":
+            o = i[0].argmax(axis=a["axis"]).astype(_np.int64)
+            if a.get("keepdims", 1):
+                o = _np.expand_dims(o, a["axis"])
+        elif op == "Pad":
+            pads = [int(x) for x in i[1]]
+            nd_ = len(pads) // 2
+            o = _np.pad(i[0], list(zip(pads[:nd_], pads[nd_:])),
+                        constant_values=float(i[2]) if len(i) > 2 else 0.0)
+        elif op == "Slice":
+            starts = [int(x) for x in i[1]]
+            ends = [int(x) for x in i[2]]
+            axes = [int(x) for x in i[3]]
+            steps = [int(x) for x in i[4]] if len(i) > 4 else [1] * len(axes)
+            sl = [slice(None)] * i[0].ndim
+            for s_, e_, ax, st in zip(starts, ends, axes, steps):
+                sl[ax] = slice(s_, e_, st)
+            o = i[0][tuple(sl)]
+        elif op == "MatMul":
+            o = i[0] @ i[1]
+        elif op == "Gemm":
+            o = i[0] @ i[1] + (i[2] if len(i) > 2 else 0)
+        elif op == "Conv":
+            o = _conv(i[0], i[1], a)
+        elif op == "MaxPool":
+            o = _pool(i[0], a, "max")
+        elif op == "AveragePool":
+            o = _pool(i[0], a, "avg")
+        elif op == "Greater":
+            o = i[0] > i[1]
+        elif op == "Less":
+            o = i[0] < i[1]
+        elif op == "GreaterOrEqual":
+            o = i[0] >= i[1]
+        elif op == "LessOrEqual":
+            o = i[0] <= i[1]
+        elif op == "Equal":
+            o = i[0] == i[1]
+        else:
+            raise MXNetError(f"evaluator: unsupported op {op}")
+        for out_name in nd.outputs:
+            env[out_name] = o
+    return env[g.output_name]

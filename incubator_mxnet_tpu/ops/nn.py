@@ -97,7 +97,30 @@ def conv_transpose(x, weight, bias=None, stride=1, padding=0, dilation=1,
     padding = _tuplize(padding, nd)
     output_padding = _tuplize(output_padding, nd)
     if groups != 1:
-        raise NotImplementedError("grouped transposed conv: pending")
+        # grouped deconv = per-group deconv over channel slices, concat on
+        # the channel axis (≙ deconvolution-inl.h group handling). The
+        # group count is a trace-time constant, so the unrolled convs fuse.
+        jnp = _jnp()
+        ch_axis = 1 if layout.startswith("NC") else x.ndim - 1
+        cin = x.shape[ch_axis]
+        if cin % groups or weight.shape[0 if layout.startswith("NC")
+                                        else -1] % groups:
+            raise ValueError("channels not divisible by groups")
+        xs = jnp.split(x, groups, axis=ch_axis)
+        # deconv weight carries in_channels on dim 0 (NC) / last (NHWC-style)
+        w_axis = 0 if layout.startswith("NC") else weight.ndim - 1
+        ws = jnp.split(weight, groups, axis=w_axis)
+        ys = [conv_transpose(xg, wg, None, stride, padding, dilation,
+                             output_padding, 1, layout)
+              for xg, wg in zip(xs, ws)]
+        y = jnp.concatenate(ys, axis=ch_axis)
+        if bias is not None:
+            nd_ = x.ndim - 2
+            if layout.startswith("NC"):
+                y = y + bias.reshape((1, -1) + (1,) * nd_)
+            else:
+                y = y + bias
+        return y
     if layout.startswith("NC"):
         spatial = layout[2:]
         # deconv weight layout in the reference is (in, out, *k)

@@ -134,7 +134,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0])                       # (bq, bk)
+        # all-masked query rows carry the _NEG_INF lse sentinel: s - lse
+        # would be 0 there (both -1e30), turning exp into 1 — zero p
+        # explicitly so fully-masked rows contribute no gradient
+        lse_row = lse_ref[0]
+        p = jnp.where(lse_row > _NEG_INF / 2,
+                      jnp.exp(s - lse_row), 0.0)        # (bq, bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0])
@@ -184,7 +189,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0])                       # (bq, bk)
+        # all-masked query rows carry the _NEG_INF lse sentinel: s - lse
+        # would be 0 there (both -1e30), turning exp into 1 — zero p
+        # explicitly so fully-masked rows contribute no gradient
+        lse_row = lse_ref[0]
+        p = jnp.where(lse_row > _NEG_INF / 2,
+                      jnp.exp(s - lse_row), 0.0)        # (bq, bk)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # (bk, d)

@@ -48,7 +48,11 @@ __all__ = ["enabled", "enqueue", "derive_key", "flush_all", "current_size",
            "Reject", "canon"]
 
 _MAX_OPS_DEFAULT = 4096
-_REPLAY_CACHE_CAP = 96
+# Replay entries hold a jitted callable whose closure carries no array
+# buffers (call sites strip them), so the cap guards compile-cache count,
+# not device memory — size it well above what a few workloads' steady-state
+# segment variants need, or LRU thrashing recompiles every iteration.
+_REPLAY_CACHE_CAP = 512
 _AVAL_CACHE_CAP = 65536
 
 
@@ -240,21 +244,61 @@ class Segment:
                     outs_spec.append((i, j))
                     strong.append(lv)
             key_parts.append((op.key, tuple(op.desc), tuple(mask)))
-        seg_key = tuple(key_parts)
+
+        # Donate consts nothing else owns (old param/state/activation
+        # buffers the update chain replaced): without donation the program
+        # holds every input alive across execution, doubling peak memory —
+        # ruinous on small-HBM slices. Sole ownership == the consts list is
+        # the only reference (getrefcount: consts entry + local + arg = 3).
+        # Optional refcount-based donation of sole-owned consts
+        # (MXNET_BULK_DONATE=1). Default OFF: the donate mask depends on
+        # buffer lifetimes, and any per-iteration flicker becomes a new
+        # compile-cache key — a compile storm. The structural wins (the
+        # optimizer update joining the segment + layout-pinned compiles)
+        # don't need it.
+        import sys
+        consts = self.consts
+        if get_env("MXNET_BULK_DONATE", "0") in ("1", "true"):
+            donate = []
+            for c in consts:
+                donate.append(isinstance(c, jax.Array)
+                              and not isinstance(c, jax.core.Tracer)
+                              and sys.getrefcount(c) == 3)
+        else:
+            donate = [False] * len(consts)
+        slot_map = []          # const slot -> (donated?, index within list)
+        n_d = n_k = 0
+        for d in donate:
+            if d:
+                slot_map.append((True, n_d))
+                n_d += 1
+            else:
+                slot_map.append((False, n_k))
+                n_k += 1
+        # Boundary layouts: every replay is a plain jax.jit, so its inputs
+        # and outputs use DEFAULT device layouts. Steady-state loops feed
+        # replay outputs back as the next replay's consts (the optimizer
+        # update joins the segment), so the boundary is default-to-default:
+        # no PJRT relayout copies, and — critically — no layout-signature
+        # chase in the cache key (keying on concrete layouts never
+        # converges when producing executables pick fresh layouts).
+        seg_key = (tuple(key_parts), tuple(donate))
 
         entry = _replay_cache_get(seg_key)
         if entry is None:
             ops_snap = list(self.ops)
             spec = list(outs_spec)
+            smap = list(slot_map)
 
-            def replay(consts):
+            def replay(dons, keeps):
                 env = {}
                 for i, op in enumerate(ops_snap):
                     args = []
                     for h in op.handles:
                         k = h[0]
                         if k == "c":
-                            args.append(consts[h[1]])
+                            d, j = smap[h[1]]
+                            args.append(dons[j] if d else keeps[j])
                         elif k == "s":
                             args.append(env[(h[1], h[2])])
                         else:
@@ -264,12 +308,14 @@ class Segment:
                         env[(i, j)] = leaf
                 return [env[s] for s in spec]
 
-            entry = jax.jit(replay)
+            entry = jax.jit(replay, donate_argnums=(0,))
             _replay_cache_put(seg_key, entry)
 
+        dons = [c for c, d in zip(consts, donate) if d]
+        keeps = [c for c, d in zip(consts, donate) if not d]
         _tls.suspended = getattr(_tls, "suspended", 0) + 1
         try:
-            results = entry(self.consts)
+            results = entry(dons, keeps)
         except Exception as e:  # deferred-error semantics (SURVEY §5.3):
             self.error = e      # the error surfaces at the wait point
             self.ops = None

@@ -205,6 +205,113 @@ class Optimizer:
             if not k.startswith("_") and k not in skip
             and isinstance(v, (int, float, bool))))
 
+    def fused_update_all_bulked(self, items):
+        """Multi-tensor fused update routed through the op-bulking segment.
+
+        When the engine is bulking (ops/segment.py), the update must join
+        the SAME deferred program as the forward/backward: the whole train
+        iteration then compiles into one donated, self-feeding XLA
+        executable — parameter buffers alias across iterations, so their
+        device layouts stay fixed and no cross-program relayout copies
+        happen (the silent >10x tax of chaining separately-compiled
+        programs). Falls back (returns False) when bulking is off or the
+        rule is ineligible; the caller then uses `fused_update_all`.
+        """
+        from ..ops import segment as _seg
+        from ..ops.registry import invoke
+        import jax
+        import jax.tree_util as jtu
+        from ..ndarray import NDArray
+
+        if not _seg.enabled():
+            return False
+        if not self._fused_safe or not items or self.multi_precision:
+            return False
+        if (type(self).update is not Optimizer.update
+                or type(self).update_multi_precision
+                is not Optimizer.update_multi_precision):
+            return False
+        for index, _, _, _ in items:
+            self._update_count(index)
+        indices = tuple(i for i, _, _, _ in items)
+        takes_t = type(self)._step_takes_t()
+        lrs = _np.asarray([self._get_lr(i) for i in indices], _np.float32)
+        wds = _np.asarray([self._get_wd(i) for i in indices], _np.float32)
+        ts = (_np.asarray([self._index_update_count[i] for i in indices],
+                          _np.float32) if takes_t else None)
+
+        def _raw(x):
+            d = x._data
+            return d  # concrete buffer or pending _LazyVal — both fine
+
+        nw = len(items)
+        sbuf_trees = [_state_tree_raw(s) for _, _, _, s in items]
+        flat_s, sdef = jtu.tree_flatten(sbuf_trees)
+        ns = len(flat_s)
+        opt = self
+
+        def f(*flat):
+            from ..ndarray import _wrap
+            wb = flat[:nw]
+            gb = flat[nw:2 * nw]
+            sb = jtu.tree_unflatten(sdef, list(flat[2 * nw:2 * nw + ns]))
+            lr_args = flat[2 * nw + ns]
+            wd_args = flat[2 * nw + ns + 1]
+            rescale = flat[2 * nw + ns + 2]
+            t_args = flat[2 * nw + ns + 3] if takes_t else None
+            prev = opt.rescale_grad
+            opt.rescale_grad = rescale
+            try:
+                new_w, new_s = [], []
+                for k, idx in enumerate(indices):
+                    w = _wrap(wb[k])
+                    g = _wrap(gb[k])
+                    st = _wrap_state(sb[k])
+                    if takes_t:
+                        opt.step_one(idx, w, g, st, lr_args[k], wd_args[k],
+                                     t=t_args[k])
+                    else:
+                        opt.step_one(idx, w, g, st, lr_args[k], wd_args[k])
+                    new_w.append(w._arr)
+                    new_s.append(_state_bufs(st))
+            finally:
+                opt.rescale_grad = prev
+            return tuple(new_w) + tuple(jtu.tree_leaves(new_s))
+
+        key = ("fused_all_bulk", self, indices, self.clip_gradient,
+               self._hyper_fingerprint(), sdef, takes_t)
+        args = (tuple(_raw(w) for _, w, _, _ in items)
+                + tuple(_raw(g) for _, _, g, _ in items)
+                + tuple(flat_s)
+                + (lrs, wds, _np.float32(self.rescale_grad))
+                + ((ts,) if takes_t else ()))
+        try:
+            outs = invoke(f, args, name="fused_update", multi_out=True,
+                          key=key)
+        except Exception:
+            # roll back the update counts: the fallback path (caller) will
+            # re-increment them — without this, every failing step would
+            # double-advance num_update/t (wrong bias correction + lr)
+            for index in indices:
+                self._index_update_count[index] -= 1
+            self.num_update = max(self._index_update_count.values(),
+                                  default=0)
+            return False
+        new_w = outs[:nw]
+        new_s_leaves = outs[nw:]
+        for (idx, w_nd, g_nd, state), wv in zip(items, new_w):
+            w_nd._set_arr(wv._data)
+        leaf_vals = [o._data for o in new_s_leaves]
+        new_trees = jtu.tree_unflatten(sdef, leaf_vals)
+        for (_, _, _, state), tree in zip(items, new_trees):
+            _state_adopt(state, tree)
+        # the optimizer step is the natural iteration boundary: flush so
+        # each loop iteration is ONE stable program (async dispatch — the
+        # host does not block), instead of accumulating iterations until
+        # the op cap forces a monster compile
+        _seg.flush_all()
+        return True
+
     def fused_update_all(self, items):
         """Multi-tensor fused update (≙ multi_sgd/multi_mp_sgd ops,
         optimizer_op.cc:353-493, preloaded_multi_sgd.cc): ONE jitted XLA
@@ -320,6 +427,26 @@ def _state_restore(state, bufs):
             _state_restore(s, b)
         return
     state._set_arr(bufs)
+
+
+def _state_tree_raw(state):
+    """Like _state_bufs but lazy-friendly: pending buffers stay pending."""
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_state_tree_raw(s) for s in state)
+    return state._data
+
+
+def _state_adopt(state, tree):
+    """Adopt (possibly pending) new buffers into the state NDArrays."""
+    if state is None:
+        return
+    if isinstance(state, tuple):
+        for s, b in zip(state, tree):
+            _state_adopt(s, b)
+        return
+    state._set_arr(tree)
 
 
 # ---------------------------------------------------------------------------

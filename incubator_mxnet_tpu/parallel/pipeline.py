@@ -18,7 +18,39 @@ the microbatched input on rank 0.
 """
 from __future__ import annotations
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "pipeline_train_1f1b", "bubble_fraction",
+           "stash_size_1f1b"]
+
+
+def stash_size_1f1b(n_stages, n_microbatches):
+    """Activation-stash slots per stage under the 1F1B schedule: bounded by
+    the pipeline depth (2S-1), NOT the microbatch count — the memory
+    advantage that motivates 1F1B over GPipe-via-autodiff (O(M) residuals).
+    Single source of truth for pipeline_train_1f1b's ring buffer."""
+    return min(n_microbatches, 2 * n_stages - 1)
+
+
+def bubble_fraction(schedule, n_stages, n_microbatches, fwd_cost=1.0,
+                    bwd_cost=2.0):
+    """Analytic pipeline-bubble fraction (idle stage-time / total stage-time)
+    for the lockstep SPMD schedules implemented here.
+
+    gpipe: jax.grad over the forward scan — a full forward phase of
+    M + S - 1 ticks then a reversed backward phase of the same length.
+    1f1b:  interleaved schedule (PipeDream-flush): M + 2S - 2 combined
+    ticks, each holding one fwd and one bwd slot. Same asymptotic bubble
+    (S-1 startup/drain); the 1F1B win is activation memory O(S) vs O(M),
+    which is what decides whether a long-sequence model fits HBM at all.
+    """
+    S, M = n_stages, n_microbatches
+    work = M * (fwd_cost + bwd_cost)            # per stage
+    if schedule == "gpipe":
+        span = (M + S - 1) * (fwd_cost + bwd_cost)
+    elif schedule == "1f1b":
+        span = (M + 2 * S - 2) * (fwd_cost + bwd_cost)
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
+    return 1.0 - work / span
 
 
 def pipeline_apply(stage_fn, stage_params, x_microbatches, axis_name="pp"):
@@ -68,3 +100,104 @@ def pipeline_apply(stage_fn, stage_params, x_microbatches, axis_name="pp"):
     (state, outputs), _ = jax.lax.scan(
         step, (state0, outputs0), jnp.arange(M + S - 1))
     return outputs
+
+
+def pipeline_train_1f1b(stage_fn, stage_params, x_microbatches, loss_fn,
+                        axis_name="pp"):
+    """One fwd+bwd pipeline pass under the 1F1B (PipeDream-flush) schedule.
+
+    stage_fn(params, x) -> y        one stage's computation (same shape)
+    stage_params                    this rank's stage parameters (pytree)
+    x_microbatches (M, B, ...)      full input, meaningful on rank 0
+    loss_fn(y) -> scalar            per-microbatch loss, applied on the
+                                    LAST stage's output
+
+    Returns (param_grads, total_loss): grads for this rank's stage params
+    (summed over microbatches) and the summed loss (meaningful on the last
+    rank). All ranks call collectively inside shard_map.
+
+    Schedule (lockstep SPMD, T = M + 2S - 2 ticks): at tick t, stage s runs
+      fwd  on microbatch  t - s                   (when in [0, M))
+      bwd  on microbatch  t - (2(S-1) - s)        (when in [0, M))
+    so the last stage backpropagates a microbatch the same tick its forward
+    finishes (one-F-one-B), and every stage stashes at most 2(S-1-s)+1
+    activations — O(S) live activations instead of GPipe's O(M). Backward
+    re-linearizes the stage from the stashed *input* (recompute; XLA folds
+    it), cotangents hop rank s <- s+1 via the reverse `lax.ppermute`.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    M = x_microbatches.shape[0]
+    mb_shape = x_microbatches.shape[1:]
+    dtype = x_microbatches.dtype
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+    stash_n = stash_size_1f1b(S, M)   # ring buffer: ample for 2(S-1-s)+1
+
+    def fwd_only(params, x):
+        return stage_fn(params, x)
+
+    zero_grads = jax.tree_util.tree_map(
+        lambda p: jnp.zeros_like(p), stage_params)
+
+    def tick(carry, t):
+        (act_in, ct_in, stash, grads, loss_sum) = carry
+
+        # ---- forward half-tick -------------------------------------
+        mf = t - rank
+        f_active = (mf >= 0) & (mf < M)
+        feed = jax.lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(mf, 0, M - 1), axis=0, keepdims=False)
+        x_in = jnp.where(rank == 0, feed, act_in)
+        y = stage_fn(stage_params, x_in)
+        y = jnp.where(f_active, y, act_in)
+        # stash the stage INPUT for this microbatch (bwd recomputes from it)
+        stash = jax.lax.cond(
+            f_active,
+            lambda st: jax.lax.dynamic_update_index_in_dim(
+                st, x_in, jnp.clip(mf, 0, M - 1) % stash_n, axis=0),
+            lambda st: st, stash)
+
+        # ---- backward half-tick ------------------------------------
+        mb = t - (2 * (S - 1) - rank)
+        b_active = (mb >= 0) & (mb < M)
+        x_saved = jax.lax.dynamic_index_in_dim(
+            stash, jnp.clip(mb, 0, M - 1) % stash_n, axis=0, keepdims=False)
+
+        def stage_and_maybe_loss(params, x):
+            out = stage_fn(params, x)
+            # last stage: scalar loss seeds the chain; others propagate ct
+            lval = loss_fn(out)
+            return out, lval
+
+        (y_b, lval), vjp = jax.vjp(stage_and_maybe_loss, stage_params,
+                                   x_saved)
+        is_last = rank == S - 1
+        ct_out = jnp.where(is_last, jnp.zeros_like(y_b), ct_in)
+        ct_loss = jnp.where(is_last, jnp.ones((), lval.dtype),
+                            jnp.zeros((), lval.dtype))
+        g_params, ct_x = vjp((ct_out.astype(y_b.dtype), ct_loss))
+        grads = jax.tree_util.tree_map(
+            lambda g, gn: g + jnp.where(b_active, gn,
+                                        jnp.zeros_like(gn)).astype(g.dtype),
+            grads, g_params)
+        loss_sum = loss_sum + jnp.where(b_active & is_last,
+                                        lval, 0.0).astype(jnp.float32)
+        ct_x = jnp.where(b_active, ct_x, ct_in)
+
+        # ---- rotate: activations forward, cotangents backward -------
+        act_next = jax.lax.ppermute(y, axis_name, perm_fwd)
+        ct_next = jax.lax.ppermute(ct_x, axis_name, perm_bwd)
+        return (act_next, ct_next, stash, grads, loss_sum), None
+
+    carry0 = (jnp.zeros(mb_shape, dtype),
+              jnp.zeros(mb_shape, dtype),
+              jnp.zeros((stash_n,) + mb_shape, dtype),
+              zero_grads,
+              jnp.zeros((), jnp.float32))
+    (act, ct, stash, grads, loss_sum), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(M + 2 * S - 2))
+    return grads, loss_sum

@@ -217,3 +217,81 @@ class profiler_scope:
 
 
 scope = profiler_scope
+
+
+# ---------------------------------------------------------------------------
+# storage profiler lanes (≙ src/profiler/storage_profiler.{h,cc}: per-alloc
+# timeline + pool stats dump). PJRT owns the allocator, so the equivalents
+# are (a) the live-allocation snapshot XLA exposes (pprof-format heap dump,
+# attributing bytes to the HLO that owns them) and (b) a sampled
+# device-memory timeline — the Chrome-trace "storage lane" the reference
+# renders from its per-alloc events.
+# ---------------------------------------------------------------------------
+def dump_storage_profile(filename="memory.prof", backend=None):
+    """Write XLA's live-buffer heap profile (pprof format; inspect with
+    `pprof -http` or speedscope). ≙ storage_profiler's aggregate dump."""
+    import jax.profiler as _jp
+    data = _jp.device_memory_profile(backend) if backend \
+        else _jp.device_memory_profile()
+    with open(filename, "wb") as f:
+        f.write(data)
+    return filename
+
+
+class MemoryMonitor:
+    """Sampled device-memory timeline (≙ the storage profiler's
+    MemoryManagerProfiler lane). Each sample lands in the Chrome trace as a
+    counter event, so `profiler.dump()` renders a memory lane alongside op
+    events.
+
+        with profiler.MemoryMonitor(interval=0.01):
+            train()
+    """
+
+    def __init__(self, interval=0.05, device=None):
+        self.interval = float(interval)
+        self.device = device
+        self.samples = []          # (ts_us, bytes_in_use)
+        self._stop = None
+        self._thread = None
+
+    def _read(self):
+        import jax
+        dev = self.device or jax.devices()[0]
+        stats = getattr(dev, "memory_stats", lambda: None)()
+        if stats:
+            return int(stats.get("bytes_in_use", 0))
+        return 0
+
+    def __enter__(self):
+        import threading
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.is_set():
+                self.samples.append((_now_us(), self._read()))
+                self._stop.wait(self.interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        import threading as _threading
+        self._stop.set()
+        self._thread.join(timeout=5)
+        # proper Chrome COUNTER events ('ph': 'C') appended unconditionally:
+        # the user explicitly asked for this lane by entering the context,
+        # whether or not the op profiler is also running
+        with _lock:
+            for ts, b in self.samples:
+                _events.append({
+                    "name": "device_memory", "cat": "storage", "ph": "C",
+                    "ts": ts, "pid": 0,
+                    "tid": _threading.get_ident() % 100000,
+                    "args": {"bytes_in_use": b},
+                })
+
+    @property
+    def peak_bytes(self):
+        return max((b for _, b in self.samples), default=0)

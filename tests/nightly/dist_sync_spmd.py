@@ -54,6 +54,55 @@ def main():
     g_ref = 2 * x_all.T @ (x_all @ w)
     np.testing.assert_allclose(np.asarray(g.addressable_data(0)), g_ref,
                                rtol=1e-5)
+
+    # ---- kvstore dist path: bucketed fused allreduce over many keys -----
+    # (≙ dist_sync_kvstore.py:66-101 + kvstore_dist.h key batching)
+    kv = mx.kv.create("dist_sync")
+    shapes = [(3,), (128, 9), (5, 7), (1024, 600)]   # mixed sizes: >1 bucket
+    keys = list(range(len(shapes)))
+    for k, s in zip(keys, shapes):
+        kv.init(k, mx.np.zeros(s))
+    grads = [mx.np.array(np.full(s, (rank + 1) * (k + 1), np.float32))
+             for k, s in zip(keys, shapes)]
+    outs = [mx.np.zeros(s) for s in shapes]
+    kv.push(keys, grads)
+    kv.pull(keys, out=outs)
+    for k, s, o in zip(keys, shapes, outs):
+        expect = sum((r + 1) * (k + 1) for r in range(world))
+        np.testing.assert_allclose(o.asnumpy(),
+                                   np.full(s, expect, np.float32))
+
+    # ---- gradient compression on the dist path with error feedback -----
+    # (≙ tests/nightly/dist_sync_kvstore.py:232-372: each worker quantizes
+    # grad+residual, the wire carries quantized values, the pulled result is
+    # the SUM of the workers' quantized grads; the residual carries the
+    # quantization error into the next round)
+    for ctype, thr in (("2bit", 0.5), ("1bit", 0.2)):
+        kvc = mx.kv.create("dist_sync")
+        kvc.set_gradient_compression({"type": ctype, "threshold": thr})
+        kvc.init(100, mx.np.zeros((6,)))
+        base = np.array([0.26, -0.26, 0.9, -0.9, 0.1, 0.0], np.float32)
+        my = base * (1.0 if rank == 0 else -0.4)
+        # independent model of every worker's residual stream (the
+        # reference test recomputes the server-side expectation the same way)
+        streams = [np.zeros_like(base) for _ in range(world)]
+        for _round in range(3):   # multiple rounds exercise error-feedback
+            out = mx.np.zeros((6,))
+            kvc.push(100, mx.np.array(my))
+            kvc.pull(100, out=out)
+            expect = np.zeros_like(base)
+            for r in range(world):
+                gr = base * (1.0 if r == 0 else -0.4) + streams[r]
+                if ctype == "2bit":
+                    q = np.where(gr >= thr, thr,
+                                 np.where(gr <= -thr, -thr, 0.0)
+                                 ).astype(np.float32)
+                else:
+                    q = np.where(gr >= 0, thr, -thr).astype(np.float32)
+                streams[r] = gr - q
+                expect += q
+            np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5,
+                                       atol=1e-6)
     print(f"rank {rank}/{world}: dist sync semantics OK", flush=True)
 
 

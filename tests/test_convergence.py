@@ -1,0 +1,121 @@
+"""Convergence gate + pretrained-weight story.
+
+≙ the reference's tests/python/train/ (small end-to-end convergence tests
+kept in CI) and model_store pretrained loading. The digit-classification
+dataset is sklearn's bundled load_digits (offline, 1797 8x8 images) — the
+MNIST-MLP convergence criterion (≥97% train accuracy) transfers directly.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn
+
+
+def _digits():
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    X = (d.data / 16.0).astype(np.float32)
+    Y = d.target.astype(np.int32)
+    return X, Y
+
+
+def test_mlp_digits_converges_97():
+    """MLP digit classification to >=97% accuracy through the EAGER tape +
+    Trainer path (also gates bulked-dispatch training correctness)."""
+    X, Y = _digits()
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    xs, ys = mx.np.array(X), mx.np.array(Y)
+    bs = 256
+    rng = np.random.RandomState(0)
+    for epoch in range(60):
+        order = rng.permutation(len(X))
+        for i in range(0, len(X) - bs + 1, bs):
+            idx = order[i:i + bs]
+            xb, yb = mx.np.array(X[idx]), mx.np.array(Y[idx])
+            with mx.autograd.record():
+                L = loss_fn(net(xb), yb).mean()
+            L.backward()
+            trainer.step(bs)
+    pred = net(xs).asnumpy().argmax(1)
+    acc = float((pred == Y).mean())
+    assert acc >= 0.97, f"accuracy {acc:.4f} < 0.97"
+
+
+def test_pretrained_roundtrip_resnet18(tmp_path):
+    """model_zoo pretrained=True loads offline weights and reproduces the
+    source net's logits exactly (eval mode)."""
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    src = vision.resnet18_v1(layout="NHWC")
+    src.initialize()
+    x = mx.np.array(
+        np.random.RandomState(0).randn(1, 32, 32, 3).astype(np.float32))
+    src(x)  # resolve shapes
+    root = tmp_path / "models"
+    os.makedirs(root)
+    src.save_parameters(str(root / "resnet18_v1.npz"))
+
+    net = vision.resnet18_v1(layout="NHWC", pretrained=True, root=str(root))
+    np.testing.assert_allclose(net(x).asnumpy(), src(x).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_params_format_roundtrip(tmp_path):
+    """Reference .params binary format: our writer's output parses back
+    bit-exactly, and the converter produces a loadable npz zoo entry."""
+    from incubator_mxnet_tpu.gluon.model_zoo import model_store as ms
+    arrays = {
+        "features.0.weight": np.random.RandomState(1)
+            .randn(8, 3, 3, 3).astype(np.float32),
+        "features.0.bias": np.zeros((8,), np.float32),
+        "output.weight": np.random.RandomState(2)
+            .randn(10, 8).astype(np.float16),
+        "steps": np.arange(5, dtype=np.int64),
+    }
+    p = str(tmp_path / "m.params")
+    ms.save_params_file(p, arrays)
+    back = ms.load_params_file(p)
+    assert set(back) == set(arrays)
+    for k in arrays:
+        assert back[k].dtype == arrays[k].dtype
+        np.testing.assert_array_equal(back[k], arrays[k])
+
+    npz = ms.convert_params_to_npz(p, str(tmp_path / "m.npz"),
+                                   name_map={"steps": "step_count"})
+    with np.load(npz) as f:
+        assert "step_count" in f.files
+        np.testing.assert_array_equal(f["features.0.bias"],
+                                      arrays["features.0.bias"])
+
+
+def test_pretrained_from_params_file(tmp_path):
+    """pretrained=True also accepts the reference's .params container."""
+    from incubator_mxnet_tpu.gluon.model_zoo import vision, model_store as ms
+    src = vision.alexnet()
+    src.initialize()
+    x = mx.np.array(
+        np.random.RandomState(3).randn(1, 3, 224, 224).astype(np.float32))
+    src(x)
+    arrays = {name: p.data().asnumpy()
+              for name, p in src.collect_params().items()}
+    root = tmp_path / "models"
+    os.makedirs(root)
+    ms.save_params_file(str(root / "alexnet.params"), arrays)
+
+    net = vision.alexnet(pretrained=True, root=str(root))
+    np.testing.assert_allclose(net(x).asnumpy(), src(x).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_missing_pretrained_gives_actionable_error(tmp_path):
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    with pytest.raises(mx.MXNetError, match="convert_model"):
+        vision.resnet18_v1(pretrained=True, root=str(tmp_path / "empty"))

@@ -453,6 +453,33 @@ def test_fused_update_matches_per_param():
                                    atol=1e-7)
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _immediate_updates():
+    """Pin the standalone jitted fused-update path: these tests inspect the
+    optimizer's _jitted cache, which engine op-bulking bypasses (the update
+    then joins the deferred segment instead)."""
+    from incubator_mxnet_tpu import engine
+    prev = engine.set_bulk_size(0)
+    try:
+        yield
+    finally:
+        engine.set_bulk_size(prev)
+
+
+def _with_immediate_updates(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        with _immediate_updates():
+            return fn(*a, **k)
+    return wrapper
+
+
+@_with_immediate_updates
 def test_fused_update_honors_hyperparam_change():
     """Regression: mutating momentum mid-training must affect the fused path
     (hyperparams are part of the jit cache key)."""
@@ -478,6 +505,7 @@ def test_fused_update_honors_hyperparam_change():
     np.testing.assert_allclose(w_fused, w_plain, rtol=1e-6, atol=1e-7)
 
 
+@_with_immediate_updates
 def test_fused_update_lr_schedule_no_retrace():
     """Regression: a per-step lr schedule must reuse ONE fused executable
     (lr is a traced arg, not a cache-key component)."""
@@ -496,6 +524,7 @@ def test_fused_update_lr_schedule_no_retrace():
     assert len(fused_keys) == 1, fused_keys
 
 
+@_with_immediate_updates
 def test_fused_update_rescale_no_retrace_and_correct():
     """Regression: varying batch size must neither retrace the fused update
     nor apply a stale rescale."""
@@ -587,6 +616,7 @@ def test_fused_adam_matches_per_param():
                                    atol=1e-6)
 
 
+@_with_immediate_updates
 def test_fused_adam_single_trace():
     """The fused Adam path must reuse ONE executable across steps (t is a
     traced argument, not a cache-key component)."""

@@ -30,5 +30,6 @@ def test_two_process_dist_sync_via_launcher():
         capture_output=True, text=True, timeout=540, env=env, cwd=REPO)
     assert r.returncode == 0, \
         f"rc={r.returncode}\nstdout={r.stdout[-3000:]}\nstderr={r.stderr[-3000:]}"
-    # each rank prints the exact marker; require it (not any 'ok' substring)
-    assert r.stdout.count("dist sync semantics OK") >= 1, r.stdout[-2000:]
+    # BOTH ranks must print the exact marker — a silent rank-1 failure must
+    # fail the test (VERDICT-r2 Weak #6)
+    assert r.stdout.count("dist sync semantics OK") == 2, r.stdout[-2000:]

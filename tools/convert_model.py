@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Convert a reference-format .params checkpoint into the offline npz zoo.
+
+≙ the role of python/mxnet/gluon/model_zoo/model_store.py's download+cache:
+this build is offline, so checkpoints are converted locally once and then
+`model_zoo.vision.<model>(pretrained=True, root=...)` loads them.
+
+    python tools/convert_model.py resnet18_v1.params ~/.mxnet/models/resnet18_v1.npz
+    python tools/convert_model.py net.params out.npz --rename old=new --rename a=b
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("params_file")
+    ap.add_argument("npz_file")
+    ap.add_argument("--rename", action="append", default=[],
+                    help="old=new parameter renames (repeatable)")
+    args = ap.parse_args()
+    from incubator_mxnet_tpu.gluon.model_zoo.model_store import (
+        convert_params_to_npz)
+    name_map = dict(r.split("=", 1) for r in args.rename)
+    out = convert_params_to_npz(args.params_file, args.npz_file,
+                                name_map or None)
+    import numpy as np
+    with np.load(out) as f:
+        print(f"wrote {out}: {len(f.files)} arrays")
+
+
+if __name__ == "__main__":
+    main()
