@@ -22,6 +22,7 @@ from incubator_mxnet_tpu import gluon
 from incubator_mxnet_tpu.gluon import nn, rnn
 
 START, STOP = "<s>", "</s>"
+FIT_EPOCHS = 60   # epochs at/after which exact-fit is asserted
 
 
 class BiLSTMCRF(gluon.HybridBlock):
@@ -34,7 +35,7 @@ class BiLSTMCRF(gluon.HybridBlock):
         self.hidden2tag = nn.Dense(self.n_tags, flatten=False)
         # transitions[i, j]: score of j -> i
         self.transitions = gluon.Parameter(
-            "transitions", shape=(self.n_tags, self.n_tags))
+            shape=(self.n_tags, self.n_tags), name="transitions")
         self.transitions.initialize(mx.initializer.Uniform(0.1))
 
     def emissions(self, sentence):
@@ -117,7 +118,7 @@ class BiLSTMCRF(gluon.HybridBlock):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=60)
+    ap.add_argument("--epochs", type=int, default=FIT_EPOCHS)
     args = ap.parse_args()
 
     training_data = [
@@ -134,8 +135,8 @@ def main():
 
     model = BiLSTMCRF(len(word2idx), tag2idx)
     model.initialize()
-    trainer = gluon.Trainer(model.collect_params(), "sgd",
-                            {"learning_rate": 0.01, "wd": 1e-4})
+    trainer = gluon.Trainer(model.collect_params(), "adam",
+                            {"learning_rate": 0.02})
 
     data = [(mx.np.array([word2idx[w] for w in s], dtype="int32"),
              mx.np.array([tag2idx[t] for t in ts], dtype="int32"))
@@ -151,11 +152,14 @@ def main():
         if epoch % 10 == 0:
             print(f"epoch {epoch}: nll={total:.3f}")
 
+    ok = True
     for sent, tags in data:
         pred = model.viterbi(sent).asnumpy().tolist()
         print("pred:", pred, "gold:", tags.asnumpy().tolist())
-        assert pred == tags.asnumpy().tolist(), "tagger failed to fit"
-    print("lstm_crf done")
+        ok = ok and pred == tags.asnumpy().tolist()
+    if args.epochs >= FIT_EPOCHS:
+        assert ok, "tagger failed to fit"
+    print("lstm_crf done", "(fit)" if ok else "(not converged yet)")
 
 
 if __name__ == "__main__":
