@@ -42,7 +42,14 @@ _DTYPE_OF_FLAG = {0: _np.float32, 1: _np.float64, 2: _np.float16,
                   3: _np.uint8, 4: _np.int32, 5: _np.int8, 6: _np.int64,
                   7: _np.bool_, 8: _np.int16, 9: _np.uint16,
                   10: _np.uint32, 11: _np.uint64}
+_BF16_FLAG = 12   # mshadow kBfloat16: stored as raw uint16, widened to f32
 _FLAG_OF_DTYPE = {_np.dtype(v): k for k, v in _DTYPE_OF_FLAG.items()}
+
+
+def _widen_bf16(raw_u16):
+    # numpy has no native bf16: place the 16 payload bits in the high half
+    # of a float32 word (bf16 is f32 truncated to its top 16 bits)
+    return (raw_u16.astype(_np.uint32) << 16).view(_np.float32)
 
 
 def default_root():
@@ -76,7 +83,7 @@ class _Reader:
         self.i = 0
 
     def take(self, n):
-        if self.i + n > len(self.d):
+        if n < 0 or self.i + n > len(self.d):
             raise MXNetError("truncated .params file")
         out = self.d[self.i:self.i + n]
         self.i += n
@@ -101,6 +108,9 @@ def _read_one_ndarray(r):
                 "sparse arrays in .params are unsupported (dense-only TPU "
                 "build; cast_storage the checkpoint first)")
         ndim = r.i32()
+        if ndim == -1:
+            # V3 "none" (uninitialized) record: nothing else follows
+            return _np.zeros((), _np.float32)
         shape = struct.unpack(f"<{ndim}q", r.take(8 * ndim))
     elif magic == _V1_MAGIC:
         ndim = r.i32()
@@ -111,15 +121,21 @@ def _read_one_ndarray(r):
         if ndim > 32:
             raise MXNetError(f"corrupt .params (ndim={ndim})")
         shape = struct.unpack(f"<{ndim}I", r.take(4 * ndim))
-    if len(shape) == 0:
+    if len(shape) == 0 and magic != _V3_MAGIC:
+        # legacy/V2 ndim==0 encodes "none": no ctx/dtype/data follow.
+        # V3 (np-shape semantics) saves real 0-d scalars WITH ctx, dtype,
+        # and one element — falling through keeps the stream in sync.
         return _np.zeros((), _np.float32)
     r.i32()   # ctx dev_type
     r.i32()   # ctx dev_id
     flag = r.i32()
+    n = int(_np.prod(shape)) if shape else 1
+    if flag == _BF16_FLAG:
+        raw = _np.frombuffer(r.take(n * 2), dtype=_np.uint16)
+        return _widen_bf16(raw).reshape(shape).copy()
     if flag not in _DTYPE_OF_FLAG:
         raise MXNetError(f"unsupported dtype flag {flag} in .params")
     dt = _np.dtype(_DTYPE_OF_FLAG[flag])
-    n = int(_np.prod(shape))
     arr = _np.frombuffer(r.take(n * dt.itemsize), dtype=dt).reshape(shape)
     return arr.copy()
 
@@ -153,10 +169,15 @@ def save_params_file(path, params):
     items = list(params.items())
     out += struct.pack("<Q", len(items))
     for _, arr in items:
-        arr = _np.ascontiguousarray(arr)
+        # asarray, not ascontiguousarray: the latter promotes 0-d to (1,)
+        arr = _np.asarray(arr)
+        if not arr.flags["C_CONTIGUOUS"]:
+            arr = _np.copy(arr, order="C")
         if arr.dtype not in _FLAG_OF_DTYPE:
             arr = arr.astype(_np.float32)
-        out += struct.pack("<I", _V2_MAGIC)
+        # 0-d records need V3 (np-shape semantics): under V2 a zero ndim
+        # encodes "none" and carries no data, so scalars wouldn't round-trip
+        out += struct.pack("<I", _V3_MAGIC if arr.ndim == 0 else _V2_MAGIC)
         out += struct.pack("<i", 0)                      # default storage
         out += struct.pack("<i", arr.ndim)
         out += struct.pack(f"<{arr.ndim}q", *arr.shape)

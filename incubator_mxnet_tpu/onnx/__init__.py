@@ -87,7 +87,7 @@ class _Translator:
         "max": "Max", "min": "Min", "pow": "Pow", "neg": "Neg",
         "exp": "Exp", "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
         "sqrt": "Sqrt", "abs": "Abs", "sign": "Sign", "floor": "Floor",
-        "ceil": "Ceil", "erf": "Erf", "is_finite": "IsInf",
+        "ceil": "Ceil", "erf": "Erf",
         "stop_gradient": "Identity", "copy": "Identity",
         "gt": "Greater", "lt": "Less", "ge": "GreaterOrEqual",
         "le": "LessOrEqual", "eq": "Equal",
@@ -99,6 +99,21 @@ class _Translator:
         params = eqn.params
         if p in self._SIMPLE:
             g.add(self._SIMPLE[p], ins, outs)
+        elif p == "is_finite":
+            # IsInf alone has inverted semantics and misses NaN:
+            # finite(x) == Not(Or(IsInf(x), IsNaN(x)))
+            src = ins[0]
+            if _aval_of(eqn.invars[0])[1] == _np.dtype(_np.float16):
+                # opset-13 IsInf only accepts f32/f64; widening is exact
+                cast = g.fresh()
+                g.add("Cast", [src], [cast],
+                      to=int(P.DT[_np.dtype(_np.float32)]))
+                src = cast
+            inf, nan, either = g.fresh(), g.fresh(), g.fresh()
+            g.add("IsInf", [src], [inf])
+            g.add("IsNaN", [src], [nan])
+            g.add("Or", [inf, nan], [either])
+            g.add("Not", [either], outs)
         elif p == "rsqrt":
             t = g.fresh()
             g.add("Sqrt", ins, [t])

@@ -340,6 +340,16 @@ def run(path_or_graph, inputs):
             o = i[0] <= i[1]
         elif op == "Equal":
             o = i[0] == i[1]
+        elif op == "IsInf":
+            o = _np.isinf(i[0])
+        elif op == "IsNaN":
+            o = _np.isnan(i[0])
+        elif op == "Or":
+            o = _np.logical_or(i[0], i[1])
+        elif op == "And":
+            o = _np.logical_and(i[0], i[1])
+        elif op == "Not":
+            o = _np.logical_not(i[0])
         else:
             raise MXNetError(f"evaluator: unsupported op {op}")
         for out_name in nd.outputs:

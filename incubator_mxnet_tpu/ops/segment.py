@@ -459,8 +459,13 @@ def _enqueue_locked(seg, fn, raw, key, name):
                 weak = bool(getattr(a, "weak_type", False))
                 handles.append(("c", slot))
                 desc.append(("c", slot, sh, str(dt), weak))
-                eval_args.append(a if a.ndim == 0 else
-                                 jax.ShapeDtypeStruct(sh, dt))
+                # abstract for every rank — a concrete 0-d arg would let
+                # value-dependent-shape ops cache shapes keyed only by aval,
+                # so a later call with a different scalar value would read
+                # stale shapes; such ops now fail eval_shape and fall back
+                # to immediate execution instead
+                eval_args.append(jax.ShapeDtypeStruct(sh, dt,
+                                                      weak_type=weak))
                 akeys.append(("a", sh, str(dt), weak))
             elif isinstance(a, _np.ndarray):
                 if a.dtype == jax.dtypes.float0:

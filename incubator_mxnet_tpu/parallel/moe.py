@@ -42,7 +42,10 @@ def moe_dispatch(x, gate_logits, expert_fn, axis_name="ep", capacity=None,
     E = jax.lax.axis_size(axis_name)
     assert gate_logits.shape[-1] == E, "one expert per ep rank"
     if capacity is None:
-        capacity = max(2 * T // E, 1)
+        # capacity scales with top_k (GShard): K*T assignments share the
+        # per-expert slots, so a K-independent default would drop roughly
+        # half the second choices even on perfectly balanced traffic
+        capacity = max(int(top_k) * 2 * T // E, 1)
     C = capacity
 
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)

@@ -119,3 +119,47 @@ def test_missing_pretrained_gives_actionable_error(tmp_path):
     from incubator_mxnet_tpu.gluon.model_zoo import vision
     with pytest.raises(mx.MXNetError, match="convert_model"):
         vision.resnet18_v1(pretrained=True, root=str(tmp_path / "empty"))
+
+
+def test_params_bf16_and_v3_scalar_records(tmp_path):
+    """bf16 (type_flag 12) payloads widen to f32, and a V3 (np-semantics)
+    0-d record carries ctx/dtype/data — the stream must stay in sync so
+    the FOLLOWING array parses correctly."""
+    import struct
+    from incubator_mxnet_tpu.gluon.model_zoo import model_store as ms
+
+    f32 = np.array([1.5, -2.25, 3.0, 0.5], np.float32)
+    bf16_u16 = (f32.view(np.uint32) >> 16).astype(np.uint16)  # exact in bf16
+    after = np.arange(6, dtype=np.float32).reshape(2, 3)
+
+    out = bytearray()
+    out += struct.pack("<QQ", 0x112, 0)
+    out += struct.pack("<Q", 3)
+    # record 1: V2, bf16 flag 12
+    out += struct.pack("<Ii", 0xF993FAC9, 0)
+    out += struct.pack("<i", 1) + struct.pack("<q", 4)
+    out += struct.pack("<ii", 1, 0) + struct.pack("<i", 12)
+    out += bf16_u16.tobytes()
+    # record 2: V3, ndim==0 scalar WITH ctx/dtype/one f32 element
+    out += struct.pack("<Ii", 0xF993FACA, 0)
+    out += struct.pack("<i", 0)
+    out += struct.pack("<ii", 1, 0) + struct.pack("<i", 0)
+    out += struct.pack("<f", 7.25)
+    # record 3: ordinary V2 f32 (2,3) — corrupted if record 2 desyncs
+    out += struct.pack("<Ii", 0xF993FAC9, 0)
+    out += struct.pack("<i", 2) + struct.pack("<qq", 2, 3)
+    out += struct.pack("<ii", 1, 0) + struct.pack("<i", 0)
+    out += after.tobytes()
+    out += struct.pack("<Q", 3)
+    for nm in ("bf", "scalar", "after"):
+        b = nm.encode()
+        out += struct.pack("<Q", len(b)) + b
+    p = str(tmp_path / "mixed.params")
+    with open(p, "wb") as f:
+        f.write(bytes(out))
+
+    back = ms.load_params_file(p)
+    np.testing.assert_array_equal(back["bf"], f32)
+    assert back["scalar"].shape == ()
+    assert back["scalar"] == np.float32(7.25)
+    np.testing.assert_array_equal(back["after"], after)

@@ -95,3 +95,16 @@ def test_unsupported_primitive_raises(tmp_path):
     with pytest.raises(mx.MXNetError, match="no ONNX translation"):
         mxonnx.export_model(weird, np.ones((4,), np.float32),
                             str(tmp_path / "x.onnx"))
+
+
+def test_export_isfinite_semantics(tmp_path):
+    """is_finite must be false for ±inf AND NaN (a bare IsInf inverts it)."""
+    def fn(x):
+        import jax.numpy as jnp
+        return jnp.isfinite(x).astype(jnp.float32)
+
+    x = np.array([1.0, np.inf, -np.inf, np.nan, 0.0], np.float32)
+    path = str(tmp_path / "fin.onnx")
+    mxonnx.export_model(fn, x, path)
+    got = _runtime.run(path, {"data": x})
+    np.testing.assert_array_equal(got, [1.0, 0.0, 0.0, 0.0, 1.0])
