@@ -80,6 +80,16 @@ class KVStore(KVStoreBase):
         self._optimizer = None
         self._opt_states = {}
         self._compression = None
+        # wire accounting for the compressed dist push path: bytes this
+        # process actually sent per key on its last push (packed payload)
+        self.wire_bytes_last_push = {}
+        self._wire_bytes_total = 0
+
+    @property
+    def wire_bytes_total(self):
+        """Total compressed payload bytes this process has pushed (dist
+        compressed path only; 0 otherwise)."""
+        return self._wire_bytes_total
 
     def set_gradient_compression(self, compression_params):
         """≙ KVStore::SetGradientCompression (gradient_compression.cc)."""
@@ -248,23 +258,57 @@ class KVStore(KVStoreBase):
 
     def push(self, key, value, priority=0):
         keys, values = _pairs(key, value)
+        dist = self._dist_active()
+        if self._compression is not None and dist:
+            # ≙ the reference's dist compressed push
+            # (src/kvstore/kvstore_dist.h:262-382 + gradient_compression.cc):
+            # the LOCALLY-REDUCED gradient is quantized with error-feedback,
+            # bit-packed into uint32 words, and the PACKED words are what
+            # cross the wire (process allgather); every process then unpacks
+            # all workers' payloads and sums — the server-side reconstruction.
+            import jax.numpy as jnp
+            from jax.experimental import multihost_utils
+            local_aggs, payloads = [], []
+            for k, v in zip(keys, values):
+                agg = _aggregate(v)
+                local_aggs.append(agg)
+                packed = self._compression.compress_packed(k, agg)
+                nbytes = int(packed.size) * 4
+                self.wire_bytes_last_push[k] = nbytes
+                self._wire_bytes_total += nbytes
+                payloads.append(packed)
+            # ONE gather for all keys (≙ the bucketed key batching of
+            # kvstore_dist.h): packed words concatenate into a single
+            # uint32 wire message instead of a per-key rendezvous
+            flat = (payloads[0] if len(payloads) == 1
+                    else jnp.concatenate(payloads))
+            gathered = multihost_utils.process_allgather(flat)  # (P, W)
+            aggs, off = [], 0
+            for k, agg, packed in zip(keys, local_aggs, payloads):
+                w = int(packed.size)
+                aggs.append(self._compression.decompress_sum(
+                    gathered[:, off:off + w], agg.shape, agg.dtype))
+                off += w
+            self._finish_push(keys, values, aggs)
+            return
         aggs = []
         for k, v in zip(keys, values):
             if self._compression is not None:
-                # compression happens BEFORE the wire (≙ gradient_compression
-                # on the dist push path, src/kvstore/kvstore_dist.h:262-382):
-                # each worker quantizes with error-feedback, the collective
-                # sums the quantized values
+                # local stores: same quantize-with-error-feedback semantics,
+                # applied per pushed value (no wire to pack for)
                 vs = v if isinstance(v, (list, tuple)) else [v]
                 v = [self._compression.compress((k, i), g)
                      for i, g in enumerate(vs)]
             aggs.append(_aggregate(v))
-        if self._dist_active():
+        if dist:
             # ≙ dist_sync: the server's sum over workers, as ONE fused
             # bucketed collective set over all pushed keys. Every process
             # contributes its local aggregate and receives the global sum,
             # so updater/optimizer runs identically everywhere.
             aggs = self._cross_process_sum_many(aggs)
+        self._finish_push(keys, values, aggs)
+
+    def _finish_push(self, keys, values, aggs):
         for k, v, agg in zip(keys, values, aggs):
             if self._updater is not None:
                 if k not in self._store:

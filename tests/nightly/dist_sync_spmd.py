@@ -103,6 +103,22 @@ def main():
                 expect += q
             np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5,
                                        atol=1e-6)
+        # the wire really is packed: a 6-value key is ONE uint32 word
+        assert kvc.wire_bytes_last_push[100] == 4, \
+            kvc.wire_bytes_last_push
+
+    # ---- wire-size accounting on a big key (the point of compression) ---
+    # 2bit packs 16 values/word: 4096 f32 (16384 B uncompressed) must cross
+    # as exactly ceil(4096/16)*4 = 1024 B (16x reduction; ≙ the word packing
+    # in src/kvstore/gradient_compression.cc)
+    kvw = mx.kv.create("dist_sync")
+    kvw.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kvw.init(7, mx.np.zeros((4096,)))
+    kvw.push(7, mx.np.array(np.linspace(-1, 1, 4096, dtype=np.float32)))
+    assert kvw.wire_bytes_last_push[7] == 1024, kvw.wire_bytes_last_push
+    big = mx.np.zeros((4096,))
+    kvw.pull(7, out=big)
+    assert np.isfinite(big.asnumpy()).all()
     print(f"rank {rank}/{world}: dist sync semantics OK", flush=True)
 
 

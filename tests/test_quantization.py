@@ -487,3 +487,60 @@ def test_fold_bn_negative_axis_normalized():
     with mx.autograd.predict_mode():
         after = net(x).asnumpy()
     np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-4)
+
+
+def test_gradient_compression_packed_wire():
+    """The wire payload is bit-packed uint32 words (≙ the reference's
+    gradient_compression.cc word packing): 16 values/word at 2 bits,
+    32 values/word at 1 bit; unpack+sum reconstructs the quantized sum."""
+    import math
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.kvstore.gradient_compression import \
+        GradientCompression
+
+    rng = np.random.RandomState(7)
+    for ctype, thr, vpw in (("2bit", 0.5, 16), ("1bit", 0.25, 32)):
+        n = 1000
+        grads = [rng.randn(n).astype(np.float32) for _ in range(3)]
+        workers = [GradientCompression(ctype, threshold=thr)
+                   for _ in range(3)]
+        payloads = [w.compress_packed("k", mx.np.array(g))
+                    for w, g in zip(workers, grads)]
+        # payload size: the whole point — ceil(n/vpw) words, not n floats
+        for p in payloads:
+            assert str(p.dtype) == "uint32"
+            assert p.size == math.ceil(n / vpw)
+            assert p.size * 4 * (vpw // 4) <= n * 4  # ≥(vpw/4)x smaller
+        stack = np.stack([np.asarray(p) for p in payloads])
+        got = workers[0].decompress_sum(stack, (n,)).asnumpy()
+        # reference semantics: sum of each worker's quantized grad
+        expect = np.zeros(n, np.float32)
+        for g in grads:
+            if ctype == "2bit":
+                expect += np.where(g >= thr, thr,
+                                   np.where(g <= -thr, -thr, 0.0))
+            else:
+                expect += np.where(g >= 0, thr, -thr)
+        np.testing.assert_allclose(got, expect, rtol=1e-6, atol=1e-6)
+        # error feedback: residual carries the quantization error
+        r0 = workers[0]._residuals["k"].asnumpy()
+        q0 = (np.where(grads[0] >= thr, thr,
+                       np.where(grads[0] <= -thr, -thr, 0.0))
+              if ctype == "2bit" else
+              np.where(grads[0] >= 0, thr, -thr))
+        np.testing.assert_allclose(r0, grads[0] - q0, rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_compression_mixed_paths():
+    """compress() after compress_packed() on one instance (the jit caches
+    for the two paths share a dict and must not shadow each other)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.kvstore.gradient_compression import \
+        GradientCompression
+    gc = GradientCompression("2bit", threshold=0.5)
+    g = mx.np.array(np.array([0.7, -0.7, 0.1], np.float32))
+    gc.compress_packed("a", g)
+    out = gc.compress("b", g)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0])
