@@ -144,8 +144,33 @@ def load_imagerec():
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int]
         lib.ir_version.restype = ctypes.c_char_p
+        lib.ir_stage_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)] * 3
+        lib.ir_stage_reset.argtypes = []
         _LIB["imagerec"] = lib
         return lib
+
+
+def imagerec_stage_stats(reset=False):
+    """Per-stage accumulated wall nanoseconds of the native image pipeline
+    since the last reset: {'decode_ns', 'augment_ns', 'records'}. The
+    measured basis for the IO decode-bound analysis (VERDICT-r3 Weak #2)."""
+    lib = load_imagerec()
+    if lib is None:
+        return None
+    d = ctypes.c_int64()
+    a = ctypes.c_int64()
+    r = ctypes.c_int64()
+    lib.ir_stage_stats(ctypes.byref(d), ctypes.byref(a), ctypes.byref(r))
+    out = {"decode_ns": d.value, "augment_ns": a.value, "records": r.value}
+    if reset:
+        lib.ir_stage_reset()
+    return out
+
+
+def imagerec_stage_reset():
+    lib = load_imagerec()
+    if lib is not None:
+        lib.ir_stage_reset()
 
 
 class NativeImageRecordFile:

@@ -270,12 +270,14 @@ def batch_norm(x, gamma, beta, running_mean, running_var, momentum=0.9,
         new_rm, new_rv = running_mean, running_var
     inv = lax.rsqrt(var.astype(jnp.float32) + eps)
     out = (x.astype(jnp.float32) - mean.reshape(bshape)) * inv.reshape(bshape)
-    out = out.astype(x.dtype)
+    # scale/shift IN f32, one cast at the end: casting first would promote
+    # back to f32 against the f32 gamma/beta, making every BN output f32
+    # under AMP and doubling activation HBM traffic (bandwidth-bound nets)
     if gamma is not None:
-        out = out * gamma.reshape(bshape)
+        out = out * gamma.reshape(bshape).astype(jnp.float32)
     if beta is not None:
-        out = out + beta.reshape(bshape)
-    return out, new_rm, new_rv
+        out = out + beta.reshape(bshape).astype(jnp.float32)
+    return out.astype(x.dtype), new_rm, new_rv
 
 
 def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
@@ -286,12 +288,12 @@ def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
     mean = jnp.mean(xf, axis=axis, keepdims=True)
     var = jnp.var(xf, axis=axis, keepdims=True)
     out = (xf - mean) * lax.rsqrt(var + eps)
-    out = out.astype(x.dtype)
     if gamma is not None:
         bshape = [1] * x.ndim
         bshape[axis % x.ndim] = x.shape[axis % x.ndim]
-        out = out * gamma.reshape(bshape) + beta.reshape(bshape)
-    return out
+        out = (out * gamma.reshape(bshape).astype(jnp.float32)
+               + beta.reshape(bshape).astype(jnp.float32))
+    return out.astype(x.dtype)
 
 
 def group_norm(x, gamma, beta, num_groups, eps=1e-5):
@@ -304,11 +306,12 @@ def group_norm(x, gamma, beta, num_groups, eps=1e-5):
     axes = tuple(range(2, xg.ndim))
     mean = jnp.mean(xg, axis=axes, keepdims=True)
     var = jnp.var(xg, axis=axes, keepdims=True)
-    out = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape).astype(x.dtype)
+    out = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
     if gamma is not None:
         bshape = (1, c) + (1,) * len(rest)
-        out = out * gamma.reshape(bshape) + beta.reshape(bshape)
-    return out
+        out = (out * gamma.reshape(bshape).astype(jnp.float32)
+               + beta.reshape(bshape).astype(jnp.float32))
+    return out.astype(x.dtype)
 
 
 def instance_norm(x, gamma, beta, eps=1e-5):
@@ -319,9 +322,11 @@ def instance_norm(x, gamma, beta, eps=1e-5):
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axes, keepdims=True)
     var = jnp.var(xf, axis=axes, keepdims=True)
-    out = ((xf - mean) * lax.rsqrt(var + eps)).astype(x.dtype)
+    out = (xf - mean) * lax.rsqrt(var + eps)
     bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
-    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+    out = (out * gamma.reshape(bshape).astype(jnp.float32)
+           + beta.reshape(bshape).astype(jnp.float32))
+    return out.astype(x.dtype)
 
 
 def l2_normalize(x, axis=-1, eps=1e-10):
@@ -336,8 +341,10 @@ def rms_norm(x, gamma, axis=-1, eps=1e-6):
     lax = _jx().lax
     xf = x.astype(jnp.float32)
     ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
-    out = (xf * lax.rsqrt(ms + eps)).astype(x.dtype)
-    return out * gamma if gamma is not None else out
+    out = xf * lax.rsqrt(ms + eps)
+    if gamma is not None:
+        out = out * gamma.astype(jnp.float32)
+    return out.astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------
