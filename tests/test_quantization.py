@@ -220,7 +220,9 @@ def test_requantize_uses_calibrated_range():
 def _quant(xn):
     from incubator_mxnet_tpu.contrib import quantization as q
     qx, mn, mx_ = q.quantize_v2(mx.np.array(xn))
-    return q, qx, mn, mx_
+    # auto-calibrated ranges come back as 0-d NDArrays (device-computed,
+    # no host sync in the op path); tests want Python floats
+    return q, qx, float(mn), float(mx_)
 
 
 def test_quantized_act_relu():
@@ -544,3 +546,25 @@ def test_gradient_compression_mixed_paths():
     gc.compress_packed("a", g)
     out = gc.compress("b", g)
     np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0])
+
+
+def test_quantize_v2_auto_is_segment_safe():
+    """Auto-calibration must not host-sync inside the op path: a chain of
+    auto quantize_v2 calls stays DEFERRED in the bulking segment until the
+    caller actually reads a value (VERDICT-r3 Weak #4)."""
+    from incubator_mxnet_tpu.contrib import quantization as q
+    from incubator_mxnet_tpu.ops import segment
+
+    xs = [mx.np.array(np.random.RandomState(i).randn(8).astype(np.float32))
+          for i in range(4)]
+    with mx.engine.bulk(32):
+        outs = [q.quantize_v2(x) for x in xs]
+        seg = segment._current(create=False)
+        # all 4 quantize ops (and their range outputs) still enqueued
+        assert seg is not None and seg.ops is not None and len(seg.ops) >= 4
+    for x, (qd, mn, mxr) in zip(xs, outs):
+        amax = max(abs(x.asnumpy()).max(), 1e-12)
+        np.testing.assert_allclose(float(mxr), amax, rtol=1e-6)
+        np.testing.assert_allclose(
+            qd.asnumpy(),
+            np.clip(np.round(x.asnumpy() * 127.0 / amax), -127, 127))
