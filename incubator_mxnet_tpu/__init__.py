@@ -38,17 +38,23 @@ from . import ops
 _LAZY = ("gluon", "optimizer", "kvstore", "parallel", "amp", "profiler",
          "initializer", "lr_scheduler", "metric", "test_utils", "util",
          "runtime", "io", "image", "engine", "context", "recordio",
-         "checkpoint", "visualization", "models", "native", "deploy")
+         "checkpoint", "visualization", "models", "native", "deploy",
+         "symbol", "onnx", "contrib", "operator", "library")
 
 
 def __getattr__(name):
     if name == "kv":   # reference alias: mx.kv is mx.kvstore
         name = "kvstore"
+    if name == "sym":  # reference alias: mx.sym is mx.symbol
+        name = "symbol"
     if name in _LAZY:
         import importlib
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
-        globals().setdefault("kv" if name == "kvstore" else name, mod)
+        if name == "kvstore":
+            globals().setdefault("kv", mod)
+        if name == "symbol":
+            globals().setdefault("sym", mod)
         return mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 

@@ -1,0 +1,98 @@
+"""LegacySymbolBlock — run a parsed reference `*-symbol.json` graph as a
+HybridBlock (≙ gluon.SymbolBlock over an nnvm symbol, block.py:1638 +
+symbol loading in legacy_json_util.cc:226).
+
+The symbol executor (`Symbol.bind_fn`) is a pure jax function, so the block
+hybridizes/jits like any native net; parameters come from a reference
+`.params` checkpoint (arg:/aux: prefixes) or are freshly initialized from
+`infer_shape`.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+
+def build_legacy_block(symbol_file, input_names=None, param_file=None):
+    from .. import symbol as sym_mod
+    from ..ndarray import NDArray, _wrap, array
+    from .block import HybridBlock
+    from .parameter import Parameter
+
+    s = sym_mod.load(symbol_file)
+    input_names = list(input_names or ["data"])
+    args = s.list_arguments()
+    aux = s.list_auxiliary_states()
+    param_names = [a for a in args if a not in input_names]
+    label_like = {n for n in param_names
+                  if n.endswith("_label") or n.endswith("label")}
+    param_names = [n for n in param_names if n not in label_like]
+
+    loaded = {}
+    if param_file:
+        from .model_zoo.model_store import load_params_file
+        loaded = load_params_file(param_file)
+
+    class LegacySymbolBlock(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self._symbol = s
+            self._run = s.bind_fn()
+            self._input_names = input_names
+            self._n_outputs = s.num_outputs
+            for nm in param_names + aux:
+                grad_req = "write" if nm in param_names else "null"
+                p = Parameter(name=nm, grad_req=grad_req,
+                              allow_deferred_init=True)
+                self._reg_params[nm] = p
+
+        def infer_and_initialize(self, **input_shapes):
+            """Resolve every parameter shape from the graph and initialize
+            (loaded checkpoint values win; the rest Xavier-ish random)."""
+            arg_shapes, _, aux_shapes = self._symbol.infer_shape(
+                **input_shapes)
+            shp = dict(zip(args, arg_shapes))
+            shp.update(dict(zip(aux, aux_shapes)))
+            rng = _np.random.RandomState(0)
+            for nm, p in self._reg_params.items():
+                if nm in loaded:
+                    val = loaded[nm]
+                elif shp.get(nm) is not None:
+                    shape = shp[nm]
+                    fan = max(int(_np.prod(shape[1:])), 1)
+                    scale = float(_np.sqrt(2.0 / fan))
+                    if nm in aux and ("var" in nm):
+                        val = _np.ones(shape, _np.float32)
+                    elif nm in aux or nm.endswith("_bias") \
+                            or nm.endswith("_beta"):
+                        val = _np.zeros(shape, _np.float32)
+                    elif nm.endswith("_gamma"):
+                        val = _np.ones(shape, _np.float32)
+                    else:
+                        val = (rng.randn(*shape) * scale).astype(_np.float32)
+                else:
+                    raise MXNetError(
+                        f"cannot infer shape for parameter {nm!r}; pass "
+                        "input shapes covering it")
+                p.set_data(array(val))
+            return self
+
+        def forward(self, *inputs):
+            vals = {}
+            for nm, x in zip(self._input_names, inputs):
+                vals[nm] = x._arr if isinstance(x, NDArray) else x
+            for nm, p in self._reg_params.items():
+                vals[nm] = p.data()._arr
+            outs = [_wrap(o) for o in self._run(vals)]
+            return outs[0] if self._n_outputs == 1 else tuple(outs)
+
+    block = LegacySymbolBlock()
+    if loaded:
+        # shapes are known from the checkpoint: initialize immediately,
+        # missing entries resolved on the first infer_and_initialize
+        ready = all(nm in loaded for nm in list(param_names) + list(aux))
+        if ready:
+            for nm, p in block._reg_params.items():
+                p.set_data(array(loaded[nm]))
+    return block

@@ -669,11 +669,18 @@ class SymbolBlock(HybridBlock):
 
     @staticmethod
     def imports(symbol_file, input_names=None, param_file=None, device=None):
-        """Load an export artifact. `symbol_file` is the export prefix
-        (`net-0000`) or the `.jaxport` path; `param_file`/manifest default
-        to the sibling artifact names. `input_names` is accepted for
-        reference-signature compatibility and unused (the manifest records
-        the input signature)."""
+        """Load a saved model artifact.
+
+        Two formats are accepted (≙ gluon.SymbolBlock.imports):
+        - a reference `*-symbol.json` legacy graph (+ `.params` checkpoint):
+          parsed by mx.symbol and executed as a pure jax function
+          (gluon/_legacy_symbol_block.py);
+        - this framework's own export triple (`net-0000` prefix or the
+          `.jaxport` path): served by deploy.ExportedModel.
+        """
+        if symbol_file.endswith(".json"):
+            from ._legacy_symbol_block import build_legacy_block
+            return build_legacy_block(symbol_file, input_names, param_file)
         from ..deploy import ExportedModel
         if symbol_file.endswith(".jaxport"):
             prefix = symbol_file[:-len(".jaxport")]

@@ -1,0 +1,834 @@
+"""mx.symbol — the reference's symbolic graph API + serialized-JSON parity.
+
+Reference equivalents: python/mxnet/symbol/symbol.py (the Symbol class and
+its composition/attr/serialization surface) and src/nnvm/legacy_json_util.cc
+:226 (the `symbol.json` wire format: nodes / arg_nodes / node_row_ptr /
+heads / attrs, with every attr value stringified).
+
+TPU-native redesign: the reference Symbol is a handle into the nnvm C++
+graph; here the graph is a tiny immutable Python DAG whose EXECUTION is a
+pure jax-traceable function (`Symbol.bind` → callable), so a legacy graph
+jits/grads/shards like any other code path — there is no separate graph
+executor, XLA is the executor. Op semantics come from the same ops/ library
+the imperative path uses (NCHW, the reference artifact layout).
+
+Why it exists at all (the rest of this framework is imperative-first):
+compatibility with serialized reference artifacts — `mx.sym.load` /
+`SymbolBlock.imports` of real model-zoo `*-symbol.json` files, and the
+MXSymbol* C ABI group.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+
+from ..base import MXNetError
+
+__all__ = ["Symbol", "Variable", "var", "load", "load_json", "Group",
+           "register_legacy_op", "list_legacy_ops"]
+
+_MXNET_VERSION = 10700   # emitted in attrs: latest 1.x format
+
+
+def _parse_attr(v, default=None):
+    """Reference attrs are ALL strings ('(3, 3)', 'True', '64')."""
+    if v is None:
+        return default
+    if not isinstance(v, str):
+        return v
+    s = v.strip()
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        low = s.lower()
+        if low in ("true", "false"):
+            return low == "true"
+        return s
+
+
+def _fmt_attr(v):
+    if isinstance(v, bool):
+        return "True" if v else "False"
+    if isinstance(v, (tuple, list)):
+        return "(" + ", ".join(str(int(x)) for x in v) + ")"
+    return str(v)
+
+
+def _tuple2(v, default):
+    v = _parse_attr(v, default)
+    if isinstance(v, (int, float)):
+        return (int(v), int(v))
+    return tuple(int(x) for x in v)
+
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "inputs")
+
+    def __init__(self, op, name, attrs=None, inputs=()):
+        self.op = op
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.inputs = list(inputs)   # [(node, out_idx)]
+
+
+# ---------------------------------------------------------------------------
+# legacy op registry: semantics + shape inference for serialized graphs
+# ---------------------------------------------------------------------------
+class _OpSpec:
+    def __init__(self, name, fn, num_inputs=1, param_slots=(), aux_slots=(),
+                 shape_fn=None, variadic=False):
+        self.name = name
+        self.fn = fn                  # fn(attrs, *input_arrays) -> array(s)
+        self.num_inputs = num_inputs  # data inputs BEFORE param slots
+        self.param_slots = tuple(param_slots)  # learnable arg suffixes
+        self.aux_slots = tuple(aux_slots)      # auxiliary state suffixes
+        self.shape_fn = shape_fn      # (attrs, in_shapes)->(in_shapes, outs)
+        self.variadic = variadic
+
+
+_LEGACY_OPS = {}
+
+
+def register_legacy_op(name, **kw):
+    """Register semantics for a serialized-graph op (extensible: custom
+    frontends add their own, ≙ nnvm op registration)."""
+    def deco(fn):
+        _LEGACY_OPS[name] = _OpSpec(name, fn, **kw)
+        return fn
+    return deco
+
+
+def list_legacy_ops():
+    return sorted(_LEGACY_OPS)
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# -- shape helpers ----------------------------------------------------------
+def _conv_out(n, k, s, p, d=1):
+    eff = (k - 1) * d + 1
+    return (n + 2 * p - eff) // s + 1
+
+
+def _pool_out(n, k, s, p, ceil):
+    if ceil:
+        return -((-(n + 2 * p - k)) // s) + 1
+    return (n + 2 * p - k) // s + 1
+
+
+# -- op implementations (NCHW; semantics from ops/, not translated) ---------
+@register_legacy_op(
+    "Convolution", num_inputs=1, param_slots=("weight", "bias"),
+    shape_fn=lambda a, ins: _conv_shapes(a, ins))
+def _op_conv(attrs, x, weight, bias=None):
+    from ..ops import nn as N
+    stride = _tuple2(attrs.get("stride"), (1, 1))
+    pad = _tuple2(attrs.get("pad"), (0, 0))
+    dilate = _tuple2(attrs.get("dilate"), (1, 1))
+    groups = int(_parse_attr(attrs.get("num_group"), 1))
+    return N.conv(x, weight, bias, stride=stride, padding=pad,
+                  dilation=dilate, groups=groups, layout="NCHW")
+
+
+def _conv_shapes(attrs, ins):
+    x = ins[0]
+    nf = int(_parse_attr(attrs["num_filter"]))
+    k = _tuple2(attrs.get("kernel"), (1, 1))
+    stride = _tuple2(attrs.get("stride"), (1, 1))
+    pad = _tuple2(attrs.get("pad"), (0, 0))
+    dilate = _tuple2(attrs.get("dilate"), (1, 1))
+    g = int(_parse_attr(attrs.get("num_group"), 1))
+    no_bias = bool(_parse_attr(attrs.get("no_bias"), False))
+    wshape = (nf, x[1] // g) + k
+    out = (x[0], nf,
+           _conv_out(x[2], k[0], stride[0], pad[0], dilate[0]),
+           _conv_out(x[3], k[1], stride[1], pad[1], dilate[1]))
+    filled = [x, wshape] + ([] if no_bias else [(nf,)])
+    return filled, [out]
+
+
+@register_legacy_op(
+    "FullyConnected", num_inputs=1, param_slots=("weight", "bias"),
+    shape_fn=lambda a, ins: _fc_shapes(a, ins))
+def _op_fc(attrs, x, weight, bias=None):
+    jnp = _jnp()
+    flatten = bool(_parse_attr(attrs.get("flatten"), True))
+    if flatten and x.ndim > 2:
+        x = x.reshape((x.shape[0], -1))
+    y = x @ weight.T
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def _fc_shapes(attrs, ins):
+    x = ins[0]
+    nh = int(_parse_attr(attrs["num_hidden"]))
+    flatten = bool(_parse_attr(attrs.get("flatten"), True))
+    no_bias = bool(_parse_attr(attrs.get("no_bias"), False))
+    in_f = 1
+    if flatten:
+        for d in x[1:]:
+            in_f *= d
+        out = (x[0], nh)
+    else:
+        in_f = x[-1]
+        out = tuple(x[:-1]) + (nh,)
+    filled = [x, (nh, in_f)] + ([] if no_bias else [(nh,)])
+    return filled, [out]
+
+
+@register_legacy_op(
+    "BatchNorm", num_inputs=1, param_slots=("gamma", "beta"),
+    aux_slots=("moving_mean", "moving_var"),
+    shape_fn=lambda a, ins: (
+        [ins[0], (ins[0][1],), (ins[0][1],), (ins[0][1],), (ins[0][1],)],
+        [ins[0]]))
+def _op_bn(attrs, x, gamma, beta, mmean, mvar):
+    from ..ops import nn as N
+    jnp = _jnp()
+    eps = float(_parse_attr(attrs.get("eps"), 1e-3))
+    fix_gamma = bool(_parse_attr(attrs.get("fix_gamma"), True))
+    axis = int(_parse_attr(attrs.get("axis"), 1))
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    out, _, _ = N.batch_norm(x, gamma, beta, mmean, mvar, eps=eps,
+                             training=False, axis=axis,
+                             use_global_stats=True)
+    return out
+
+
+@register_legacy_op("Activation")
+def _op_act(attrs, x):
+    jnp = _jnp()
+    t = attrs.get("act_type", "relu")
+    if t == "relu":
+        return jnp.maximum(x, 0)
+    if t == "sigmoid":
+        import jax
+        return jax.nn.sigmoid(x)
+    if t == "tanh":
+        return jnp.tanh(x)
+    if t == "softrelu":
+        import jax
+        return jax.nn.softplus(x)
+    if t == "softsign":
+        return x / (1 + jnp.abs(x))
+    raise MXNetError(f"Activation act_type {t!r} unsupported")
+
+
+@register_legacy_op("LeakyReLU")
+def _op_leaky(attrs, x, *rest):
+    import jax
+    jnp = _jnp()
+    t = attrs.get("act_type", "leaky")
+    slope = float(_parse_attr(attrs.get("slope"), 0.25))
+    if t == "leaky":
+        return jnp.where(x >= 0, x, slope * x)
+    if t == "elu":
+        return jnp.where(x >= 0, x, slope * (jnp.exp(x) - 1))
+    if t == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    raise MXNetError(f"LeakyReLU act_type {t!r} unsupported")
+
+
+@register_legacy_op(
+    "Pooling",
+    shape_fn=lambda a, ins: ([ins[0]], [_pooling_shape(a, ins[0])]))
+def _op_pool(attrs, x):
+    from ..ops import nn as N
+    gp = bool(_parse_attr(attrs.get("global_pool"), False))
+    pt = attrs.get("pool_type", "max")
+    k = _tuple2(attrs.get("kernel"), (1, 1))
+    stride = _tuple2(attrs.get("stride"), k)
+    pad = _tuple2(attrs.get("pad"), (0, 0))
+    ceil = attrs.get("pooling_convention", "valid") == "full"
+    count_include_pad = bool(
+        _parse_attr(attrs.get("count_include_pad"), True))
+    return N.pooling(x, kernel=k, stride=stride, padding=pad, pool_type=pt,
+                     global_pool=gp, ceil_mode=ceil,
+                     count_include_pad=count_include_pad, layout="NCHW")
+
+
+def _pooling_shape(attrs, x):
+    if bool(_parse_attr(attrs.get("global_pool"), False)):
+        return (x[0], x[1], 1, 1)
+    k = _tuple2(attrs.get("kernel"), (1, 1))
+    stride = _tuple2(attrs.get("stride"), k)
+    pad = _tuple2(attrs.get("pad"), (0, 0))
+    ceil = attrs.get("pooling_convention", "valid") == "full"
+    return (x[0], x[1], _pool_out(x[2], k[0], stride[0], pad[0], ceil),
+            _pool_out(x[3], k[1], stride[1], pad[1], ceil))
+
+
+@register_legacy_op(
+    "Flatten",
+    shape_fn=lambda a, ins: (
+        [ins[0]],
+        [(ins[0][0], int(__import__("math").prod(ins[0][1:])))]))
+def _op_flatten(attrs, x):
+    return x.reshape((x.shape[0], -1))
+
+
+@register_legacy_op("Dropout")
+def _op_dropout(attrs, x, *rest):
+    return x   # scoring semantics: identity (mode='training' not serialized)
+
+
+def _softmax_out_shapes(attrs, ins):
+    filled = [ins[0]]
+    if len(ins) > 1:
+        filled.append(ins[1] if ins[1] is not None else (ins[0][0],))
+    return filled, [ins[0]]
+
+
+@register_legacy_op("SoftmaxOutput", num_inputs=2,
+                    shape_fn=_softmax_out_shapes)
+def _op_softmax_out(attrs, x, label=None):
+    import jax
+    return jax.nn.softmax(x, axis=1)
+
+
+@register_legacy_op("softmax")
+def _op_softmax(attrs, x):
+    import jax
+    axis = int(_parse_attr(attrs.get("axis"), -1))
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_legacy_op("Concat", variadic=True,
+                    shape_fn=lambda a, ins: _concat_shapes(a, ins))
+def _op_concat(attrs, *xs):
+    jnp = _jnp()
+    dim = int(_parse_attr(attrs.get("dim"), 1))
+    return jnp.concatenate(xs, axis=dim)
+
+
+def _concat_shapes(attrs, ins):
+    dim = int(_parse_attr(attrs.get("dim"), 1))
+    out = list(ins[0])
+    out[dim] = sum(s[dim] for s in ins)
+    return list(ins), [tuple(out)]
+
+
+@register_legacy_op("elemwise_add", num_inputs=2,
+                    shape_fn=lambda a, ins: (list(ins), [ins[0]]))
+def _op_eadd(attrs, a, b):
+    return a + b
+
+
+for _alias in ("_Plus", "_plus", "broadcast_add"):
+    _LEGACY_OPS[_alias] = _OpSpec(_alias, _op_eadd, num_inputs=2,
+                                  shape_fn=lambda a, ins: (list(ins),
+                                                           [ins[0]]))
+
+_LEGACY_OPS["elemwise_mul"] = _OpSpec(
+    "elemwise_mul", lambda at, a, b: a * b, num_inputs=2,
+    shape_fn=lambda a, ins: (list(ins), [ins[0]]))
+_LEGACY_OPS["broadcast_mul"] = _OpSpec(
+    "broadcast_mul", lambda at, a, b: a * b, num_inputs=2,
+    shape_fn=lambda a, ins: (list(ins), [ins[0]]))
+
+
+@register_legacy_op("add_n", variadic=True,
+                    shape_fn=lambda a, ins: (list(ins), [ins[0]]))
+def _op_addn(attrs, *xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@register_legacy_op("clip")
+def _op_clip(attrs, x):
+    jnp = _jnp()
+    return jnp.clip(x, float(_parse_attr(attrs["a_min"])),
+                    float(_parse_attr(attrs["a_max"])))
+
+
+@register_legacy_op("Reshape", shape_fn=lambda a, ins: _reshape_shapes(a, ins))
+def _op_reshape(attrs, x):
+    shape = _parse_attr(attrs.get("shape"))
+    return x.reshape(_resolve_reshape(shape, x.shape))
+
+
+def _resolve_reshape(spec, in_shape):
+    # supports 0 (copy dim) and -1 (infer); the common zoo subset
+    out = []
+    for i, d in enumerate(spec):
+        out.append(in_shape[i] if d == 0 else d)
+    return tuple(out)
+
+
+def _reshape_shapes(attrs, ins):
+    import numpy as _np
+    spec = _parse_attr(attrs.get("shape"))
+    resolved = list(_resolve_reshape(spec, ins[0]))
+    if -1 in resolved:
+        known = 1
+        for d in resolved:
+            if d != -1:
+                known *= d
+        total = int(_np.prod(ins[0]))
+        resolved[resolved.index(-1)] = total // known
+    return [ins[0]], [tuple(resolved)]
+
+
+@register_legacy_op("Cast")
+def _op_cast(attrs, x):
+    import numpy as _np
+    return x.astype(_np.dtype(attrs.get("dtype", "float32")))
+
+
+@register_legacy_op("transpose")
+def _op_transpose(attrs, x):
+    axes = _parse_attr(attrs.get("axes")) or tuple(
+        reversed(range(x.ndim)))
+    return x.transpose(axes)
+
+
+@register_legacy_op("relu")
+def _op_relu(attrs, x):
+    return _jnp().maximum(x, 0)
+
+
+@register_legacy_op("sigmoid")
+def _op_sigmoid(attrs, x):
+    import jax
+    return jax.nn.sigmoid(x)
+
+
+@register_legacy_op("mean", shape_fn=lambda a, ins: (
+        [ins[0]], [_reduce_shape(a, ins[0])]))
+def _op_mean(attrs, x):
+    axis = _parse_attr(attrs.get("axis"))
+    keepdims = bool(_parse_attr(attrs.get("keepdims"), False))
+    return _jnp().mean(x, axis=axis, keepdims=keepdims)
+
+
+def _reduce_shape(attrs, x):
+    axis = _parse_attr(attrs.get("axis"))
+    keepdims = bool(_parse_attr(attrs.get("keepdims"), False))
+    if axis is None:
+        return (1,) * len(x) if keepdims else ()
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % len(x) for a in axis)
+    if keepdims:
+        return tuple(1 if i in axis else d for i, d in enumerate(x))
+    return tuple(d for i, d in enumerate(x) if i not in axis)
+
+
+# ---------------------------------------------------------------------------
+# Symbol
+# ---------------------------------------------------------------------------
+_name_counter = {}
+
+
+def _auto_name(op):
+    k = op.lower()
+    n = _name_counter.get(k, 0)
+    _name_counter[k] = n + 1
+    return f"{k}{n}"
+
+
+class Symbol:
+    """An output list over the immutable node DAG (≙ symbol.symbol.Symbol)."""
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)   # [(node, out_idx)]
+
+    # -- graph walk -----------------------------------------------------
+    def _topo(self):
+        order, seen = [], set()
+        stack = [(n, False) for n, _ in reversed(self._outputs)]
+        while stack:
+            node, done = stack.pop()
+            if done:
+                order.append(node)
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            stack.append((node, True))
+            for inp, _ in reversed(node.inputs):
+                if id(inp) not in seen:
+                    stack.append((inp, False))
+        return order
+
+    # -- introspection --------------------------------------------------
+    def _null_nodes(self):
+        return [n for n in self._topo() if n.op == "null"]
+
+    def _aux_names(self):
+        aux = set()
+        for n in self._topo():
+            spec = _LEGACY_OPS.get(n.op)
+            if spec is None or not spec.aux_slots:
+                continue
+            base = len(n.inputs) - len(spec.aux_slots)
+            for inp, _ in n.inputs[base:]:
+                if inp.op == "null":
+                    aux.add(inp.name)
+        return aux
+
+    def list_arguments(self):
+        aux = self._aux_names()
+        return [n.name for n in self._null_nodes() if n.name not in aux]
+
+    def list_auxiliary_states(self):
+        aux = self._aux_names()
+        return [n.name for n in self._null_nodes() if n.name in aux]
+
+    def list_outputs(self):
+        out = []
+        for node, oidx in self._outputs:
+            suffix = "_output" if node.op != "null" else ""
+            nm = node.name + suffix
+            if oidx:
+                nm = f"{node.name}_output{oidx}"
+            out.append(nm)
+        return out
+
+    def list_inputs(self):
+        return [n.name for n in self._null_nodes()]
+
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def get_internals(self):
+        outs = []
+        for n in self._topo():
+            if n.op != "null":
+                outs.append((n, 0))
+        return Symbol(outs or self._outputs)
+
+    def get_children(self):
+        kids = []
+        for node, _ in self._outputs:
+            kids.extend(node.inputs)
+        return Symbol(kids) if kids else None
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            for node, oidx in self._outputs:
+                if node.name == idx or f"{node.name}_output" == idx:
+                    return Symbol([(node, oidx)])
+            raise MXNetError(f"no output named {idx!r}")
+        return Symbol([self._outputs[idx]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    @property
+    def num_outputs(self):
+        return len(self._outputs)
+
+    # -- attrs ----------------------------------------------------------
+    def attr(self, key):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].attrs.get(key)
+        return None
+
+    def _set_attr(self, **kwargs):
+        for node, _ in self._outputs:
+            for k, v in kwargs.items():
+                node.attrs[k] = _fmt_attr(v)
+
+    def list_attr(self):
+        if len(self._outputs) == 1:
+            return dict(self._outputs[0][0].attrs)
+        return {}
+
+    def attr_dict(self):
+        out = {}
+        for n in self._topo():
+            if n.attrs:
+                out[n.name] = dict(n.attrs)
+        return out
+
+    # -- composition ----------------------------------------------------
+    def compose(self, **kwargs):
+        """Substitute variables by name (≙ Symbol.__call__ composition)."""
+        sub = {}
+        for n in self._null_nodes():
+            if n.name in kwargs:
+                repl = kwargs[n.name]
+                if not isinstance(repl, Symbol) or len(repl._outputs) != 1:
+                    raise MXNetError("compose needs single-output Symbols")
+                sub[id(n)] = repl._outputs[0]
+        if not sub:
+            return self
+        memo = {}
+
+        def ref(node, oidx):
+            """Rebuilt (node, out_idx) for a reference into the old graph."""
+            if id(node) in sub:
+                return sub[id(node)]   # substituted variable: its own ref
+            return rebuild(node), oidx
+
+        def rebuild(node):
+            if id(node) in memo:
+                return memo[id(node)]
+            new = _Node(node.op, node.name, node.attrs,
+                        [ref(i, oi) for i, oi in node.inputs])
+            memo[id(node)] = new
+            return new
+
+        return Symbol([ref(n, oi) for n, oi in self._outputs])
+
+    def __call__(self, **kwargs):
+        return self.compose(**kwargs)
+
+    # -- serialization (legacy_json_util.cc format) ---------------------
+    def tojson(self):
+        order = self._topo()
+        idx = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            d = {"op": n.op, "name": n.name}
+            if n.attrs:
+                d["attrs"] = {k: str(v) for k, v in sorted(n.attrs.items())}
+            d["inputs"] = [[idx[id(i)], oi, 0] for i, oi in n.inputs]
+            nodes.append(d)
+        arg_nodes = [i for i, n in enumerate(order) if n.op == "null"]
+        # node_row_ptr: cumulative entry count (1 entry per single-output
+        # node — multi-output legacy ops are not produced by this builder)
+        row_ptr = list(range(len(order) + 1))
+        heads = [[idx[id(n)], oi, 0] for n, oi in self._outputs]
+        return json.dumps(
+            {"nodes": nodes, "arg_nodes": arg_nodes,
+             "node_row_ptr": row_ptr, "heads": heads,
+             "attrs": {"mxnet_version": ["int", _MXNET_VERSION]}},
+            indent=2)
+
+    def save(self, fname):
+        if not fname.endswith(".json"):
+            raise MXNetError("symbol files must end with .json")
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def debug_str(self):
+        lines = []
+        for n in self._topo():
+            if n.op == "null":
+                lines.append(f"Variable:{n.name}")
+            else:
+                ins = ", ".join(i.name for i, _ in n.inputs)
+                lines.append(f"Op:{n.op}, Name={n.name}\nInputs: [{ins}]")
+        return "\n".join(lines)
+
+    def __repr__(self):
+        nm = self.name or f"grouped({len(self._outputs)})"
+        return f"<Symbol {nm}>"
+
+    # -- shape / type inference ----------------------------------------
+    def infer_shape(self, **kwargs):
+        """(arg_shapes, out_shapes, aux_shapes) from per-op shape rules
+        (≙ MXSymbolInferShape). kwargs: {input_name: shape}."""
+        order = self._topo()
+        shapes = {}        # id(node) -> [out shapes]
+        var_shape = {}     # id(node) -> shape (null nodes)
+        for n in order:
+            if n.op == "null":
+                if n.name in kwargs:
+                    var_shape[id(n)] = tuple(kwargs[n.name])
+                continue
+            spec = _LEGACY_OPS.get(n.op)
+            if spec is None:
+                raise MXNetError(f"infer_shape: unknown op {n.op!r}")
+            in_shapes = []
+            for inp, oi in n.inputs:
+                if inp.op == "null":
+                    in_shapes.append(var_shape.get(id(inp)))
+                else:
+                    in_shapes.append(shapes[id(inp)][oi])
+            if in_shapes and in_shapes[0] is None:
+                raise MXNetError(
+                    f"infer_shape: missing shape for data input of "
+                    f"{n.name!r} — pass it as a keyword")
+            if spec.shape_fn is not None:
+                # shape_fns read the known data-input shapes (always at the
+                # front) and return the FULLY-filled input list + outputs
+                filled, outs = spec.shape_fn(n.attrs, in_shapes)
+                if len(filled) == len(in_shapes):
+                    for (inp, oi), s in zip(n.inputs, filled):
+                        if inp.op == "null" and id(inp) not in var_shape \
+                                and s is not None:
+                            var_shape[id(inp)] = tuple(s)
+            else:
+                outs = [in_shapes[0]]
+            shapes[id(n)] = [tuple(o) if o is not None else None
+                             for o in outs]
+        aux = self._aux_names()
+        arg_shapes = [var_shape.get(id(n)) for n in self._null_nodes()
+                      if n.name not in aux]
+        aux_shapes = [var_shape.get(id(n)) for n in self._null_nodes()
+                      if n.name in aux]
+        out_shapes = []
+        for node, oi in self._outputs:
+            if node.op == "null":
+                out_shapes.append(var_shape.get(id(node)))
+            else:
+                out_shapes.append(shapes[id(node)][oi])
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, **kwargs):
+        import numpy as _np
+        dt = _np.dtype(next(iter(kwargs.values()))) if kwargs \
+            else _np.dtype("float32")
+        n_args = len(self.list_arguments())
+        n_aux = len(self.list_auxiliary_states())
+        return ([dt] * n_args, [dt] * len(self._outputs), [dt] * n_aux)
+
+    # -- execution ------------------------------------------------------
+    def bind_fn(self):
+        """A pure jax-traceable callable `f(value_dict) -> [outputs]` where
+        value_dict maps EVERY required null-node name to an array. This is
+        the executor: jit/grad/shard it like any jax function
+        (≙ simple_bind + executor.forward, redesigned: XLA is the executor).
+        Missing optional inputs (e.g. SoftmaxOutput labels) may be omitted."""
+        order = self._topo()
+
+        def run(values):
+            env = {}
+            for n in order:
+                if n.op == "null":
+                    if n.name in values:
+                        env[id(n)] = [values[n.name]]
+                    else:
+                        env[id(n)] = [None]
+                    continue
+                spec = _LEGACY_OPS.get(n.op)
+                if spec is None:
+                    raise MXNetError(
+                        f"op {n.op!r} has no executor; register it with "
+                        "symbol.register_legacy_op")
+                ins = [env[id(i)][oi] for i, oi in n.inputs]
+                while ins and ins[-1] is None:
+                    ins.pop()   # trailing optional inputs (labels)
+                if any(v is None for v in ins):
+                    missing = [i.name for (i, oi), v
+                               in zip(n.inputs, ins) if v is None]
+                    raise MXNetError(
+                        f"executor: missing values for {missing} "
+                        f"(inputs of {n.name})")
+                out = spec.fn(n.attrs, *ins)
+                env[id(n)] = list(out) if isinstance(out, (tuple, list)) \
+                    else [out]
+            outs = []
+            for node, oi in self._outputs:
+                outs.append(env[id(node)][oi])
+            return outs
+
+        return run
+
+    def eval(self, **kwargs):
+        """Eager evaluation convenience (≙ Symbol.eval)."""
+        from ..ndarray import NDArray, _wrap
+        vals = {k: (v._arr if isinstance(v, NDArray) else v)
+                for k, v in kwargs.items()}
+        return [_wrap(o) for o in self.bind_fn()(vals)]
+
+
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+def Variable(name, **attrs):
+    if not isinstance(name, str):
+        raise TypeError("variable name must be a string")
+    node = _Node("null", name,
+                 {k: _fmt_attr(v) for k, v in attrs.items()})
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load_json(json_str):
+    """Parse the reference symbol.json format (legacy_json_util.cc:226)."""
+    d = json.loads(json_str)
+    if "nodes" not in d or "heads" not in d:
+        raise MXNetError("not a symbol json (missing nodes/heads)")
+    built = []
+    for nd in d["nodes"]:
+        attrs = nd.get("attrs", nd.get("attr", nd.get("param", {})))
+        node = _Node(nd["op"], nd["name"], attrs)
+        built.append(node)
+    for node, nd in zip(built, d["nodes"]):
+        node.inputs = [(built[i[0]], i[1] if len(i) > 1 else 0)
+                       for i in nd.get("inputs", [])]
+    heads = [(built[h[0]], h[1] if len(h) > 1 else 0) for h in d["heads"]]
+    return Symbol(heads)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# op-maker frontend: mx.sym.Convolution(data=..., kernel=(3,3), ...)
+# ---------------------------------------------------------------------------
+def _make_op(op_name):
+    spec = _LEGACY_OPS[op_name]
+
+    def maker(*args, name=None, **kwargs):
+        sym_args = list(args)
+        data_kw = []
+        # split symbol-valued kwargs (inputs) from attribute kwargs
+        attrs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                data_kw.append((k, v))
+            else:
+                attrs[k] = _fmt_attr(v)
+        name = name or _auto_name(op_name)
+        inputs = []
+        for s in sym_args:
+            if not isinstance(s, Symbol):
+                raise MXNetError("positional op arguments must be Symbols")
+            inputs.extend(s._outputs)
+        kw_order = {"data": 0, "lhs": 0, "rhs": 1, "label": 9}
+        for k, s in sorted(data_kw,
+                           key=lambda kv: kw_order.get(kv[0], 5)):
+            if len(s._outputs) != 1:
+                raise MXNetError("op inputs must be single-output Symbols")
+            inputs.append(s._outputs[0])
+        # auto-create missing learnable/aux slots (≙ nnvm's automatic
+        # variable creation for unbound op parameters)
+        no_bias = bool(_parse_attr(attrs.get("no_bias"), False))
+        slots = [s for s in spec.param_slots if not (no_bias
+                                                     and s == "bias")]
+        slots += list(spec.aux_slots)
+        want = (spec.num_inputs if not spec.variadic else len(inputs))
+        have_extra = len(inputs) - want
+        for s in slots[max(have_extra, 0):]:
+            v = _Node("null", f"{name}_{s}")
+            inputs.append((v, 0))
+        node = _Node(op_name, name, attrs, inputs)
+        return Symbol([(node, 0)])
+
+    maker.__name__ = op_name
+    maker.__doc__ = f"Create a {op_name!r} symbol node (legacy graph API)."
+    return maker
+
+
+def __getattr__(nm):
+    if nm in _LEGACY_OPS:
+        return _make_op(nm)
+    raise AttributeError(f"module 'symbol' has no attribute {nm!r}")

@@ -1,0 +1,190 @@
+"""mx.symbol: legacy graph API + serialized symbol.json parity
+(≙ reference tests/python/unittest/test_symbol.py + the
+legacy_json_util.cc format contract).
+
+The format check runs against a REAL reference artifact
+(tests/python/mkl/data/*_model1.json, a VGG16 graph) when the reference
+tree is present.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import symbol as sym
+
+REF_JSON = ("/root/reference/tests/python/mkl/data/"
+            "test_mkldnn_test_mkldnn_model_model1.json")
+
+
+def _small_net():
+    data = sym.var("data")
+    c1 = sym.Convolution(data=data, kernel=(3, 3), num_filter=8,
+                         pad=(1, 1), name="c1")
+    bn = sym.BatchNorm(data=c1, fix_gamma=False, name="bn1")
+    act = sym.Activation(data=bn, act_type="relu", name="r1")
+    p = sym.Pooling(data=act, global_pool=True, pool_type="avg",
+                    kernel=(1, 1), name="gap")
+    f = sym.Flatten(data=p, name="flat")
+    fc = sym.FullyConnected(data=f, num_hidden=10, name="fc")
+    return sym.softmax(data=fc, name="sm")
+
+
+def test_builder_introspection():
+    s = _small_net()
+    args = s.list_arguments()
+    assert args[0] == "data"
+    assert "c1_weight" in args and "c1_bias" in args
+    assert "bn1_gamma" in args and "bn1_beta" in args
+    assert s.list_auxiliary_states() == ["bn1_moving_mean",
+                                         "bn1_moving_var"]
+    assert s.list_outputs() == ["sm_output"]
+
+
+def test_infer_shape_small():
+    s = _small_net()
+    arg_shapes, out_shapes, aux_shapes = s.infer_shape(data=(2, 3, 16, 16))
+    d = dict(zip(s.list_arguments(), arg_shapes))
+    assert d["c1_weight"] == (8, 3, 3, 3)
+    assert d["c1_bias"] == (8,)
+    assert d["fc_weight"] == (10, 8)
+    assert out_shapes == [(2, 10)]
+    assert aux_shapes == [(8,), (8,)]
+
+
+def test_json_roundtrip_format():
+    s = _small_net()
+    j = s.tojson()
+    d = json.loads(j)
+    # the exact top-level contract of legacy_json_util.cc
+    assert set(d) == {"nodes", "arg_nodes", "node_row_ptr", "heads",
+                      "attrs"}
+    assert d["attrs"]["mxnet_version"][0] == "int"
+    for n in d["nodes"]:
+        assert set(n) <= {"op", "name", "attrs", "inputs"}
+        for v in n.get("attrs", {}).values():
+            assert isinstance(v, str)   # ALL attr values stringified
+        for i in n["inputs"]:
+            assert len(i) == 3
+    s2 = sym.load_json(j)
+    assert s2.list_arguments() == s.list_arguments()
+    assert s2.list_auxiliary_states() == s.list_auxiliary_states()
+    assert json.loads(s2.tojson()) == d
+
+
+def test_executor_matches_and_grads():
+    import jax
+    s = _small_net()
+    arg_shapes, _, aux_shapes = s.infer_shape(data=(2, 3, 8, 8))
+    rng = np.random.RandomState(0)
+    names = s.list_arguments() + s.list_auxiliary_states()
+    shapes = list(arg_shapes) + list(aux_shapes)
+    vals = {}
+    for nm, shp in zip(names, shapes):
+        if nm == "data":
+            vals[nm] = rng.randn(2, 3, 8, 8).astype(np.float32)
+        elif "moving_var" in nm:
+            vals[nm] = np.ones(shp, np.float32)
+        else:
+            vals[nm] = (rng.randn(*shp) * 0.1).astype(np.float32)
+    run = s.bind_fn()
+    out = run(vals)[0]
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-5)
+
+    # the executor is a pure jax function: jit + grad straight through
+    jout = jax.jit(lambda v: run(v)[0])(vals)
+    np.testing.assert_allclose(np.asarray(jout), np.asarray(out),
+                               rtol=2e-5, atol=1e-6)
+    g = jax.grad(lambda v: run(v)[0][:, 0].sum())(vals)
+    assert g["c1_weight"].shape == vals["c1_weight"].shape
+    assert float(np.abs(np.asarray(g["c1_weight"])).sum()) > 0
+
+
+def test_compose_and_internals():
+    x = sym.var("x")
+    net = sym.FullyConnected(data=x, num_hidden=4, name="fc1")
+    y = sym.var("y")
+    net2 = net.compose(x=y)
+    assert "y" in net2.list_arguments()
+    assert "x" not in net2.list_arguments()
+    internals = _small_net().get_internals()
+    assert internals.num_outputs >= 6
+    out = internals["c1_output"]
+    assert out.name == "c1"
+
+
+def test_attrs():
+    a = sym.var("w", lr_mult=2.0)
+    assert a.attr("lr_mult") == "2.0"
+    s = sym.FullyConnected(data=a, num_hidden=3, name="fc")
+    assert s.attr("num_hidden") == "3"
+    assert "fc" in s.attr_dict()
+
+
+@pytest.mark.skipif(not os.path.exists(REF_JSON),
+                    reason="reference artifact not present")
+def test_reference_vgg16_artifact_parses_and_runs():
+    s = sym.load(REF_JSON)
+    args = s.list_arguments()
+    assert len(args) == 34 and args[0] == "data"
+    arg_shapes, out_shapes, _ = s.infer_shape(data=(1, 3, 224, 224),
+                                              softmax_label=(1,))
+    assert out_shapes == [(1, 1000)]
+    d = dict(zip(args, arg_shapes))
+    assert d["conv1_1_weight"] == (64, 3, 3, 3)
+    rng = np.random.RandomState(0)
+    vals = {}
+    for nm, shp in zip(args, arg_shapes):
+        if nm == "data":
+            vals[nm] = rng.randn(1, 3, 224, 224).astype(np.float32)
+        elif shp is not None and nm != "softmax_label":
+            vals[nm] = (rng.randn(*shp) * 0.01).astype(np.float32)
+    out = s.bind_fn()(vals)[0]
+    assert out.shape == (1, 1000)
+    np.testing.assert_allclose(float(np.asarray(out).sum()), 1.0, rtol=1e-4)
+    # emit→reparse→re-execute parity
+    s2 = sym.load_json(s.tojson())
+    out2 = s2.bind_fn()(vals)[0]
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out), rtol=1e-6)
+
+
+def test_symbolblock_imports_legacy_artifact(tmp_path):
+    """End-to-end VERDICT-r3 Next #7: save symbol.json + reference-format
+    .params, SymbolBlock.imports loads both, forward matches the raw
+    executor, and the block hybridizes."""
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon.model_zoo.model_store import \
+        save_params_file
+
+    s = _small_net()
+    sym_file = str(tmp_path / "m-symbol.json")
+    s.save(sym_file)
+
+    arg_shapes, _, aux_shapes = s.infer_shape(data=(2, 3, 8, 8))
+    rng = np.random.RandomState(1)
+    params = {}
+    for nm, shp in zip(s.list_arguments(), arg_shapes):
+        if nm == "data":
+            continue
+        params["arg:" + nm] = (rng.randn(*shp) * 0.1).astype(np.float32)
+    for nm, shp in zip(s.list_auxiliary_states(), aux_shapes):
+        params["aux:" + nm] = (np.ones(shp, np.float32) if "var" in nm
+                               else np.zeros(shp, np.float32))
+    params_file = str(tmp_path / "m-0000.params")
+    save_params_file(params_file, params)
+
+    net = gluon.SymbolBlock.imports(sym_file, ["data"], params_file)
+    x = mx.np.array(rng.randn(2, 3, 8, 8).astype(np.float32))
+    got = net(x).asnumpy()
+
+    vals = {k.split(":", 1)[-1]: v for k, v in params.items()}
+    vals["data"] = x.asnumpy()
+    ref = np.asarray(s.bind_fn()(vals)[0])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    net.hybridize()
+    got_h = net(x).asnumpy()
+    np.testing.assert_allclose(got_h, ref, rtol=1e-5, atol=1e-6)
