@@ -115,6 +115,292 @@ int MXPredForward(PredictorHandle handle, int num_inputs,
                   NDArrayHandle **outputs);
 int MXPredFree(PredictorHandle handle);
 
+/* ======================================================================
+ * Extended groups (same axes as reference c_api.h:246-3119): MXSymbol,
+ * MXDataIter/Dataset/Batchify, MXProfile, MXEngine, MXRecordIO, and the
+ * NDArray/KVStore/misc tail. String and list returns use THREAD-LOCAL
+ * storage owned by the library, valid until the next C API call on the
+ * same thread (the reference MXAPIThreadLocalEntry contract). Handle
+ * arrays returned through triple-pointer out params are malloc'd:
+ * release them with MXFreeHandleArray.
+ * ====================================================================== */
+
+typedef void *SymbolHandle;
+typedef void *DataIterHandle;
+typedef void *DatasetHandle;
+typedef void *BatchifyFunctionHandle;
+typedef void *ProfileHandle;
+typedef void *RecordIOHandle;
+
+/* ---- NDArray tail ----------------------------------------------------- */
+int MXNDArrayCreateNone(NDArrayHandle *out);
+int MXNDArrayCreate64(const void *data, const int64_t *shape, int ndim,
+                      int dtype, NDArrayHandle *out);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t nbytes);
+int MXNDArrayAt(NDArrayHandle handle, uint32_t idx, NDArrayHandle *out);
+int MXNDArrayAt64(NDArrayHandle handle, int64_t idx, NDArrayHandle *out);
+int MXNDArraySlice(NDArrayHandle handle, uint32_t start, uint32_t stop,
+                   NDArrayHandle *out);
+int MXNDArraySlice64(NDArrayHandle handle, int64_t start, int64_t stop,
+                     NDArrayHandle *out);
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *shape,
+                     NDArrayHandle *out);
+int MXNDArrayReshape64(NDArrayHandle handle, int ndim, const int64_t *shape,
+                       int reverse, NDArrayHandle *out);
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out);
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitToWrite(NDArrayHandle handle);
+int MXNDArrayGetShape64(NDArrayHandle handle, int *out_dim,
+                        const int64_t **out_pdata);
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out);
+int MXNDArraySave(const char *fname, uint32_t num_args, NDArrayHandle *args,
+                  const char **keys);
+int MXNDArrayLoad(const char *fname, uint32_t *out_size,
+                  NDArrayHandle **out_arr, uint32_t *out_name_size,
+                  const char ***out_names);
+int MXNDArrayLegacySave(const char *fname, uint32_t num_args,
+                        NDArrayHandle *args, const char **keys);
+int MXShallowCopyNDArray(NDArrayHandle handle, NDArrayHandle *out);
+
+/* ---- misc ------------------------------------------------------------- */
+int MXRandomSeed(int seed);
+int MXRandomSeedContext(int seed, int dev_type, int dev_id);
+int MXListAllOpNames(uint32_t *out_size, const char ***out_array);
+int MXLibInfoFeatures(const void **out, size_t *out_size);
+int MXGetGPUCount(int *out);
+int MXGetTPUCount(int *out);
+int MXGetGPUMemoryInformation64(int dev, uint64_t *free_mem,
+                                uint64_t *total_mem);
+int MXSetNumOMPThreads(int n);
+int MXSetFlushDenorms(int on, int *prev);
+int MXIsNumpyShape(int *out);
+int MXSetIsNumpyShape(int flag, int *prev);
+int MXIsNumpyDefaultDtype(int *out);
+int MXSetIsNumpyDefaultDtype(int flag, int *prev);
+int MXNotifyShutdown(void);
+int MXStorageEmptyCache(int dev_type, int dev_id);
+
+/* ---- symbol (≙ reference MXSymbol*, c_api.h:1448-2100) ---------------- */
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+int MXSymbolCreateAtomicSymbol(const char *op_name, uint32_t num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out);
+int MXSymbolCompose(SymbolHandle sym, const char *name, uint32_t num_args,
+                    const char **keys, SymbolHandle *args);
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+int MXSymbolSaveToFile(SymbolHandle sym, const char *fname);
+int MXSymbolFree(SymbolHandle sym);
+int MXSymbolCopy(SymbolHandle sym, SymbolHandle *out);
+int MXSymbolPrint(SymbolHandle sym, const char **out_str);
+int MXSymbolGetName(SymbolHandle sym, const char **out, int *success);
+int MXSymbolGetAttr(SymbolHandle sym, const char *key, const char **out,
+                    int *success);
+int MXSymbolSetAttr(SymbolHandle sym, const char *key, const char *value);
+int MXSymbolListAttr(SymbolHandle sym, uint32_t *out_size,
+                     const char ***out);
+int MXSymbolListAttrShallow(SymbolHandle sym, uint32_t *out_size,
+                            const char ***out);
+int MXSymbolListArguments(SymbolHandle sym, uint32_t *out_size,
+                          const char ***out_str_array);
+int MXSymbolListOutputs(SymbolHandle sym, uint32_t *out_size,
+                        const char ***out_str_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, uint32_t *out_size,
+                                const char ***out_str_array);
+int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle *out);
+int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle *out);
+int MXSymbolGetOutput(SymbolHandle sym, uint32_t index, SymbolHandle *out);
+int MXSymbolGetNumOutputs(SymbolHandle sym, uint32_t *output_count);
+int MXSymbolGetInputs(SymbolHandle sym, SymbolHandle *out);
+int MXSymbolGetInputSymbols(SymbolHandle sym, SymbolHandle **out,
+                            int *out_size);
+int MXSymbolCreateGroup(uint32_t num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out);
+int MXShallowCopySymbol(SymbolHandle sym, SymbolHandle *out);
+int MXSymbolListAtomicSymbolCreators(uint32_t *out_size,
+                                     const char ***out_array);
+int MXSymbolGetAtomicSymbolName(const char *creator, const char **name);
+int MXSymbolGetAtomicSymbolInfo(const char *creator, const char **name,
+                                const char **description);
+/* CSR-packed shapes: arg_ind_ptr has num_args+1 entries delimiting each
+ * argument's dims inside arg_shape_data. Unknown rows come back with
+ * ndim == -1 (partial variant only). */
+int MXSymbolInferShape64(SymbolHandle sym, uint32_t num_args,
+                         const char **keys, const int64_t *arg_ind_ptr,
+                         const int64_t *arg_shape_data,
+                         size_t *in_shape_size, const int **in_shape_ndim,
+                         const int64_t ***in_shape_data,
+                         size_t *out_shape_size, const int **out_shape_ndim,
+                         const int64_t ***out_shape_data,
+                         size_t *aux_shape_size, const int **aux_shape_ndim,
+                         const int64_t ***aux_shape_data, int *complete);
+int MXSymbolInferShapePartial64(
+    SymbolHandle sym, uint32_t num_args, const char **keys,
+    const int64_t *arg_ind_ptr, const int64_t *arg_shape_data,
+    size_t *in_shape_size, const int **in_shape_ndim,
+    const int64_t ***in_shape_data, size_t *out_shape_size,
+    const int **out_shape_ndim, const int64_t ***out_shape_data,
+    size_t *aux_shape_size, const int **aux_shape_ndim,
+    const int64_t ***aux_shape_data, int *complete);
+int MXSymbolInferType(SymbolHandle sym, uint32_t num_args, const char **keys,
+                      const int *arg_type_data, uint32_t *in_type_size,
+                      const int **in_type_data, uint32_t *out_type_size,
+                      const int **out_type_data, uint32_t *aux_type_size,
+                      const int **aux_type_data, int *complete);
+
+/* ---- data iterators / datasets / batchify ----------------------------- */
+int MXListDataIters(uint32_t *out_size, DataIterHandle **out_array);
+int MXDataIterGetIterInfo(DataIterHandle creator, const char **name,
+                          const char **description, uint32_t *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions);
+int MXDataIterCreateIter(DataIterHandle creator, uint32_t num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out);
+int MXDataIterFree(DataIterHandle handle);
+int MXDataIterNext(DataIterHandle handle, int *out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetItems(DataIterHandle handle, int *num_outputs,
+                       NDArrayHandle **outputs);
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size);
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+int MXDataIterGetLenHint(DataIterHandle handle, int64_t *len);
+int MXListDatasets(uint32_t *out_size, DatasetHandle **out_array);
+int MXDatasetGetDatasetInfo(DatasetHandle creator, const char **name,
+                            const char **description, uint32_t *num_args,
+                            const char ***arg_names,
+                            const char ***arg_type_infos,
+                            const char ***arg_descriptions);
+int MXDatasetCreateDataset(DatasetHandle creator, uint32_t num_param,
+                           const char **keys, const char **vals,
+                           DatasetHandle *out);
+int MXDatasetFree(DatasetHandle handle);
+int MXDatasetGetLen(DatasetHandle handle, uint64_t *out);
+int MXDatasetGetItems(DatasetHandle handle, uint64_t index,
+                      int *num_outputs, NDArrayHandle **outputs);
+int MXListBatchifyFunctions(uint32_t *out_size,
+                            BatchifyFunctionHandle **out_array);
+int MXBatchifyFunctionGetFunctionInfo(BatchifyFunctionHandle creator,
+                                      const char **name,
+                                      const char **description,
+                                      uint32_t *num_args,
+                                      const char ***arg_names,
+                                      const char ***arg_type_infos,
+                                      const char ***arg_descriptions);
+int MXBatchifyFunctionCreateFunction(BatchifyFunctionHandle creator,
+                                     uint32_t num_param, const char **keys,
+                                     const char **vals,
+                                     BatchifyFunctionHandle *out);
+int MXBatchifyFunctionInvoke(BatchifyFunctionHandle handle, int num_samples,
+                             NDArrayHandle *samples, int *num_outputs,
+                             NDArrayHandle **outputs);
+int MXBatchifyFunctionFree(BatchifyFunctionHandle handle);
+
+/* ---- profiler (≙ reference MXProfile*, c_api.h:246-600) --------------- */
+int MXSetProfilerConfig(int num_params, const char **keys,
+                        const char **vals);
+int MXSetProcessProfilerConfig(int num_params, const char **keys,
+                               const char **vals, void *kv_handle);
+int MXSetProfilerState(int state);
+int MXSetProcessProfilerState(int state, int profile_process,
+                              void *kv_handle);
+int MXProfilePause(int paused);
+int MXProcessProfilePause(int paused, int profile_process, void *kv_handle);
+int MXDumpProfile(int finished);
+int MXDumpProcessProfile(int finished, int profile_process, void *kv_handle);
+int MXAggregateProfileStatsPrint(const char **out_str, int reset);
+int MXProfileCreateDomain(const char *domain, ProfileHandle *out);
+int MXProfileCreateTask(ProfileHandle domain, const char *task_name,
+                        ProfileHandle *out);
+int MXProfileCreateFrame(ProfileHandle domain, const char *frame_name,
+                         ProfileHandle *out);
+int MXProfileCreateEvent(const char *event_name, ProfileHandle *out);
+int MXProfileCreateCounter(ProfileHandle domain, const char *counter_name,
+                           ProfileHandle *out);
+int MXProfileDestroyHandle(ProfileHandle handle);
+int MXProfileDurationStart(ProfileHandle duration_handle);
+int MXProfileDurationStop(ProfileHandle duration_handle);
+int MXProfileSetCounter(ProfileHandle counter_handle, uint64_t value);
+int MXProfileAdjustCounter(ProfileHandle counter_handle, int64_t delta);
+int MXProfileSetMarker(ProfileHandle domain, const char *instant_marker_name,
+                       const char *scope);
+
+/* ---- engine (≙ reference MXEngine*, c_api.h:3028-3119) ---------------- */
+typedef void (*EngineSyncFunc)(void *);
+typedef void (*EngineAsyncFunc)(void *, void *, void *);
+int MXEngineSetBulkSize(int bulk_size, int *prev_bulk_size);
+int MXEnginePushSync(EngineSyncFunc sync_func, void *func_param,
+                     void *deleter, const void *ctx_handle,
+                     const void *const_vars, int num_const_vars,
+                     const void *mutable_vars, int num_mutable_vars);
+int MXEnginePushAsync(EngineAsyncFunc async_func, void *func_param,
+                      void *deleter, const void *ctx_handle,
+                      const void *const_vars, int num_const_vars,
+                      const void *mutable_vars, int num_mutable_vars);
+int MXEnginePushSyncND(EngineSyncFunc sync_func, void *func_param,
+                       void *deleter, const void *ctx_handle,
+                       NDArrayHandle *const_nds, int num_const_nds,
+                       NDArrayHandle *mutable_nds, int num_mutable_nds);
+int MXEnginePushAsyncND(EngineAsyncFunc async_func, void *func_param,
+                        void *deleter, const void *ctx_handle,
+                        NDArrayHandle *const_nds, int num_const_nds,
+                        NDArrayHandle *mutable_nds, int num_mutable_nds);
+
+/* ---- recordio (≙ reference MXRecordIO*, c_api.h:2810-2900) ------------ */
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOWriterFree(RecordIOHandle handle);
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size);
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos);
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOReaderFree(RecordIOHandle handle);
+/* *buf NULL + *size 0 signals EOF; the buffer is thread-local storage */
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const **buf,
+                               size_t *size);
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+int MXRecordIOReaderTell(RecordIOHandle handle, size_t *pos);
+
+/* ---- kvstore tail ----------------------------------------------------- */
+typedef void (*MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                 NDArrayHandle local, void *handle);
+int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+int MXKVStoreBarrier(KVStoreHandle handle);
+int MXKVStorePushPull(KVStoreHandle handle, int num, const int *keys,
+                      NDArrayHandle *vals, NDArrayHandle *outs,
+                      int priority);
+int MXKVStoreBroadcast(KVStoreHandle handle, int num, const int *keys,
+                       NDArrayHandle *vals, NDArrayHandle *outs,
+                       int priority);
+int MXKVStoreSetGradientCompression(KVStoreHandle handle, uint32_t num_params,
+                                    const char **keys, const char **vals);
+int MXKVStoreInitEx(KVStoreHandle handle, uint32_t num, const char **keys,
+                    NDArrayHandle *vals);
+int MXKVStorePushEx(KVStoreHandle handle, uint32_t num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+int MXKVStorePullEx(KVStoreHandle handle, uint32_t num, const char **keys,
+                    NDArrayHandle *outs, int priority);
+/* updater runs synchronously during push; recv/local handles are borrowed
+ * and valid only for the duration of the callback */
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle);
+int MXKVStoreIsWorkerNode(int *ret);
+int MXKVStoreIsServerNode(int *ret);
+int MXKVStoreIsSchedulerNode(int *ret);
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *number);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  int barrier_before_exit);
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body);
+int MXInitPSEnv(uint32_t num_vars, const char **keys, const char **vals);
+
 #ifdef __cplusplus
 }
 #endif

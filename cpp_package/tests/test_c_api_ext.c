@@ -1,0 +1,369 @@
+/* Extended C ABI consumer: symbols, record IO, data iterators, profiler,
+ * kvstore updater callback, NDArray tail — pure C, no Python on this side.
+ * (≙ reference tests/cpp/ + the capi breadth of include/mxnet/c_api.h.)
+ *
+ * usage: test_c_api_ext <csv_path> <profile_json_path> <tmpdir>
+ */
+#include <assert.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <mxtpu/c_api.h>
+
+#define CHECK(x)                                                        \
+  do {                                                                  \
+    if ((x) != 0) {                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s -> %s\n", __FILE__, __LINE__, #x, \
+              MXGetLastError());                                        \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (0)
+
+static void test_symbol(void) {
+  SymbolHandle data, fc, loaded;
+  CHECK(MXSymbolCreateVariable("data", &data));
+
+  const char *akeys[] = {"num_hidden"};
+  const char *avals[] = {"4"};
+  CHECK(MXSymbolCreateAtomicSymbol("FullyConnected", 1, akeys, avals, &fc));
+  const char *ckeys[] = {"data"};
+  SymbolHandle cargs[] = {data};
+  CHECK(MXSymbolCompose(fc, "fc1", 1, ckeys, cargs));
+
+  uint32_t n_args = 0;
+  const char **args = NULL;
+  CHECK(MXSymbolListArguments(fc, &n_args, &args));
+  assert(n_args == 3); /* data, fc1_weight, fc1_bias */
+  assert(strcmp(args[0], "data") == 0);
+  assert(strcmp(args[1], "fc1_weight") == 0);
+
+  uint32_t n_out = 0;
+  CHECK(MXSymbolGetNumOutputs(fc, &n_out));
+  assert(n_out == 1);
+
+  const char *attr = NULL;
+  int success = 0;
+  CHECK(MXSymbolGetAttr(fc, "num_hidden", &attr, &success));
+  assert(success == 1 && strcmp(attr, "4") == 0);
+
+  /* infer shape through the CSR contract */
+  const char *skeys[] = {"data"};
+  int64_t ind_ptr[] = {0, 2};
+  int64_t shp[] = {2, 6};
+  size_t in_sz, out_sz, aux_sz;
+  const int *in_nd, *out_nd, *aux_nd;
+  const int64_t **in_d, **out_d, **aux_d;
+  int complete = 0;
+  CHECK(MXSymbolInferShape64(fc, 1, skeys, ind_ptr, shp, &in_sz, &in_nd,
+                             &in_d, &out_sz, &out_nd, &out_d, &aux_sz,
+                             &aux_nd, &aux_d, &complete));
+  assert(complete == 1);
+  assert(in_sz == 3);
+  assert(in_nd[1] == 2 && in_d[1][0] == 4 && in_d[1][1] == 6); /* weight */
+  assert(out_sz == 1 && out_nd[0] == 2 && out_d[0][0] == 2 &&
+         out_d[0][1] == 4);
+
+  /* json round-trip */
+  const char *json = NULL;
+  CHECK(MXSymbolSaveToJSON(fc, &json));
+  assert(strstr(json, "FullyConnected") != NULL);
+  CHECK(MXSymbolCreateFromJSON(json, &loaded));
+  uint32_t n2 = 0;
+  const char **args2 = NULL;
+  CHECK(MXSymbolListArguments(loaded, &n2, &args2));
+  assert(n2 == 3);
+
+  uint32_t n_ops = 0;
+  const char **ops = NULL;
+  CHECK(MXSymbolListAtomicSymbolCreators(&n_ops, &ops));
+  assert(n_ops >= 10);
+
+  CHECK(MXSymbolFree(loaded));
+  CHECK(MXSymbolFree(fc));
+  CHECK(MXSymbolFree(data));
+  printf("symbol group OK\n");
+}
+
+static void test_recordio(const char *tmpdir) {
+  char path[512];
+  snprintf(path, sizeof(path), "%s/records.rec", tmpdir);
+  RecordIOHandle w, r;
+  CHECK(MXRecordIOWriterCreate(path, &w));
+  CHECK(MXRecordIOWriterWriteRecord(w, "hello", 5));
+  CHECK(MXRecordIOWriterWriteRecord(w, "tpu-record", 10));
+  size_t pos = 0;
+  CHECK(MXRecordIOWriterTell(w, &pos));
+  assert(pos > 0);
+  CHECK(MXRecordIOWriterFree(w));
+
+  CHECK(MXRecordIOReaderCreate(path, &r));
+  const char *buf = NULL;
+  size_t size = 0;
+  CHECK(MXRecordIOReaderReadRecord(r, &buf, &size));
+  assert(size == 5 && memcmp(buf, "hello", 5) == 0);
+  CHECK(MXRecordIOReaderReadRecord(r, &buf, &size));
+  assert(size == 10 && memcmp(buf, "tpu-record", 10) == 0);
+  CHECK(MXRecordIOReaderReadRecord(r, &buf, &size));
+  assert(size == 0 && buf == NULL); /* EOF */
+  CHECK(MXRecordIOReaderSeek(r, 0));
+  CHECK(MXRecordIOReaderReadRecord(r, &buf, &size));
+  assert(size == 5);
+  CHECK(MXRecordIOReaderFree(r));
+  printf("recordio group OK\n");
+}
+
+static void test_data_iter(const char *csv_path) {
+  uint32_t n = 0;
+  DataIterHandle *creators = NULL;
+  CHECK(MXListDataIters(&n, &creators));
+  DataIterHandle csv_creator = NULL;
+  for (uint32_t i = 0; i < n; ++i) {
+    const char *name = NULL, *desc = NULL;
+    CHECK(MXDataIterGetIterInfo(creators[i], &name, &desc, NULL, NULL, NULL,
+                                NULL));
+    if (strcmp(name, "CSVIter") == 0) csv_creator = creators[i];
+  }
+  assert(csv_creator != NULL);
+
+  const char *keys[] = {"data_csv", "data_shape", "batch_size"};
+  const char *vals[] = {csv_path, "(3,)", "2"};
+  DataIterHandle it = NULL;
+  CHECK(MXDataIterCreateIter(csv_creator, 3, keys, vals, &it));
+
+  /* 5 rows, batch 2 -> 3 batches, last padded by 1 */
+  int batches = 0, has_next = 0, last_pad = 0;
+  float first_row[3] = {0, 0, 0};
+  for (;;) {
+    CHECK(MXDataIterNext(it, &has_next));
+    if (!has_next) break;
+    NDArrayHandle d = NULL;
+    CHECK(MXDataIterGetData(it, &d));
+    int ndim = 0;
+    CHECK(MXNDArrayGetNDim(d, &ndim));
+    assert(ndim == 2);
+    if (batches == 0) {
+      float host[6];
+      CHECK(MXNDArraySyncCopyToCPU(d, host, sizeof(host)));
+      memcpy(first_row, host, sizeof(first_row));
+    }
+    CHECK(MXDataIterGetPadNum(it, &last_pad));
+    CHECK(MXNDArrayFree(d));
+    ++batches;
+  }
+  assert(batches == 3);
+  assert(last_pad == 1);
+  assert(first_row[0] == 0.0f && first_row[1] == 1.0f &&
+         first_row[2] == 2.0f);
+
+  /* reset + re-iterate */
+  CHECK(MXDataIterBeforeFirst(it));
+  CHECK(MXDataIterNext(it, &has_next));
+  assert(has_next == 1);
+  CHECK(MXDataIterFree(it));
+  CHECK(MXFreeHandleArray(creators));
+  printf("data iter group OK (3 batches, pad 1)\n");
+}
+
+static void test_profiler(const char *profile_path) {
+  const char *keys[] = {"filename"};
+  const char *vals[1];
+  vals[0] = profile_path;
+  CHECK(MXSetProfilerConfig(1, keys, vals));
+  CHECK(MXSetProfilerState(1));
+
+  ProfileHandle domain = NULL, task = NULL, counter = NULL;
+  CHECK(MXProfileCreateDomain("capi_test", &domain));
+  CHECK(MXProfileCreateTask(domain, "c_side_work", &task));
+  CHECK(MXProfileDurationStart(task));
+  /* some real work through the ABI so the profile has op events */
+  int64_t shape[] = {64, 64};
+  NDArrayHandle a = NULL, outp = NULL;
+  CHECK(MXNDArrayZeros(shape, 2, 0, &a));
+  int n_out = 0;
+  NDArrayHandle *outs = NULL;
+  CHECK(MXImperativeInvoke("abs", 1, &a, "", &n_out, &outs));
+  outp = outs[0];
+  CHECK(MXProfileDurationStop(task));
+  CHECK(MXProfileCreateCounter(domain, "items", &counter));
+  CHECK(MXProfileSetCounter(counter, 41));
+  CHECK(MXProfileAdjustCounter(counter, 1));
+  CHECK(MXProfileSetMarker(domain, "done_marker", "process"));
+
+  const char *stats = NULL;
+  CHECK(MXAggregateProfileStatsPrint(&stats, 0));
+  assert(stats != NULL);
+  CHECK(MXSetProfilerState(0));
+  CHECK(MXDumpProfile(1));
+
+  CHECK(MXNDArrayFree(a));
+  CHECK(MXNDArrayFree(outp));
+  CHECK(MXFreeHandleArray(outs));
+  CHECK(MXProfileDestroyHandle(task));
+  CHECK(MXProfileDestroyHandle(counter));
+  CHECK(MXProfileDestroyHandle(domain));
+  printf("profiler group OK\n");
+}
+
+static void test_ndarray_tail(const char *tmpdir) {
+  float data[12];
+  int i;
+  for (i = 0; i < 12; ++i) data[i] = (float)i;
+  int64_t shape[] = {3, 4};
+  NDArrayHandle a = NULL, row = NULL, sl = NULL, rs = NULL;
+  CHECK(MXNDArrayCreate(data, shape, 2, 0, &a));
+
+  CHECK(MXNDArrayAt(a, 1, &row));
+  float host4[4];
+  CHECK(MXNDArraySyncCopyToCPU(row, host4, sizeof(host4)));
+  assert(host4[0] == 4.0f && host4[3] == 7.0f);
+
+  CHECK(MXNDArraySlice(a, 1, 3, &sl));
+  int ndim = 0;
+  CHECK(MXNDArrayGetNDim(sl, &ndim));
+  assert(ndim == 2);
+
+  int rshape[] = {4, 3};
+  CHECK(MXNDArrayReshape(a, 2, rshape, &rs));
+  const int64_t *s64 = NULL;
+  int nd = 0;
+  CHECK(MXNDArrayGetShape64(rs, &nd, &s64));
+  assert(nd == 2 && s64[0] == 4 && s64[1] == 3);
+
+  int dev_type = 0, dev_id = -1;
+  CHECK(MXNDArrayGetContext(a, &dev_type, &dev_id));
+  assert(dev_type >= 1);
+  int stype = -1;
+  CHECK(MXNDArrayGetStorageType(a, &stype));
+  assert(stype == 0);
+  CHECK(MXNDArrayWaitToRead(a));
+
+  /* save/load round trip */
+  char path[512];
+  snprintf(path, sizeof(path), "%s/arrays.ndarray", tmpdir);
+  const char *names[] = {"a"};
+  NDArrayHandle savearr[1];
+  savearr[0] = a;
+  CHECK(MXNDArraySave(path, 1, savearr, names));
+  uint32_t n_loaded = 0, n_names = 0;
+  NDArrayHandle *loaded = NULL;
+  const char **lnames = NULL;
+  CHECK(MXNDArrayLoad(path, &n_loaded, &loaded, &n_names, &lnames));
+  assert(n_loaded == 1 && n_names == 1 && strcmp(lnames[0], "a") == 0);
+  float back[12];
+  CHECK(MXNDArraySyncCopyToCPU(loaded[0], back, sizeof(back)));
+  assert(memcmp(back, data, sizeof(back)) == 0);
+
+  /* in-place host write */
+  float neg[12];
+  for (i = 0; i < 12; ++i) neg[i] = -1.0f;
+  CHECK(MXNDArraySyncCopyFromCPU(a, neg, sizeof(neg)));
+  CHECK(MXNDArraySyncCopyToCPU(a, back, sizeof(back)));
+  assert(back[0] == -1.0f && back[11] == -1.0f);
+
+  CHECK(MXNDArrayFree(loaded[0]));
+  CHECK(MXFreeHandleArray(loaded));
+  CHECK(MXNDArrayFree(row));
+  CHECK(MXNDArrayFree(sl));
+  CHECK(MXNDArrayFree(rs));
+  CHECK(MXNDArrayFree(a));
+  printf("ndarray tail OK\n");
+}
+
+static void kv_updater(int key, NDArrayHandle recv, NDArrayHandle local,
+                       void *handle) {
+  /* local += 2 * recv (a C-side optimizer rule) */
+  float r[4], l[4];
+  int i;
+  (void)key;
+  (void)handle;
+  if (MXNDArraySyncCopyToCPU(recv, r, sizeof(r)) != 0) exit(2);
+  if (MXNDArraySyncCopyToCPU(local, l, sizeof(l)) != 0) exit(2);
+  for (i = 0; i < 4; ++i) l[i] += 2.0f * r[i];
+  if (MXNDArraySyncCopyFromCPU(local, l, sizeof(l)) != 0) exit(2);
+}
+
+static void test_kvstore_updater(void) {
+  KVStoreHandle kv = NULL;
+  CHECK(MXKVStoreCreate("local", &kv));
+  const char *type = NULL;
+  CHECK(MXKVStoreGetType(kv, &type));
+  assert(strcmp(type, "local") == 0);
+  CHECK(MXKVStoreSetUpdater(kv, kv_updater, NULL));
+
+  int64_t shape[] = {4};
+  float ones[4] = {1, 1, 1, 1};
+  NDArrayHandle v = NULL, out = NULL;
+  CHECK(MXNDArrayCreate(ones, shape, 1, 0, &v));
+  int keys[] = {7};
+  NDArrayHandle vals[1];
+  vals[0] = v;
+  CHECK(MXKVStoreInit(kv, 1, keys, vals));
+  CHECK(MXKVStorePush(kv, 1, keys, vals, 0));
+  int64_t zshape[] = {4};
+  CHECK(MXNDArrayZeros(zshape, 1, 0, &out));
+  NDArrayHandle outs[1];
+  outs[0] = out;
+  CHECK(MXKVStorePull(kv, 1, keys, outs, 0));
+  float host[4];
+  CHECK(MXNDArraySyncCopyToCPU(out, host, sizeof(host)));
+  /* init 1 + updater(local += 2*push(1)) -> 3 */
+  assert(host[0] == 3.0f && host[3] == 3.0f);
+  CHECK(MXKVStoreBarrier(kv));
+  int is_worker = -1;
+  CHECK(MXKVStoreIsWorkerNode(&is_worker));
+  assert(is_worker == 1);
+  CHECK(MXNDArrayFree(v));
+  CHECK(MXNDArrayFree(out));
+  CHECK(MXKVStoreFree(kv));
+  printf("kvstore updater OK\n");
+}
+
+static void test_misc(void) {
+  CHECK(MXRandomSeed(42));
+  uint32_t n_ops = 0;
+  const char **ops = NULL;
+  CHECK(MXListAllOpNames(&n_ops, &ops));
+  assert(n_ops > 100);
+  int numpy_shape = 0;
+  CHECK(MXIsNumpyShape(&numpy_shape));
+  assert(numpy_shape == 1);
+  int tpus = -1, gpus = -1;
+  CHECK(MXGetTPUCount(&tpus));
+  assert(tpus >= 0); /* 0 under the CPU test platform; >0 on real TPU */
+  CHECK(MXGetGPUCount(&gpus));
+  assert(gpus == 0); /* TPU build has no CUDA devices by design */
+  int bulk_prev = -1;
+  CHECK(MXEngineSetBulkSize(16, &bulk_prev));
+  assert(bulk_prev >= 0);
+  printf("misc group OK (%u ops)\n", n_ops);
+}
+
+static int g_engine_calls = 0;
+static void engine_work(void *param) { g_engine_calls += *(int *)param; }
+
+static void test_engine(void) {
+  int val = 5;
+  CHECK(MXEnginePushSync(engine_work, &val, NULL, NULL, NULL, 0, NULL, 0));
+  assert(g_engine_calls == 5);
+  printf("engine group OK\n");
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <csv> <profile_json> <tmpdir>\n", argv[0]);
+    return 1;
+  }
+  CHECK(MXTPUInit());
+  test_misc();
+  test_symbol();
+  test_recordio(argv[3]);
+  test_data_iter(argv[1]);
+  test_ndarray_tail(argv[3]);
+  test_kvstore_updater();
+  test_engine();
+  test_profiler(argv[2]);
+  printf("ALL EXT C API TESTS PASSED\n");
+  return 0;
+}

@@ -326,3 +326,765 @@ def _capi_pred_forward(model, inputs):
     if not isinstance(out, tuple):
         out = (out,)
     return [mxnp.array(o) for o in out]
+
+
+# ==========================================================================
+# round-4 C ABI breadth (VERDICT-r3 Next #3): MXSymbol*, MXDataIter*/
+# Dataset/Batchify, MXProfile*, MXEngine*, MXRecordIO*, and the NDArray /
+# KVStore / misc tail. Same contract as above: plain functions over plain
+# types; handles are the Python objects themselves.
+# ==========================================================================
+
+# -- NDArray tail ----------------------------------------------------------
+def _capi_ndarray_create_none():
+    from . import np as mxnp
+    return mxnp.zeros((0,))
+
+
+def _capi_ndarray_copy_from_bytes(nd, buf):
+    a = _np.frombuffer(bytes(buf), dtype=str(nd.dtype)).reshape(nd.shape)
+    nd[...] = a
+    return True
+
+
+def _capi_ndarray_at(nd, idx):
+    return nd[int(idx)]
+
+
+def _capi_ndarray_slice(nd, start, stop):
+    return nd[int(start):int(stop)]
+
+
+def _capi_ndarray_reshape(nd, shape, reverse=0):
+    spec = [int(s) for s in shape]
+    if int(reverse):
+        # reference reverse inference: special values (0 = copy-dim,
+        # -1 = infer) match from the RIGHT; flipping both views reduces
+        # it to the forward rule
+        cur = list(nd.shape)[::-1]
+        spec = spec[::-1]
+        out = []
+        for i, d in enumerate(spec):
+            out.append(cur[i] if d == 0 and i < len(cur) else d)
+        return nd.reshape(tuple(out[::-1]))
+    return nd.reshape(tuple(spec))
+
+
+def _capi_ndarray_detach(nd):
+    return nd.detach()
+
+
+def _capi_ndarray_context(nd):
+    dev = nd.device
+    # reference dev_type codes: 1=cpu, 2=gpu; TPU reports as 6 (extension)
+    code = {"cpu": 1, "gpu": 2, "tpu": 6}.get(dev.device_type, 1)
+    return code, int(dev.device_id)
+
+
+def _capi_ndarray_wait_to_read(nd):
+    nd.wait_to_read()
+    return True
+
+
+def _capi_ndarray_storage_type(nd):
+    return 0   # kDefaultStorage; sparse storage unsupported by design
+
+
+def _capi_ndarray_save(fname, arrays, names):
+    from .ndarray import save
+    if names:
+        save(fname, dict(zip(names, arrays)))
+    else:
+        save(fname, list(arrays))
+    return True
+
+
+def _capi_ndarray_load(fname):
+    from .ndarray import load
+    from . import np as mxnp
+    data = load(fname)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names, arrays = [], list(data)
+    return names, arrays
+
+
+def _capi_ndarray_legacy_save(fname, arrays, names):
+    """Write the reference binary .params container."""
+    from .gluon.model_zoo.model_store import save_params_file
+    save_params_file(fname, {n: a.asnumpy()
+                             for n, a in zip(names, arrays)})
+    return True
+
+
+def _capi_random_seed(seed):
+    from . import random as _random
+    _random.seed(int(seed))
+    return True
+
+
+def _capi_list_all_op_names():
+    out = set()
+    from . import np as mxnp, npx
+    for ns in (mxnp, npx):
+        for nm in dir(ns):
+            if not nm.startswith("_") and callable(getattr(ns, nm, None)):
+                out.add(nm)
+    return sorted(out)
+
+
+def _capi_lib_features():
+    from .runtime import Features
+    return [(f.name, bool(f.enabled)) for f in Features().values()]
+
+
+def _capi_device_count(kind):
+    import jax
+    try:
+        if kind == "gpu":
+            return 0       # TPU build: no CUDA devices, by design
+        if kind == "tpu":
+            return sum(1 for d in jax.devices() if d.platform == "tpu")
+        return len(jax.devices())
+    except RuntimeError:
+        return 0
+
+
+def _capi_memory_info(_dev_id):
+    from .device import device_memory_info
+    info = device_memory_info()
+    return int(info.get("bytes_in_use", 0)), int(info.get("bytes_limit", 0))
+
+
+def _capi_is_numpy_shape():
+    return 1   # np-shape semantics are the only mode in this framework
+
+
+def _capi_is_numpy_default_dtype():
+    return 1
+
+
+# -- symbol group (≙ MXSymbol*, c_api.h:1448-2100) -------------------------
+def _capi_symbol_create_variable(name):
+    from . import symbol as sym
+    return sym.Variable(name)
+
+
+class _AtomicSymbol:
+    """Uncomposed op template (CreateAtomicSymbol -> Compose two-step)."""
+
+    def __init__(self, op, attrs):
+        self.op = op
+        self.attrs = attrs
+
+
+def _capi_symbol_create_atomic(op_name, keys, vals):
+    from . import symbol as sym
+    if op_name not in sym.list_legacy_ops():
+        raise MXNetError(f"unknown legacy op {op_name!r}")
+    return _AtomicSymbol(op_name, dict(zip(keys, vals)))
+
+
+def _capi_symbol_compose(holder, name, keys, args):
+    """In-place compose (reference MXSymbolCompose mutates the handle):
+    an _AtomicSymbol holder BECOMES the composed Symbol; a Symbol holder
+    gets its free variables substituted."""
+    from . import symbol as sym
+    if isinstance(holder, _AtomicSymbol):
+        maker = getattr(sym, holder.op)
+        kwargs = dict(holder.attrs)
+        if keys:
+            composed = maker(name=name or None,
+                             **dict(zip(keys, args)), **kwargs)
+        else:
+            composed = maker(*args, name=name or None, **kwargs)
+        holder.__class__ = sym.Symbol
+        holder.__dict__.clear()
+        holder._outputs = list(composed._outputs)
+        return True
+    if keys:
+        kwargs = dict(zip(keys, args))
+    else:
+        # positional composition: bind free variables in graph input order
+        kwargs = dict(zip(holder.list_inputs(), args))
+    composed = holder.compose(**kwargs)
+    holder._outputs = list(composed._outputs)
+    return True
+
+
+def _capi_symbol_from_json(json_str):
+    from . import symbol as sym
+    return sym.load_json(json_str)
+
+
+def _capi_symbol_to_json(s):
+    return s.tojson()
+
+
+def _capi_symbol_from_file(fname):
+    from . import symbol as sym
+    return sym.load(fname)
+
+
+def _capi_symbol_save_file(s, fname):
+    s.save(fname)
+    return True
+
+
+def _capi_symbol_copy(s):
+    from . import symbol as sym
+    return sym.load_json(s.tojson())
+
+
+def _capi_symbol_print(s):
+    return s.debug_str()
+
+
+def _capi_symbol_get_name(s):
+    return s.name or ""
+
+
+def _capi_symbol_get_attr(s, key):
+    v = s.attr(key)
+    return v if v is not None else ""
+
+
+def _capi_symbol_set_attr(s, key, value):
+    s._set_attr(**{key: value})
+    return True
+
+
+def _capi_symbol_list_attr(s):
+    flat = []
+    for nm, attrs in s.attr_dict().items():
+        for k, v in attrs.items():
+            flat.extend([f"{nm}${k}", str(v)])
+    return flat
+
+
+def _capi_symbol_list_attr_shallow(s):
+    flat = []
+    for k, v in s.list_attr().items():
+        flat.extend([k, str(v)])
+    return flat
+
+
+def _capi_symbol_list_arguments(s):
+    return s.list_arguments()
+
+
+def _capi_symbol_list_outputs(s):
+    return s.list_outputs()
+
+
+def _capi_symbol_list_aux(s):
+    return s.list_auxiliary_states()
+
+
+def _capi_symbol_get_internals(s):
+    return s.get_internals()
+
+
+def _capi_symbol_get_children(s):
+    c = s.get_children()
+    if c is None:
+        raise MXNetError("symbol has no children")
+    return c
+
+
+def _capi_symbol_get_output(s, idx):
+    return s[int(idx)]
+
+
+def _capi_symbol_num_outputs(s):
+    return s.num_outputs
+
+
+def _capi_symbol_get_inputs(s):
+    from . import symbol as sym
+    return sym.Group([sym.Variable(n) for n in s.list_inputs()])
+
+
+def _capi_symbol_create_group(symbols):
+    from . import symbol as sym
+    return sym.Group(list(symbols))
+
+
+def _capi_symbol_infer_shape(s, names, shapes, partial):
+    """Returns (arg_shapes, out_shapes, aux_shapes, complete) with -1 rows
+    for still-unknown entries when partial."""
+    kwargs = {n: tuple(sh) for n, sh in zip(names, shapes)}
+    try:
+        arg, out, aux = s.infer_shape(**kwargs)
+    except MXNetError:
+        if not partial:
+            raise
+        n_args = len(s.list_arguments())
+        n_aux = len(s.list_auxiliary_states())
+        return ([None] * n_args, [None] * s.num_outputs, [None] * n_aux, 0)
+    complete = int(all(x is not None for x in list(arg) + list(aux)))
+    return list(arg), list(out), list(aux), complete
+
+
+def _capi_symbol_infer_type(s, names, type_codes=None):
+    if type_codes:
+        kwargs = {n: str(_np_dtype(c)) for n, c in zip(names, type_codes)}
+    else:
+        kwargs = {n: "float32" for n in names}
+    arg, out, aux = s.infer_type(**kwargs)
+    to_code = lambda ds: [DTYPE_TO_CODE[str(_np.dtype(d))] for d in ds]
+    return to_code(arg), to_code(out), to_code(aux)
+
+
+def _capi_symbol_list_atomic_creators():
+    from . import symbol as sym
+    return sym.list_legacy_ops()
+
+
+def _capi_symbol_atomic_info(op_name):
+    from . import symbol as sym
+    if op_name not in sym.list_legacy_ops():
+        raise MXNetError(f"unknown legacy op {op_name!r}")
+    doc = f"legacy graph op {op_name} (executor: symbol/__init__.py)"
+    return op_name, doc
+
+
+# -- data iterator / dataset / batchify groups -----------------------------
+_DATAITER_CREATORS = ("NDArrayIter", "ImageRecordIter", "CSVIter",
+                      "LibSVMIter")
+
+
+def _capi_list_data_iters():
+    return list(_DATAITER_CREATORS)
+
+
+def _capi_data_iter_info(name):
+    if name not in _DATAITER_CREATORS:
+        raise MXNetError(f"unknown iterator {name!r}")
+    return name, f"{name} (io/__init__.py, ≙ reference src/io/iter_*.cc)"
+
+
+def _capi_data_iter_create(name, keys, vals):
+    from . import io as io_mod
+    import ast
+    if name not in _DATAITER_CREATORS:
+        raise MXNetError(f"unknown iterator {name!r}")
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        try:
+            kwargs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v
+    return _IterHandle(getattr(io_mod, name)(**kwargs))
+
+
+class _IterHandle:
+    """Current-batch cursor over a DataIter (the C iteration contract:
+    Next() then GetData()/GetLabel()/GetPadNum())."""
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+    def next(self):
+        try:
+            self.batch = next(self.it)
+            return 1
+        except StopIteration:
+            self.batch = None
+            return 0
+
+    def reset(self):
+        self.it.reset()
+        self.batch = None
+
+
+def _capi_data_iter_next(h):
+    return h.next()
+
+
+def _capi_data_iter_before_first(h):
+    h.reset()
+    return True
+
+
+def _capi_data_iter_data(h):
+    if h.batch is None:
+        raise MXNetError("no current batch: call MXDataIterNext first")
+    return h.batch.data[0]
+
+
+def _capi_data_iter_label(h):
+    if h.batch is None:
+        raise MXNetError("no current batch: call MXDataIterNext first")
+    if not h.batch.label:
+        raise MXNetError("iterator has no labels")
+    return h.batch.label[0]
+
+
+def _capi_data_iter_items(h):
+    if h.batch is None:
+        raise MXNetError("no current batch: call MXDataIterNext first")
+    return list(h.batch.data) + list(h.batch.label or [])
+
+
+def _capi_data_iter_pad_num(h):
+    if h.batch is None:
+        return 0
+    return int(getattr(h.batch, "pad", 0) or 0)
+
+
+def _capi_data_iter_index(h):
+    if h.batch is None or getattr(h.batch, "index", None) is None:
+        return []
+    return [int(i) for i in h.batch.index]
+
+
+def _capi_data_iter_len_hint(h):
+    try:
+        return len(h.it)
+    except TypeError:
+        return -1
+
+
+_DATASET_CREATORS = ("ArrayDataset", "RecordFileDataset", "ImageRecordDataset")
+
+
+def _capi_list_datasets():
+    return list(_DATASET_CREATORS)
+
+
+def _capi_dataset_info(name):
+    if name not in _DATASET_CREATORS:
+        raise MXNetError(f"unknown dataset {name!r}")
+    return name, f"{name} (gluon/data, ≙ reference gluon.data datasets)"
+
+
+def _capi_dataset_create(name, keys, vals):
+    import ast
+    from .gluon import data as gdata
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        try:
+            kwargs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v
+    if name == "ArrayDataset":
+        import numpy as np
+        arrs = [np.asarray(v) for k, v in sorted(kwargs.items())]
+        return gdata.ArrayDataset(*arrs)
+    if name == "RecordFileDataset":
+        return gdata.RecordFileDataset(**kwargs)
+    if name == "ImageRecordDataset":
+        return gdata.vision.ImageRecordDataset(**kwargs)
+    raise MXNetError(f"unknown dataset {name!r}")
+
+
+def _capi_dataset_len(ds):
+    return len(ds)
+
+
+def _capi_dataset_get_items(ds, idx):
+    from . import np as mxnp
+    from .ndarray import NDArray
+    item = ds[int(idx)]
+    if not isinstance(item, tuple):
+        item = (item,)
+    out = []
+    for x in item:
+        if isinstance(x, NDArray):
+            out.append(x)
+        elif isinstance(x, bytes):
+            out.append(mxnp.array(_np.frombuffer(x, _np.uint8)))
+        else:
+            out.append(mxnp.array(_np.asarray(x)))
+    return out
+
+
+_BATCHIFY_FUNCS = ("Stack", "Pad", "Group")
+
+
+def _capi_list_batchify():
+    return list(_BATCHIFY_FUNCS)
+
+
+def _capi_batchify_info(name):
+    if name not in _BATCHIFY_FUNCS:
+        raise MXNetError(f"unknown batchify {name!r}")
+    return name, f"batchify.{name} (gluon/data/batchify.py)"
+
+
+def _capi_batchify_create(name, keys, vals):
+    from .gluon.data import batchify
+    if name == "Stack":
+        return batchify.Stack()
+    if name == "Pad":
+        kw = dict(zip(keys, vals))
+        return batchify.Pad(val=float(kw.get("pad_val", 0)))
+    if name == "Group":
+        return batchify.Group(batchify.Stack(), batchify.Stack())
+    raise MXNetError(f"unknown batchify {name!r}")
+
+
+def _capi_batchify_invoke(fn, samples):
+    from .gluon.data import batchify as B
+    samples = list(samples)
+    if isinstance(fn, B.Group):
+        # the C wire is a FLAT handle array of num_samples*k entries
+        # (sample-major, ≙ MXBatchifyFunctionInvoke's inputs layout);
+        # regroup into per-sample component tuples
+        k = len(fn._fns)
+        if k and len(samples) % k:
+            raise MXNetError(
+                f"Group batchify got {len(samples)} arrays, not a "
+                f"multiple of its {k} components")
+        samples = [tuple(samples[i:i + k])
+                   for i in range(0, len(samples), k)]
+    out = fn(samples)
+    if not isinstance(out, (list, tuple)):
+        out = (out,)
+    return list(out)
+
+
+# -- profiler group (≙ MXProfile*, c_api.h:246-600) ------------------------
+def _capi_profiler_set_config(keys, vals):
+    from . import profiler
+    profiler.set_config(**dict(zip(keys, vals)))
+    return True
+
+
+def _capi_profiler_set_state(state):
+    from . import profiler
+    if int(state):
+        profiler.start()
+    else:
+        profiler.stop()
+    return True
+
+
+def _capi_profiler_pause(paused):
+    from . import profiler
+    if int(paused):
+        profiler.pause()
+    else:
+        profiler.resume()
+    return True
+
+
+def _capi_profiler_dump(finished, filename):
+    from . import profiler
+    profiler.dump(finished=bool(finished),
+                  filename=filename if filename else None)
+    return True
+
+
+def _capi_profiler_dumps(reset):
+    from . import profiler
+    return profiler.dumps(reset=bool(reset))
+
+
+def _capi_profile_create_domain(name):
+    from . import profiler
+    return profiler.Domain(name)
+
+
+def _capi_profile_create_task(domain, name):
+    from . import profiler
+    return profiler.Task(name, domain)
+
+
+def _capi_profile_create_frame(domain, name):
+    from . import profiler
+    return profiler.Frame(name, domain)
+
+
+def _capi_profile_create_event(name):
+    from . import profiler
+    return profiler.Event(name)
+
+
+def _capi_profile_create_counter(domain, name, value):
+    from . import profiler
+    c = profiler.Counter(domain, name)
+    if value is not None:
+        c.set_value(int(value))
+    return c
+
+
+def _capi_profile_duration_start(obj):
+    obj.start()
+    return True
+
+
+def _capi_profile_duration_stop(obj):
+    obj.stop()
+    return True
+
+
+def _capi_profile_set_counter(c, value):
+    c.set_value(int(value))
+    return True
+
+
+def _capi_profile_adjust_counter(c, delta):
+    c.increment(int(delta)) if delta >= 0 else c.decrement(-int(delta))
+    return True
+
+
+def _capi_profile_set_marker(domain, name, scope):
+    from . import profiler
+    profiler.Marker(domain, name).mark(scope or "process")
+    return True
+
+
+# -- engine group (≙ MXEngine*, c_api.h:3028-3119) -------------------------
+def _capi_engine_set_bulk_size(size):
+    from . import engine
+    prev = engine.effective_bulk_size()
+    engine.set_bulk_size(int(size))
+    return int(prev)
+
+
+def _capi_engine_push(fn_addr, param_addr, deleter_addr, is_async):
+    """Execute a C callback through the engine (≙ MXEnginePushSync/Async).
+
+    The TPU runtime has no user-visible dependency engine: callbacks run
+    inline after the current bulking segment flushes — the NaiveEngine
+    contract, which the reference also honors for sync pushes. The
+    caller's param deleter runs after the function completes (reference
+    EngineFuncParamDeleter contract)."""
+    import ctypes
+    from .ndarray import waitall
+    waitall()
+    try:
+        if int(is_async):
+            # async signature: void (*)(void* engine, void* param, void* cb)
+            CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_void_p)
+            CB(fn_addr)(None, ctypes.c_void_p(param_addr or 0), None)
+        else:
+            CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+            CB(fn_addr)(ctypes.c_void_p(param_addr or 0))
+    finally:
+        if deleter_addr:
+            DEL = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+            DEL(deleter_addr)(ctypes.c_void_p(param_addr or 0))
+    return True
+
+
+# -- recordio group (≙ MXRecordIO*, c_api.h:2810-2900) ---------------------
+def _capi_recordio_writer_create(path):
+    from .recordio import MXRecordIO
+    return MXRecordIO(path, "w")
+
+
+def _capi_recordio_reader_create(path):
+    from .recordio import MXRecordIO
+    return MXRecordIO(path, "r")
+
+
+def _capi_recordio_close(rec):
+    rec.close()
+    return True
+
+
+def _capi_recordio_write(rec, buf):
+    rec.write(bytes(buf))
+    return True
+
+
+def _capi_recordio_read(rec):
+    data = rec.read()
+    return data if data is not None else b""
+
+
+def _capi_recordio_tell(rec):
+    return int(rec.tell())
+
+
+def _capi_recordio_seek(rec, pos):
+    rec.seek(int(pos))
+    return True
+
+
+# -- kvstore tail ----------------------------------------------------------
+def _capi_kv_type(kv):
+    return kv.type
+
+
+def _capi_kv_barrier(kv):
+    kv.barrier()
+    return True
+
+
+def _capi_kv_pushpull(kv, keys, invals, outvals, priority):
+    for k, vin, vout in zip(keys, invals, outvals):
+        kv.pushpull(int(k), vin, out=vout, priority=priority)
+    return True
+
+
+def _capi_kv_broadcast(kv, keys, invals, outvals, priority):
+    for k, vin, vout in zip(keys, invals, outvals):
+        kv.broadcast(int(k), vin, out=vout, priority=priority)
+    return True
+
+
+def _capi_kv_set_compression(kv, keys, vals):
+    params = {}
+    for k, v in zip(keys, vals):
+        params[k] = float(v) if k == "threshold" else v
+    kv.set_gradient_compression(params)
+    return True
+
+
+def _capi_kv_init_str(kv, keys, vals):
+    for k, v in zip(keys, vals):
+        kv.init(k, v)
+    return True
+
+
+def _capi_kv_push_str(kv, keys, vals, priority):
+    for k, v in zip(keys, vals):
+        kv.push(k, v, priority=priority)
+    return True
+
+
+def _capi_kv_pull_str(kv, keys, outs, priority):
+    for k, o in zip(keys, outs):
+        kv.pull(k, out=o, priority=priority)
+    return True
+
+
+def _capi_kv_set_updater(kv, fn_addr, handle_addr):
+    """C-callback updater (≙ MXKVStoreSetUpdater): the callback receives
+    (key, recv NDArrayHandle, local NDArrayHandle, user handle). Handles
+    are borrowed PyObject* valid for the duration of the call."""
+    import ctypes
+    CB = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                          ctypes.c_void_p, ctypes.c_void_p)
+    cb = CB(fn_addr)
+
+    def updater(key, recv, local):
+        cb(int(key), id(recv), id(local),
+           ctypes.c_void_p(handle_addr or 0))
+
+    kv.set_updater(updater)
+    return True
+
+
+def _capi_kv_is_worker(_kv):
+    return 1   # SPMD runtime: every process is a worker (no server nodes)
+
+
+def _capi_kv_is_server(_kv):
+    return 0
+
+
+def _capi_kv_is_scheduler(_kv):
+    return 0
+
+
+def _capi_kv_num_dead(_kv, _node_id):
+    return 0   # PJRT surfaces failures as errors, not dead-node counts

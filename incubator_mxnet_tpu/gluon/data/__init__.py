@@ -4,4 +4,5 @@ from .dataset import (Dataset, SimpleDataset, ArrayDataset,
 from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler,
                       FilterSampler)
 from .dataloader import DataLoader, default_batchify_fn
+from . import batchify
 from . import vision

@@ -17,8 +17,10 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <initializer_list>
 #include <mutex>
 #include <string>
+#include <vector>
 
 namespace {
 
@@ -599,5 +601,1469 @@ int MXPredForward(PredictorHandle handle, int num_inputs,
 }
 
 int MXPredFree(PredictorHandle handle) { return MXNDArrayFree(handle); }
+
+}  // extern "C"
+
+// ==========================================================================
+// Round-4 breadth (VERDICT-r3 Next #3): MXSymbol*, MXDataIter*/Dataset/
+// Batchify, MXProfile*, MXEngine*, MXRecordIO*, NDArray/KVStore/misc tail.
+// Same architecture: thin thread-safe marshalling into deploy._capi_*.
+// String/list returns use thread-local storage valid until the next call
+// on the same thread (the reference's MXAPIThreadLocalEntry contract).
+// ==========================================================================
+
+namespace {
+
+thread_local std::vector<std::string> tl_strs;
+thread_local std::vector<const char *> tl_ptrs;
+thread_local std::string tl_str;
+thread_local std::vector<int> tl_ndims[3];
+thread_local std::vector<std::vector<int64_t>> tl_shape_rows[3];
+thread_local std::vector<const int64_t *> tl_shape_ptrs[3];
+thread_local std::vector<int> tl_types[3];
+
+// Build an args tuple from new references (steals them).
+PyObject *tup(std::initializer_list<PyObject *> xs) {
+  PyObject *t = PyTuple_New(static_cast<Py_ssize_t>(xs.size()));
+  Py_ssize_t i = 0;
+  for (PyObject *x : xs) PyTuple_SET_ITEM(t, i++, x);
+  return t;
+}
+
+PyObject *incref(void *h) {
+  PyObject *o = reinterpret_cast<PyObject *>(h);
+  Py_INCREF(o);
+  return o;
+}
+
+PyObject *str_or_empty(const char *s) {
+  return PyUnicode_FromString(s ? s : "");
+}
+
+PyObject *str_list(int n, const char **xs) {
+  PyObject *l = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, str_or_empty(xs ? xs[i] : ""));
+  return l;
+}
+
+// result -> new handle
+int ret_handle(PyObject *r, void **out) {
+  if (!r) return -1;
+  *out = r;
+  return 0;
+}
+
+int ret_int(PyObject *r, int *out) {
+  if (!r) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int ret_int64(PyObject *r, int64_t *out) {
+  if (!r) return -1;
+  *out = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int ret_none(PyObject *r) {
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int ret_cstr(PyObject *r, const char **out) {
+  if (!r) return -1;
+  const char *c = PyUnicode_AsUTF8(r);
+  tl_str = c ? c : "";
+  Py_DECREF(r);
+  *out = tl_str.c_str();
+  return 0;
+}
+
+int ret_cstr_list(PyObject *r, uint32_t *out_size,
+                  const char ***out_array) {
+  if (!r) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  tl_strs.clear();
+  tl_ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *c = PyUnicode_AsUTF8(PyList_GET_ITEM(r, i));
+    tl_strs.emplace_back(c ? c : "");
+  }
+  for (auto &s : tl_strs) tl_ptrs.push_back(s.c_str());
+  Py_DECREF(r);
+  *out_size = static_cast<uint32_t>(n);
+  *out_array = tl_ptrs.data();
+  return 0;
+}
+
+int ret_handle_list(PyObject *r, int *num_out, void ***out) {
+  if (!r) return -1;
+  int rc = list_to_handles(r, num_out, out);
+  Py_DECREF(r);
+  return rc;
+}
+
+// generic single-handle call shapes
+int h_call_none(const char *fn, void *h) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(fn, tup({incref(h)})));
+}
+
+int h_call_handle(const char *fn, void *h, void **out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy(fn, tup({incref(h)})), out);
+}
+
+int h_call_int(const char *fn, void *h, int *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_int(call_deploy(fn, tup({incref(h)})), out);
+}
+
+int h_call_cstr(const char *fn, void *h, const char **out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_cstr(call_deploy(fn, tup({incref(h)})), out);
+}
+
+int h_call_cstr_list(const char *fn, void *h, uint32_t *out_size,
+                     const char ***out_array) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_cstr_list(call_deploy(fn, tup({incref(h)})), out_size,
+                       out_array);
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void *SymbolHandle;
+typedef void *DataIterHandle;
+typedef void *DatasetHandle;
+typedef void *BatchifyFunctionHandle;
+typedef void *ProfileHandle;
+typedef void *RecordIOHandle;
+
+// ---- NDArray tail --------------------------------------------------------
+
+int MXNDArrayCreateNone(NDArrayHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_ndarray_create_none", tup({})), out);
+}
+
+int MXNDArrayCreate64(const void *data, const int64_t *shape, int ndim,
+                      int dtype, NDArrayHandle *out) {
+  return MXNDArrayCreate(data, shape, ndim, dtype, out);
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t nbytes) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *buf = PyBytes_FromStringAndSize(
+      static_cast<const char *>(data), static_cast<Py_ssize_t>(nbytes));
+  return ret_none(call_deploy("_capi_ndarray_copy_from_bytes",
+                              tup({incref(handle), buf})));
+}
+
+int MXNDArrayAt(NDArrayHandle handle, uint32_t idx, NDArrayHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_ndarray_at",
+                                tup({incref(handle),
+                                     PyLong_FromLong(idx)})), out);
+}
+
+int MXNDArrayAt64(NDArrayHandle handle, int64_t idx, NDArrayHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_ndarray_at",
+                                tup({incref(handle),
+                                     PyLong_FromLongLong(idx)})), out);
+}
+
+int MXNDArraySlice(NDArrayHandle handle, uint32_t start, uint32_t stop,
+                   NDArrayHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_ndarray_slice",
+                                tup({incref(handle), PyLong_FromLong(start),
+                                     PyLong_FromLong(stop)})), out);
+}
+
+int MXNDArraySlice64(NDArrayHandle handle, int64_t start, int64_t stop,
+                     NDArrayHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_ndarray_slice",
+                                tup({incref(handle),
+                                     PyLong_FromLongLong(start),
+                                     PyLong_FromLongLong(stop)})), out);
+}
+
+int MXNDArrayReshape64(NDArrayHandle handle, int ndim, const int64_t *shape,
+                       int reverse, NDArrayHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_ndarray_reshape",
+                                tup({incref(handle),
+                                     shape_to_list(shape, ndim),
+                                     PyLong_FromLong(reverse)})), out);
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *shape,
+                     NDArrayHandle *out) {
+  std::vector<int64_t> s(shape, shape + ndim);
+  return MXNDArrayReshape64(handle, ndim, s.data(), 0, out);
+}
+
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out) {
+  return h_call_handle("_capi_ndarray_detach", handle, out);
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *r = call_deploy("_capi_ndarray_context", tup({incref(handle)}));
+  if (!r) return -1;
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  return h_call_none("_capi_ndarray_wait_to_read", handle);
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  return h_call_none("_capi_ndarray_wait_to_read", handle);
+}
+
+int MXNDArrayGetShape64(NDArrayHandle handle, int *out_dim,
+                        const int64_t **out_pdata) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *l = call_deploy("_capi_ndarray_shape", tup({incref(handle)}));
+  if (!l) return -1;
+  thread_local std::vector<int64_t> shape_buf;
+  shape_buf.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(l); ++i)
+    shape_buf.push_back(PyLong_AsLongLong(PyList_GET_ITEM(l, i)));
+  Py_DECREF(l);
+  *out_dim = static_cast<int>(shape_buf.size());
+  *out_pdata = shape_buf.data();
+  return 0;
+}
+
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out) {
+  return h_call_int("_capi_ndarray_storage_type", handle, out);
+}
+
+int MXNDArraySave(const char *fname, uint32_t num_args,
+                  NDArrayHandle *args, const char **keys) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *names = keys ? str_list(num_args, keys) : PyList_New(0);
+  return ret_none(call_deploy(
+      "_capi_ndarray_save",
+      tup({str_or_empty(fname), handles_to_list(num_args, args), names})));
+}
+
+int MXNDArrayLoad(const char *fname, uint32_t *out_size,
+                  NDArrayHandle **out_arr, uint32_t *out_name_size,
+                  const char ***out_names) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *r = call_deploy("_capi_ndarray_load", tup({str_or_empty(fname)}));
+  if (!r) return -1;
+  PyObject *names = PyTuple_GetItem(r, 0);
+  PyObject *arrays = PyTuple_GetItem(r, 1);
+  int n = 0;
+  void **arr = nullptr;
+  Py_INCREF(names);
+  if (list_to_handles(arrays, &n, &arr) != 0) {
+    Py_DECREF(names);
+    Py_DECREF(r);
+    return -1;
+  }
+  *out_size = static_cast<uint32_t>(n);
+  *out_arr = arr;
+  int rc = ret_cstr_list(names, out_name_size, out_names);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXNDArrayLegacySave(const char *fname, uint32_t num_args,
+                        NDArrayHandle *args, const char **keys) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(
+      "_capi_ndarray_legacy_save",
+      tup({str_or_empty(fname), handles_to_list(num_args, args),
+           str_list(num_args, keys)})));
+}
+
+int MXShallowCopyNDArray(NDArrayHandle handle, NDArrayHandle *out) {
+  *out = incref(handle);
+  return 0;
+}
+
+// ---- misc ----------------------------------------------------------------
+
+int MXRandomSeed(int seed) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy("_capi_random_seed",
+                              tup({PyLong_FromLong(seed)})));
+}
+
+int MXRandomSeedContext(int seed, int dev_type, int dev_id) {
+  (void)dev_type;
+  (void)dev_id;
+  return MXRandomSeed(seed);
+}
+
+int MXListAllOpNames(uint32_t *out_size, const char ***out_array) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_cstr_list(call_deploy("_capi_list_all_op_names", tup({})),
+                       out_size, out_array);
+}
+
+int MXLibInfoFeatures(const void **out, size_t *out_size) {
+  // features surface through the Python runtime.Features(); the C shape
+  // returns the names only, as a string list in *out
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *r = call_deploy("_capi_lib_features", tup({}));
+  if (!r) return -1;
+  tl_strs.clear();
+  tl_ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(r); ++i) {
+    PyObject *pair = PyList_GET_ITEM(r, i);
+    const char *nm = PyUnicode_AsUTF8(PyTuple_GetItem(pair, 0));
+    int on = PyObject_IsTrue(PyTuple_GetItem(pair, 1));
+    tl_strs.emplace_back(std::string(nm ? nm : "") + (on ? "=1" : "=0"));
+  }
+  for (auto &s : tl_strs) tl_ptrs.push_back(s.c_str());
+  Py_DECREF(r);
+  *out = tl_ptrs.data();
+  *out_size = tl_ptrs.size();
+  return 0;
+}
+
+int MXGetGPUCount(int *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_int(call_deploy("_capi_device_count",
+                             tup({str_or_empty("gpu")})), out);
+}
+
+int MXGetTPUCount(int *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_int(call_deploy("_capi_device_count",
+                             tup({str_or_empty("tpu")})), out);
+}
+
+int MXGetGPUMemoryInformation64(int dev, uint64_t *free_mem,
+                                uint64_t *total_mem) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *r = call_deploy("_capi_memory_info", tup({PyLong_FromLong(dev)}));
+  if (!r) return -1;
+  uint64_t used = PyLong_AsUnsignedLongLong(PyTuple_GetItem(r, 0));
+  uint64_t limit = PyLong_AsUnsignedLongLong(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  *total_mem = limit;
+  *free_mem = limit > used ? limit - used : 0;
+  return 0;
+}
+
+int MXSetNumOMPThreads(int n) { (void)n; return 0; }
+int MXSetFlushDenorms(int on, int *prev) {
+  if (prev) *prev = 0;
+  (void)on;
+  return 0;
+}
+
+int MXIsNumpyShape(int *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_int(call_deploy("_capi_is_numpy_shape", tup({})), out);
+}
+
+int MXSetIsNumpyShape(int flag, int *prev) {
+  if (prev) *prev = 1;
+  if (!flag) {
+    set_error("legacy (non-numpy) shape semantics are not supported in "
+              "this build: np-shape is the only mode");
+    return -1;
+  }
+  return 0;
+}
+
+int MXIsNumpyDefaultDtype(int *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_int(call_deploy("_capi_is_numpy_default_dtype", tup({})), out);
+}
+
+int MXSetIsNumpyDefaultDtype(int flag, int *prev) {
+  if (prev) *prev = 1;
+  (void)flag;
+  return 0;
+}
+
+int MXNotifyShutdown(void) { return MXNDArrayWaitAll(); }
+
+int MXStorageEmptyCache(int dev_type, int dev_id) {
+  (void)dev_type;
+  (void)dev_id;
+  return 0;  // PJRT owns pooling; there is no user-facing cache to empty
+}
+
+// ---- symbol group (≙ MXSymbol*, c_api.h:1448-2100) -----------------------
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_symbol_create_variable",
+                                tup({str_or_empty(name)})), out);
+}
+
+int MXSymbolCreateAtomicSymbol(const char *op_name, uint32_t num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy(
+      "_capi_symbol_create_atomic",
+      tup({str_or_empty(op_name), str_list(num_param, keys),
+           str_list(num_param, vals)})), out);
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, uint32_t num_args,
+                    const char **keys, SymbolHandle *args) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  // reference semantics: compose mutates the handle in place (the deploy
+  // side rebinds the holder object to the composed symbol)
+  return ret_none(call_deploy(
+      "_capi_symbol_compose",
+      tup({incref(sym), str_or_empty(name),
+           keys ? str_list(num_args, keys) : PyList_New(0),
+           handles_to_list(num_args, args)})));
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_symbol_from_json",
+                                tup({str_or_empty(json)})), out);
+}
+
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json) {
+  return h_call_cstr("_capi_symbol_to_json", sym, out_json);
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_symbol_from_file",
+                                tup({str_or_empty(fname)})), out);
+}
+
+int MXSymbolSaveToFile(SymbolHandle sym, const char *fname) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy("_capi_symbol_save_file",
+                              tup({incref(sym), str_or_empty(fname)})));
+}
+
+int MXSymbolFree(SymbolHandle sym) { return MXNDArrayFree(sym); }
+
+int MXSymbolCopy(SymbolHandle sym, SymbolHandle *out) {
+  return h_call_handle("_capi_symbol_copy", sym, out);
+}
+
+int MXSymbolPrint(SymbolHandle sym, const char **out_str) {
+  return h_call_cstr("_capi_symbol_print", sym, out_str);
+}
+
+int MXSymbolGetName(SymbolHandle sym, const char **out, int *success) {
+  int rc = h_call_cstr("_capi_symbol_get_name", sym, out);
+  if (success) *success = (rc == 0 && **out) ? 1 : 0;
+  return rc;
+}
+
+int MXSymbolGetAttr(SymbolHandle sym, const char *key, const char **out,
+                    int *success) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  int rc = ret_cstr(call_deploy("_capi_symbol_get_attr",
+                                tup({incref(sym), str_or_empty(key)})), out);
+  if (success) *success = (rc == 0 && **out) ? 1 : 0;
+  return rc;
+}
+
+int MXSymbolSetAttr(SymbolHandle sym, const char *key, const char *value) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(
+      "_capi_symbol_set_attr",
+      tup({incref(sym), str_or_empty(key), str_or_empty(value)})));
+}
+
+int MXSymbolListAttr(SymbolHandle sym, uint32_t *out_size,
+                     const char ***out) {
+  return h_call_cstr_list("_capi_symbol_list_attr", sym, out_size, out);
+}
+
+int MXSymbolListAttrShallow(SymbolHandle sym, uint32_t *out_size,
+                            const char ***out) {
+  return h_call_cstr_list("_capi_symbol_list_attr_shallow", sym, out_size,
+                          out);
+}
+
+int MXSymbolListArguments(SymbolHandle sym, uint32_t *out_size,
+                          const char ***out_str_array) {
+  return h_call_cstr_list("_capi_symbol_list_arguments", sym, out_size,
+                          out_str_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle sym, uint32_t *out_size,
+                        const char ***out_str_array) {
+  return h_call_cstr_list("_capi_symbol_list_outputs", sym, out_size,
+                          out_str_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, uint32_t *out_size,
+                                const char ***out_str_array) {
+  return h_call_cstr_list("_capi_symbol_list_aux", sym, out_size,
+                          out_str_array);
+}
+
+int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle *out) {
+  return h_call_handle("_capi_symbol_get_internals", sym, out);
+}
+
+int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle *out) {
+  return h_call_handle("_capi_symbol_get_children", sym, out);
+}
+
+int MXSymbolGetOutput(SymbolHandle sym, uint32_t index, SymbolHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_symbol_get_output",
+                                tup({incref(sym), PyLong_FromLong(index)})),
+                    out);
+}
+
+int MXSymbolGetNumOutputs(SymbolHandle sym, uint32_t *output_count) {
+  int n = 0;
+  int rc = h_call_int("_capi_symbol_num_outputs", sym, &n);
+  *output_count = static_cast<uint32_t>(n);
+  return rc;
+}
+
+int MXSymbolGetInputs(SymbolHandle sym, SymbolHandle *out) {
+  return h_call_handle("_capi_symbol_get_inputs", sym, out);
+}
+
+int MXSymbolGetInputSymbols(SymbolHandle sym, SymbolHandle **out,
+                            int *out_size) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *grouped = call_deploy("_capi_symbol_get_inputs",
+                                  tup({incref(sym)}));
+  if (!grouped) return -1;
+  PyObject *outputs = PyObject_GetAttrString(grouped, "_outputs");
+  if (!outputs) {
+    Py_DECREF(grouped);
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(outputs);
+  Py_DECREF(outputs);
+  // expose each input as its own single-output symbol handle
+  void **arr = static_cast<void **>(std::malloc(sizeof(void *) * n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *one = call_deploy(
+        "_capi_symbol_get_output",
+        tup({incref(grouped), PyLong_FromSsize_t(i)}));
+    if (!one) {
+      for (Py_ssize_t j = 0; j < i; ++j)
+        Py_DECREF(reinterpret_cast<PyObject *>(arr[j]));
+      std::free(arr);
+      Py_DECREF(grouped);
+      return -1;
+    }
+    arr[i] = one;
+  }
+  Py_DECREF(grouped);
+  *out = arr;
+  *out_size = static_cast<int>(n);
+  return 0;
+}
+
+int MXSymbolCreateGroup(uint32_t num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_symbol_create_group",
+                                tup({handles_to_list(num_symbols,
+                                                     symbols)})), out);
+}
+
+int MXShallowCopySymbol(SymbolHandle sym, SymbolHandle *out) {
+  *out = incref(sym);
+  return 0;
+}
+
+int MXSymbolListAtomicSymbolCreators(uint32_t *out_size,
+                                     const char ***out_array) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_cstr_list(call_deploy("_capi_symbol_list_atomic_creators",
+                                   tup({})), out_size, out_array);
+}
+
+int MXSymbolGetAtomicSymbolName(const char *creator, const char **name) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *r = call_deploy("_capi_symbol_atomic_info",
+                            tup({str_or_empty(creator)}));
+  if (!r) return -1;
+  const char *c = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  tl_str = c ? c : "";
+  Py_DECREF(r);
+  *name = tl_str.c_str();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolInfo(const char *creator, const char **name,
+                                const char **description) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *r = call_deploy("_capi_symbol_atomic_info",
+                            tup({str_or_empty(creator)}));
+  if (!r) return -1;
+  thread_local std::string nm_buf, doc_buf;
+  const char *c0 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  const char *c1 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  nm_buf = c0 ? c0 : "";
+  doc_buf = c1 ? c1 : "";
+  Py_DECREF(r);
+  *name = nm_buf.c_str();
+  *description = doc_buf.c_str();
+  return 0;
+}
+
+namespace {
+
+// shared CSR-shape marshalling for InferShape{,Partial}
+int infer_shape_impl(SymbolHandle sym, uint32_t num_args, const char **keys,
+                     const int64_t *arg_ind_ptr,
+                     const int64_t *arg_shape_data, int partial,
+                     size_t *in_shape_size, const int **in_shape_ndim,
+                     const int64_t ***in_shape_data, size_t *out_shape_size,
+                     const int **out_shape_ndim,
+                     const int64_t ***out_shape_data, size_t *aux_shape_size,
+                     const int **aux_shape_ndim,
+                     const int64_t ***aux_shape_data, int *complete) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *names = str_list(num_args, keys);
+  PyObject *shapes = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    int64_t lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject *s = PyList_New(hi - lo);
+    for (int64_t j = lo; j < hi; ++j)
+      PyList_SET_ITEM(s, j - lo, PyLong_FromLongLong(arg_shape_data[j]));
+    PyList_SET_ITEM(shapes, i, s);
+  }
+  PyObject *r = call_deploy(
+      "_capi_symbol_infer_shape",
+      tup({incref(sym), names, shapes, PyLong_FromLong(partial)}));
+  if (!r) return -1;
+  size_t *sizes[3] = {in_shape_size, out_shape_size, aux_shape_size};
+  const int **ndims[3] = {in_shape_ndim, out_shape_ndim, aux_shape_ndim};
+  const int64_t ***datas[3] = {in_shape_data, out_shape_data,
+                               aux_shape_data};
+  for (int g = 0; g < 3; ++g) {
+    PyObject *group = PyTuple_GetItem(r, g);
+    Py_ssize_t n = PyList_Size(group);
+    tl_ndims[g].clear();
+    tl_shape_rows[g].clear();
+    tl_shape_ptrs[g].clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *row = PyList_GET_ITEM(group, i);
+      std::vector<int64_t> dims;
+      if (row != Py_None) {
+        for (Py_ssize_t j = 0; j < PyTuple_Size(row); ++j)
+          dims.push_back(PyLong_AsLongLong(PyTuple_GET_ITEM(row, j)));
+        tl_ndims[g].push_back(static_cast<int>(dims.size()));
+      } else {
+        tl_ndims[g].push_back(-1);
+      }
+      tl_shape_rows[g].push_back(std::move(dims));
+    }
+    for (auto &row : tl_shape_rows[g]) tl_shape_ptrs[g].push_back(row.data());
+    *sizes[g] = static_cast<size_t>(n);
+    *ndims[g] = tl_ndims[g].data();
+    *datas[g] = tl_shape_ptrs[g].data();
+  }
+  *complete = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 3)));
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+int MXSymbolInferShape64(SymbolHandle sym, uint32_t num_args,
+                         const char **keys, const int64_t *arg_ind_ptr,
+                         const int64_t *arg_shape_data,
+                         size_t *in_shape_size, const int **in_shape_ndim,
+                         const int64_t ***in_shape_data,
+                         size_t *out_shape_size, const int **out_shape_ndim,
+                         const int64_t ***out_shape_data,
+                         size_t *aux_shape_size, const int **aux_shape_ndim,
+                         const int64_t ***aux_shape_data, int *complete) {
+  return infer_shape_impl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                          0, in_shape_size, in_shape_ndim, in_shape_data,
+                          out_shape_size, out_shape_ndim, out_shape_data,
+                          aux_shape_size, aux_shape_ndim, aux_shape_data,
+                          complete);
+}
+
+int MXSymbolInferShapePartial64(
+    SymbolHandle sym, uint32_t num_args, const char **keys,
+    const int64_t *arg_ind_ptr, const int64_t *arg_shape_data,
+    size_t *in_shape_size, const int **in_shape_ndim,
+    const int64_t ***in_shape_data, size_t *out_shape_size,
+    const int **out_shape_ndim, const int64_t ***out_shape_data,
+    size_t *aux_shape_size, const int **aux_shape_ndim,
+    const int64_t ***aux_shape_data, int *complete) {
+  return infer_shape_impl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                          1, in_shape_size, in_shape_ndim, in_shape_data,
+                          out_shape_size, out_shape_ndim, out_shape_data,
+                          aux_shape_size, aux_shape_ndim, aux_shape_data,
+                          complete);
+}
+
+int MXSymbolInferType(SymbolHandle sym, uint32_t num_args, const char **keys,
+                      const int *arg_type_data, uint32_t *in_type_size,
+                      const int **in_type_data, uint32_t *out_type_size,
+                      const int **out_type_data, uint32_t *aux_type_size,
+                      const int **aux_type_data, int *complete) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *codes = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i)
+    PyList_SET_ITEM(codes, i,
+                    PyLong_FromLong(arg_type_data ? arg_type_data[i] : 0));
+  PyObject *r = call_deploy("_capi_symbol_infer_type",
+                            tup({incref(sym), str_list(num_args, keys),
+                                 codes}));
+  if (!r) return -1;
+  uint32_t *sizes[3] = {in_type_size, out_type_size, aux_type_size};
+  const int **datas[3] = {in_type_data, out_type_data, aux_type_data};
+  for (int g = 0; g < 3; ++g) {
+    PyObject *group = PyTuple_GetItem(r, g);
+    Py_ssize_t n = PyList_Size(group);
+    tl_types[g].clear();
+    for (Py_ssize_t i = 0; i < n; ++i)
+      tl_types[g].push_back(
+          static_cast<int>(PyLong_AsLong(PyList_GET_ITEM(group, i))));
+    *sizes[g] = static_cast<uint32_t>(n);
+    *datas[g] = tl_types[g].data();
+  }
+  *complete = 1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// ---- data iterator / dataset / batchify ----------------------------------
+
+int MXListDataIters(uint32_t *out_size, DataIterHandle **out_array) {
+  // creator handles ARE interned name strings
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *r = call_deploy("_capi_list_data_iters", tup({}));
+  if (!r) return -1;
+  return ret_handle_list(r, reinterpret_cast<int *>(out_size),
+                         reinterpret_cast<void ***>(out_array));
+}
+
+int MXDataIterGetIterInfo(DataIterHandle creator, const char **name,
+                          const char **description, uint32_t *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *r = call_deploy("_capi_data_iter_info", tup({incref(creator)}));
+  if (!r) return -1;
+  thread_local std::string nm_buf, doc_buf;
+  const char *c0 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  const char *c1 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  nm_buf = c0 ? c0 : "";
+  doc_buf = c1 ? c1 : "";
+  Py_DECREF(r);
+  *name = nm_buf.c_str();
+  *description = doc_buf.c_str();
+  if (num_args) *num_args = 0;
+  if (arg_names) *arg_names = nullptr;
+  if (arg_type_infos) *arg_type_infos = nullptr;
+  if (arg_descriptions) *arg_descriptions = nullptr;
+  return 0;
+}
+
+int MXDataIterCreateIter(DataIterHandle creator, uint32_t num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy(
+      "_capi_data_iter_create",
+      tup({incref(creator), str_list(num_param, keys),
+           str_list(num_param, vals)})), out);
+}
+
+int MXDataIterFree(DataIterHandle handle) { return MXNDArrayFree(handle); }
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  return h_call_int("_capi_data_iter_next", handle, out);
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  return h_call_none("_capi_data_iter_before_first", handle);
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  return h_call_handle("_capi_data_iter_data", handle, out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  return h_call_handle("_capi_data_iter_label", handle, out);
+}
+
+int MXDataIterGetItems(DataIterHandle handle, int *num_outputs,
+                       NDArrayHandle **outputs) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle_list(call_deploy("_capi_data_iter_items",
+                                     tup({incref(handle)})),
+                         num_outputs, outputs);
+}
+
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *r = call_deploy("_capi_data_iter_index", tup({incref(handle)}));
+  if (!r) return -1;
+  thread_local std::vector<uint64_t> idx_buf;
+  idx_buf.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(r); ++i)
+    idx_buf.push_back(PyLong_AsUnsignedLongLong(PyList_GET_ITEM(r, i)));
+  Py_DECREF(r);
+  *out_index = idx_buf.data();
+  *out_size = idx_buf.size();
+  return 0;
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  return h_call_int("_capi_data_iter_pad_num", handle, pad);
+}
+
+int MXDataIterGetLenHint(DataIterHandle handle, int64_t *len) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_int64(call_deploy("_capi_data_iter_len_hint",
+                               tup({incref(handle)})), len);
+}
+
+int MXListDatasets(uint32_t *out_size, DatasetHandle **out_array) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle_list(call_deploy("_capi_list_datasets", tup({})),
+                         reinterpret_cast<int *>(out_size),
+                         reinterpret_cast<void ***>(out_array));
+}
+
+int MXDatasetGetDatasetInfo(DatasetHandle creator, const char **name,
+                            const char **description, uint32_t *num_args,
+                            const char ***arg_names,
+                            const char ***arg_type_infos,
+                            const char ***arg_descriptions) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *r = call_deploy("_capi_dataset_info", tup({incref(creator)}));
+  if (!r) return -1;
+  thread_local std::string nm_buf, doc_buf;
+  const char *c0 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  const char *c1 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  nm_buf = c0 ? c0 : "";
+  doc_buf = c1 ? c1 : "";
+  Py_DECREF(r);
+  *name = nm_buf.c_str();
+  *description = doc_buf.c_str();
+  if (num_args) *num_args = 0;
+  if (arg_names) *arg_names = nullptr;
+  if (arg_type_infos) *arg_type_infos = nullptr;
+  if (arg_descriptions) *arg_descriptions = nullptr;
+  return 0;
+}
+
+int MXDatasetCreateDataset(DatasetHandle creator, uint32_t num_param,
+                           const char **keys, const char **vals,
+                           DatasetHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy(
+      "_capi_dataset_create",
+      tup({incref(creator), str_list(num_param, keys),
+           str_list(num_param, vals)})), out);
+}
+
+int MXDatasetFree(DatasetHandle handle) { return MXNDArrayFree(handle); }
+
+int MXDatasetGetLen(DatasetHandle handle, uint64_t *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *r = call_deploy("_capi_dataset_len", tup({incref(handle)}));
+  if (!r) return -1;
+  *out = PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDatasetGetItems(DatasetHandle handle, uint64_t index,
+                      int *num_outputs, NDArrayHandle **outputs) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle_list(
+      call_deploy("_capi_dataset_get_items",
+                  tup({incref(handle),
+                       PyLong_FromUnsignedLongLong(index)})),
+      num_outputs, outputs);
+}
+
+int MXListBatchifyFunctions(uint32_t *out_size,
+                            BatchifyFunctionHandle **out_array) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle_list(call_deploy("_capi_list_batchify", tup({})),
+                         reinterpret_cast<int *>(out_size),
+                         reinterpret_cast<void ***>(out_array));
+}
+
+int MXBatchifyFunctionGetFunctionInfo(BatchifyFunctionHandle creator,
+                                      const char **name,
+                                      const char **description,
+                                      uint32_t *num_args,
+                                      const char ***arg_names,
+                                      const char ***arg_type_infos,
+                                      const char ***arg_descriptions) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *r = call_deploy("_capi_batchify_info", tup({incref(creator)}));
+  if (!r) return -1;
+  thread_local std::string nm_buf, doc_buf;
+  const char *c0 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  const char *c1 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  nm_buf = c0 ? c0 : "";
+  doc_buf = c1 ? c1 : "";
+  Py_DECREF(r);
+  *name = nm_buf.c_str();
+  *description = doc_buf.c_str();
+  if (num_args) *num_args = 0;
+  if (arg_names) *arg_names = nullptr;
+  if (arg_type_infos) *arg_type_infos = nullptr;
+  if (arg_descriptions) *arg_descriptions = nullptr;
+  return 0;
+}
+
+int MXBatchifyFunctionCreateFunction(BatchifyFunctionHandle creator,
+                                     uint32_t num_param, const char **keys,
+                                     const char **vals,
+                                     BatchifyFunctionHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy(
+      "_capi_batchify_create",
+      tup({incref(creator), str_list(num_param, keys),
+           str_list(num_param, vals)})), out);
+}
+
+int MXBatchifyFunctionInvoke(BatchifyFunctionHandle handle, int num_samples,
+                             NDArrayHandle *samples, int *num_outputs,
+                             NDArrayHandle **outputs) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle_list(
+      call_deploy("_capi_batchify_invoke",
+                  tup({incref(handle),
+                       handles_to_list(num_samples, samples)})),
+      num_outputs, outputs);
+}
+
+int MXBatchifyFunctionFree(BatchifyFunctionHandle handle) {
+  return MXNDArrayFree(handle);
+}
+
+// ---- profiler group (≙ MXProfile*, c_api.h:246-600) ----------------------
+
+int MXSetProfilerConfig(int num_params, const char **keys,
+                        const char **vals) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy("_capi_profiler_set_config",
+                              tup({str_list(num_params, keys),
+                                   str_list(num_params, vals)})));
+}
+
+int MXSetProcessProfilerConfig(int num_params, const char **keys,
+                               const char **vals, void *kv_handle) {
+  (void)kv_handle;
+  return MXSetProfilerConfig(num_params, keys, vals);
+}
+
+int MXSetProfilerState(int state) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy("_capi_profiler_set_state",
+                              tup({PyLong_FromLong(state)})));
+}
+
+int MXSetProcessProfilerState(int state, int profile_process,
+                              void *kv_handle) {
+  (void)profile_process;
+  (void)kv_handle;
+  return MXSetProfilerState(state);
+}
+
+int MXProfilePause(int paused) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy("_capi_profiler_pause",
+                              tup({PyLong_FromLong(paused)})));
+}
+
+int MXProcessProfilePause(int paused, int profile_process, void *kv_handle) {
+  (void)profile_process;
+  (void)kv_handle;
+  return MXProfilePause(paused);
+}
+
+int MXDumpProfile(int finished) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy("_capi_profiler_dump",
+                              tup({PyLong_FromLong(finished),
+                                   str_or_empty("")})));
+}
+
+int MXDumpProcessProfile(int finished, int profile_process,
+                         void *kv_handle) {
+  (void)profile_process;
+  (void)kv_handle;
+  return MXDumpProfile(finished);
+}
+
+int MXAggregateProfileStatsPrint(const char **out_str, int reset) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_cstr(call_deploy("_capi_profiler_dumps",
+                              tup({PyLong_FromLong(reset)})), out_str);
+}
+
+int MXProfileCreateDomain(const char *domain, ProfileHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_profile_create_domain",
+                                tup({str_or_empty(domain)})), out);
+}
+
+int MXProfileCreateTask(ProfileHandle domain, const char *task_name,
+                        ProfileHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_profile_create_task",
+                                tup({incref(domain),
+                                     str_or_empty(task_name)})), out);
+}
+
+int MXProfileCreateFrame(ProfileHandle domain, const char *frame_name,
+                         ProfileHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_profile_create_frame",
+                                tup({incref(domain),
+                                     str_or_empty(frame_name)})), out);
+}
+
+int MXProfileCreateEvent(const char *event_name, ProfileHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_profile_create_event",
+                                tup({str_or_empty(event_name)})), out);
+}
+
+int MXProfileCreateCounter(ProfileHandle domain, const char *counter_name,
+                           ProfileHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *none = Py_None;
+  Py_INCREF(none);
+  return ret_handle(call_deploy("_capi_profile_create_counter",
+                                tup({incref(domain),
+                                     str_or_empty(counter_name), none})),
+                    out);
+}
+
+int MXProfileDestroyHandle(ProfileHandle handle) {
+  return MXNDArrayFree(handle);
+}
+
+int MXProfileDurationStart(ProfileHandle duration_handle) {
+  return h_call_none("_capi_profile_duration_start", duration_handle);
+}
+
+int MXProfileDurationStop(ProfileHandle duration_handle) {
+  return h_call_none("_capi_profile_duration_stop", duration_handle);
+}
+
+int MXProfileSetCounter(ProfileHandle counter_handle, uint64_t value) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(
+      "_capi_profile_set_counter",
+      tup({incref(counter_handle),
+           PyLong_FromUnsignedLongLong(value)})));
+}
+
+int MXProfileAdjustCounter(ProfileHandle counter_handle, int64_t delta) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy("_capi_profile_adjust_counter",
+                              tup({incref(counter_handle),
+                                   PyLong_FromLongLong(delta)})));
+}
+
+int MXProfileSetMarker(ProfileHandle domain, const char *instant_marker_name,
+                       const char *scope) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(
+      "_capi_profile_set_marker",
+      tup({incref(domain), str_or_empty(instant_marker_name),
+           str_or_empty(scope)})));
+}
+
+// ---- engine group (≙ MXEngine*, c_api.h:3028-3119) -----------------------
+
+int MXEngineSetBulkSize(int bulk_size, int *prev_bulk_size) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_int(call_deploy("_capi_engine_set_bulk_size",
+                             tup({PyLong_FromLong(bulk_size)})),
+                 prev_bulk_size);
+}
+
+typedef void (*EngineSyncFunc)(void *);
+typedef void (*EngineAsyncFunc)(void *, void *, void *);
+
+namespace {
+int engine_push(void *fn, void *param, void *deleter, int is_async) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(
+      "_capi_engine_push",
+      tup({PyLong_FromVoidPtr(fn), PyLong_FromVoidPtr(param),
+           PyLong_FromVoidPtr(deleter), PyLong_FromLong(is_async)})));
+}
+}  // namespace
+
+int MXEnginePushSync(EngineSyncFunc sync_func, void *func_param,
+                     void *deleter, const void *ctx_handle,
+                     const void *const_vars, int num_const_vars,
+                     const void *mutable_vars, int num_mutable_vars) {
+  (void)ctx_handle; (void)const_vars; (void)num_const_vars;
+  (void)mutable_vars; (void)num_mutable_vars;
+  return engine_push(reinterpret_cast<void *>(sync_func), func_param,
+                     deleter, 0);
+}
+
+int MXEnginePushAsync(EngineAsyncFunc async_func, void *func_param,
+                      void *deleter, const void *ctx_handle,
+                      const void *const_vars, int num_const_vars,
+                      const void *mutable_vars, int num_mutable_vars) {
+  (void)ctx_handle; (void)const_vars; (void)num_const_vars;
+  (void)mutable_vars; (void)num_mutable_vars;
+  return engine_push(reinterpret_cast<void *>(async_func), func_param,
+                     deleter, 1);
+}
+
+int MXEnginePushSyncND(EngineSyncFunc sync_func, void *func_param,
+                       void *deleter, const void *ctx_handle,
+                       NDArrayHandle *const_nds, int num_const_nds,
+                       NDArrayHandle *mutable_nds, int num_mutable_nds) {
+  (void)const_nds; (void)num_const_nds; (void)mutable_nds;
+  (void)num_mutable_nds;
+  return MXEnginePushSync(sync_func, func_param, deleter, ctx_handle,
+                          nullptr, 0, nullptr, 0);
+}
+
+int MXEnginePushAsyncND(EngineAsyncFunc async_func, void *func_param,
+                        void *deleter, const void *ctx_handle,
+                        NDArrayHandle *const_nds, int num_const_nds,
+                        NDArrayHandle *mutable_nds, int num_mutable_nds) {
+  (void)const_nds; (void)num_const_nds; (void)mutable_nds;
+  (void)num_mutable_nds;
+  return MXEnginePushAsync(async_func, func_param, deleter, ctx_handle,
+                           nullptr, 0, nullptr, 0);
+}
+
+// ---- recordio group (≙ MXRecordIO*, c_api.h:2810-2900) -------------------
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_recordio_writer_create",
+                                tup({str_or_empty(uri)})), out);
+}
+
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_recordio_reader_create",
+                                tup({str_or_empty(uri)})), out);
+}
+
+namespace {
+int recordio_free(RecordIOHandle handle) {
+  if (!handle) return 0;
+  {
+    std::lock_guard<std::mutex> lock(g_init_mutex);
+    if (g_shutdown || !Py_IsInitialized()) return 0;
+  }
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  int rc = ret_none(call_deploy("_capi_recordio_close",
+                                tup({incref(handle)})));
+  Py_DECREF(reinterpret_cast<PyObject *>(handle));
+  return rc;
+}
+}  // namespace
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  return recordio_free(handle);
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) {
+  return recordio_free(handle);
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *b = PyBytes_FromStringAndSize(buf,
+                                          static_cast<Py_ssize_t>(size));
+  return ret_none(call_deploy("_capi_recordio_write",
+                              tup({incref(handle), b})));
+}
+
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  int64_t p = 0;
+  int rc = ret_int64(call_deploy("_capi_recordio_tell",
+                                 tup({incref(handle)})), &p);
+  *pos = static_cast<size_t>(p);
+  return rc;
+}
+
+int MXRecordIOReaderTell(RecordIOHandle handle, size_t *pos) {
+  return MXRecordIOWriterTell(handle, pos);
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const **buf,
+                               size_t *size) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *r = call_deploy("_capi_recordio_read", tup({incref(handle)}));
+  if (!r) return -1;
+  thread_local std::string rec_buf;
+  char *data = nullptr;
+  Py_ssize_t n = 0;
+  PyBytes_AsStringAndSize(r, &data, &n);
+  rec_buf.assign(data ? data : "", static_cast<size_t>(n));
+  Py_DECREF(r);
+  if (n == 0) {
+    *buf = nullptr;   // EOF (reference contract)
+    *size = 0;
+    return 0;
+  }
+  *buf = rec_buf.data();
+  *size = rec_buf.size();
+  return 0;
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(
+      "_capi_recordio_seek",
+      tup({incref(handle),
+           PyLong_FromSize_t(pos)})));
+}
+
+// ---- kvstore tail --------------------------------------------------------
+
+int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
+  return h_call_cstr("_capi_kv_type", handle, type);
+}
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  return h_call_none("_capi_kv_barrier", handle);
+}
+
+namespace {
+int kv_two_val_call(const char *fn, KVStoreHandle handle, int num,
+                    const int *keys, NDArrayHandle *ins, NDArrayHandle *outs,
+                    int priority) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(
+      fn, tup({incref(handle), keys_to_list(num, keys),
+               handles_to_list(num, ins), handles_to_list(num, outs),
+               PyLong_FromLong(priority)})));
+}
+}  // namespace
+
+int MXKVStorePushPull(KVStoreHandle handle, int num, const int *keys,
+                      NDArrayHandle *vals, NDArrayHandle *outs,
+                      int priority) {
+  return kv_two_val_call("_capi_kv_pushpull", handle, num, keys, vals, outs,
+                         priority);
+}
+
+int MXKVStoreBroadcast(KVStoreHandle handle, int num, const int *keys,
+                       NDArrayHandle *vals, NDArrayHandle *outs,
+                       int priority) {
+  return kv_two_val_call("_capi_kv_broadcast", handle, num, keys, vals,
+                         outs, priority);
+}
+
+int MXKVStoreSetGradientCompression(KVStoreHandle handle, uint32_t num_params,
+                                    const char **keys, const char **vals) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy("_capi_kv_set_compression",
+                              tup({incref(handle),
+                                   str_list(num_params, keys),
+                                   str_list(num_params, vals)})));
+}
+
+int MXKVStoreInitEx(KVStoreHandle handle, uint32_t num, const char **keys,
+                    NDArrayHandle *vals) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy("_capi_kv_init_str",
+                              tup({incref(handle), str_list(num, keys),
+                                   handles_to_list(num, vals)})));
+}
+
+int MXKVStorePushEx(KVStoreHandle handle, uint32_t num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy("_capi_kv_push_str",
+                              tup({incref(handle), str_list(num, keys),
+                                   handles_to_list(num, vals),
+                                   PyLong_FromLong(priority)})));
+}
+
+int MXKVStorePullEx(KVStoreHandle handle, uint32_t num, const char **keys,
+                    NDArrayHandle *outs, int priority) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy("_capi_kv_pull_str",
+                              tup({incref(handle), str_list(num, keys),
+                                   handles_to_list(num, outs),
+                                   PyLong_FromLong(priority)})));
+}
+
+typedef void (*MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                 NDArrayHandle local, void *handle);
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(
+      "_capi_kv_set_updater",
+      tup({incref(handle),
+           PyLong_FromVoidPtr(reinterpret_cast<void *>(updater)),
+           PyLong_FromVoidPtr(updater_handle)})));
+}
+
+int MXKVStoreIsWorkerNode(int *ret) {
+  *ret = 1;
+  return 0;
+}
+
+int MXKVStoreIsServerNode(int *ret) {
+  *ret = 0;
+  return 0;
+}
+
+int MXKVStoreIsSchedulerNode(int *ret) {
+  *ret = 0;
+  return 0;
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *number) {
+  (void)handle;
+  (void)node_id;
+  *number = 0;
+  return 0;
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  int barrier_before_exit) {
+  (void)handle;
+  (void)barrier_before_exit;
+  return 0;
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body) {
+  (void)handle;
+  (void)cmd_id;
+  (void)cmd_body;
+  return 0;  // no server processes in the SPMD runtime (≙ reference no-op)
+}
+
+int MXInitPSEnv(uint32_t num_vars, const char **keys, const char **vals) {
+  (void)num_vars;
+  (void)keys;
+  (void)vals;
+  return 0;  // ps-lite env vars are not used by the SPMD backend
+}
 
 }  // extern "C"

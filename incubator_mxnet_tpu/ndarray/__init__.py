@@ -728,12 +728,15 @@ def save(fname, data):
         data = [data]
     if isinstance(data, (list, tuple)):
         payload = {f"arr_{i}": a.asnumpy() for i, a in enumerate(data)}
-        _np.savez(fname, __mx_list__=_np.array(1), **payload)
+        payload["__mx_list__"] = _np.array(1)
     elif isinstance(data, dict):
         payload = {k: v.asnumpy() for k, v in data.items()}
-        _np.savez(fname, **payload)
     else:
         raise TypeError("save expects NDArray, list or dict")
+    # write through a file object: bare np.savez APPENDS '.npz' to a path
+    # that lacks it, silently saving under a different name than asked
+    with open(fname, "wb") as f:
+        _np.savez(f, **payload)
 
 
 def load(fname):

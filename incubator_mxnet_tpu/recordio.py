@@ -71,6 +71,14 @@ class MXRecordIO:
     def tell(self):
         return self.record.tell()
 
+    def seek(self, pos):
+        """Reposition the read cursor to a byte offset previously obtained
+        from tell() (≙ MXRecordIOReaderSeek)."""
+        self._check_pid()
+        if self.writable:
+            raise MXNetError("seek is for readers")
+        self.record.seek(pos)
+
     def write(self, buf):
         """Write one record."""
         self._check_pid()

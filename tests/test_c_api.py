@@ -176,3 +176,26 @@ def test_symbolblock_composes_under_hybridize(exported_net):
     p.hybridize()
     np.testing.assert_allclose(p(ramp).asnumpy(), ref * 2.0, rtol=1e-5,
                                atol=1e-5)
+
+
+def test_c_api_extended_groups(tmp_path):
+    """The round-4 ABI breadth: symbol build/compose/infer-shape/json,
+    recordio write+read, a CSVIter iterated from C, the NDArray tail,
+    a C-callback kvstore updater, engine push, and a profile dumped
+    through the ABI (VERDICT-r3 Next #3)."""
+    binpath = _compile_consumer(
+        os.path.join(CPP_TESTS, "test_c_api_ext.c"),
+        str(tmp_path / "test_c_api_ext"))
+    csv = tmp_path / "data.csv"
+    rows = ["%d,%d,%d" % (i * 3, i * 3 + 1, i * 3 + 2) for i in range(5)]
+    csv.write_text("\n".join(rows) + "\n")
+    profile = tmp_path / "profile.json"
+    r = subprocess.run(
+        [binpath, str(csv), str(profile), str(tmp_path)],
+        capture_output=True, text=True, timeout=420, env=_subprocess_env())
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "ALL EXT C API TESTS PASSED" in r.stdout
+    # the profile dump через the ABI produced real chrome-trace content
+    assert profile.exists(), r.stdout
+    body = profile.read_text()
+    assert "c_side_work" in body and "done_marker" in body
