@@ -1,0 +1,147 @@
+/*
+ * LeNet-style digit training in C++ through the mxtpu class frontend —
+ * the C++ translation of examples/mnist.py (synthetic-data path), role
+ * parity with /root/reference/cpp-package/example/mlp.cpp + lenet.cpp.
+ *
+ * Everything runs through the RAII classes (NDArray/invoke, Optimizer)
+ * and the MXAutograd* ABI group: forward via imperative NN ops
+ * (convolution/pooling/fully_connected/log_softmax), backward via the
+ * tape, SGD-with-momentum updates on device. No Python on this side.
+ *
+ * Prints per-epoch "epoch <i> loss <l> acc <a>"; exits nonzero unless the
+ * loss halves and accuracy exceeds 0.7.
+ */
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+#include <vector>
+
+#include <mxtpu/c_api.h>
+#include <mxtpu/ndarray.hpp>
+#include <mxtpu/optimizer.hpp>
+
+using mxtpu::DType;
+using mxtpu::NDArray;
+using mxtpu::check;
+using mxtpu::invoke1;
+
+namespace {
+
+constexpr int kN = 256;      // examples (full-batch)
+constexpr int kSide = 12;    // image side
+constexpr int kClasses = 10;
+
+// Synthetic learnable digits (mirrors examples/mnist.py fallback): noise
+// plus one bright row whose position encodes the class.
+void make_data(std::vector<float>* images, std::vector<float>* labels) {
+  std::mt19937_64 rng(0);
+  std::normal_distribution<float> noise(0.f, 0.2f);
+  std::uniform_int_distribution<int> cls(0, kClasses - 1);
+  images->assign(static_cast<size_t>(kN) * kSide * kSide, 0.f);
+  labels->resize(kN);
+  for (int i = 0; i < kN; ++i) {
+    int y = cls(rng);
+    (*labels)[i] = static_cast<float>(y);
+    float* img = images->data() + static_cast<size_t>(i) * kSide * kSide;
+    for (int p = 0; p < kSide * kSide; ++p) img[p] = noise(rng);
+    int row = y + 1;
+    for (int x = 0; x < kSide; ++x) img[row * kSide + x] += 2.0f;
+  }
+}
+
+NDArray randn(std::vector<int64_t> shape, float scale, uint64_t seed) {
+  int64_t n = 1;
+  for (int64_t d : shape) n *= d;
+  std::mt19937_64 rng(seed);
+  std::normal_distribution<float> dist(0.f, scale);
+  std::vector<float> host(static_cast<size_t>(n));
+  for (auto& v : host) v = dist(rng);
+  return NDArray(host.data(), shape, DType::kFloat32);
+}
+
+void mark(NDArray* p) {
+  NDArrayHandle h = p->handle();
+  int req = 1;  // write
+  check(MXAutogradMarkVariables(1, &h, &req), "MXAutogradMarkVariables");
+}
+
+NDArray grad_of(const NDArray& p) {
+  NDArrayHandle g = nullptr;
+  check(MXNDArrayGetGrad(p.handle(), &g), "MXNDArrayGetGrad");
+  return NDArray(g);
+}
+
+}  // namespace
+
+int main() {
+  check(MXTPUInit(), "MXTPUInit");
+
+  std::vector<float> images, labels;
+  make_data(&images, &labels);
+  NDArray x(images.data(), {kN, 1, kSide, kSide}, DType::kFloat32);
+  NDArray y(labels.data(), {kN}, DType::kFloat32);
+
+  // LeNet-lite parameters
+  NDArray w1 = randn({6, 1, 5, 5}, 0.2f, 1);
+  NDArray b1 = NDArray::Zeros({6});
+  NDArray w2 = randn({32, 6 * 6 * 6}, 0.1f, 2);
+  NDArray b2 = NDArray::Zeros({32});
+  NDArray w3 = randn({10, 32}, 0.2f, 3);
+  NDArray b3 = NDArray::Zeros({10});
+  NDArray* params[] = {&w1, &b1, &w2, &b2, &w3, &b3};
+
+  auto opt = mxtpu::OptimizerRegistry::Find("sgd");
+  opt->SetParam("lr", 0.1f).SetParam("momentum", 0.9f);
+
+  float first_loss = -1.f, last_loss = -1.f, last_acc = 0.f;
+  for (int epoch = 0; epoch < 30; ++epoch) {
+    for (NDArray* p : params) mark(p);
+    int prev = 0;
+    check(MXAutogradSetIsRecording(1, &prev), "SetIsRecording");
+    check(MXAutogradSetIsTraining(1, &prev), "SetIsTraining");
+
+    NDArray h1 = invoke1("convolution", {&x, &w1, &b1},
+                         "{\"kernel\": [5, 5], \"pad\": [2, 2]}");
+    NDArray a1 = invoke1("tanh", {&h1});
+    NDArray p1 = invoke1(
+        "pooling", {&a1},
+        "{\"kernel\": [2, 2], \"stride\": [2, 2], \"pool_type\": \"avg\"}");
+    NDArray f1 = invoke1("fully_connected", {&p1, &w2, &b2});
+    NDArray a2 = invoke1("tanh", {&f1});
+    NDArray logits = invoke1("fully_connected", {&a2, &w3, &b3});
+    NDArray logp = invoke1("log_softmax", {&logits});
+    NDArray picked = invoke1("pick", {&logp, &y});
+    NDArray mean_lp = invoke1("mean", {&picked});
+    NDArray loss = invoke1("negative", {&mean_lp});
+
+    NDArrayHandle lh = loss.handle();
+    check(MXAutogradBackward(1, &lh, nullptr, 0), "MXAutogradBackward");
+    check(MXAutogradSetIsRecording(0, &prev), "SetIsRecording(0)");
+    check(MXAutogradSetIsTraining(0, &prev), "SetIsTraining(0)");
+
+    for (int i = 0; i < 6; ++i) {
+      NDArray g = grad_of(*params[i]);
+      opt->Update(i, params[i], g);
+    }
+
+    last_loss = loss.copy_to_host<float>()[0];
+    if (epoch == 0) first_loss = last_loss;
+    NDArray pred = invoke1("argmax", {&logits}, "{\"axis\": 1}");
+    std::vector<int32_t> ph = pred.copy_to_host<int32_t>();  // jnp: int32
+    int hit = 0;
+    for (int i = 0; i < kN; ++i)
+      if (static_cast<int>(ph[i]) == static_cast<int>(labels[i])) ++hit;
+    last_acc = static_cast<float>(hit) / kN;
+    std::printf("epoch %d loss %.4f acc %.3f\n", epoch, last_loss, last_acc);
+  }
+
+  if (!(last_loss < first_loss / 2.f) || !(last_acc > 0.7f)) {
+    std::fprintf(stderr, "TRAINING DID NOT CONVERGE: first=%.4f last=%.4f "
+                         "acc=%.3f\n", first_loss, last_loss, last_acc);
+    return 1;
+  }
+  std::printf("CPP TRAIN MNIST OK first=%.4f last=%.4f acc=%.3f\n",
+              first_loss, last_loss, last_acc);
+  return 0;
+}

@@ -1,0 +1,104 @@
+/*
+ * mxtpu::KVStore — RAII C++ key-value store frontend.
+ *
+ * Role parity: /root/reference/cpp-package/include/mxnet-cpp/kvstore.hpp
+ * (init/push/pull/pushpull, updater registration, rank queries) over the
+ * MXKVStore* ABI group. The backend is the TPU-native SPMD store: push
+ * aggregates via XLA collectives, dist types ride real cross-process
+ * allreduce with optional bit-packed gradient compression.
+ */
+#ifndef MXTPU_KVSTORE_HPP_
+#define MXTPU_KVSTORE_HPP_
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+#include "ndarray.hpp"
+
+namespace mxtpu {
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string &type = "local") {
+    check(MXKVStoreCreate(type.c_str(), &h_), "MXKVStoreCreate");
+  }
+  ~KVStore() {
+    if (h_) MXKVStoreFree(h_);
+  }
+  KVStore(const KVStore &) = delete;
+  KVStore &operator=(const KVStore &) = delete;
+
+  KVStoreHandle handle() const { return h_; }
+
+  std::string Type() const {
+    const char *t = nullptr;
+    check(MXKVStoreGetType(h_, &t), "MXKVStoreGetType");
+    return t;
+  }
+
+  int Rank() const {
+    int r = 0;
+    check(MXKVStoreGetRank(h_, &r), "MXKVStoreGetRank");
+    return r;
+  }
+
+  int NumWorkers() const {
+    int n = 0;
+    check(MXKVStoreGetGroupSize(h_, &n), "MXKVStoreGetGroupSize");
+    return n;
+  }
+
+  void Init(int key, const NDArray &value) {
+    NDArrayHandle v = value.handle();
+    check(MXKVStoreInit(h_, 1, &key, &v), "MXKVStoreInit");
+  }
+
+  void Push(int key, const NDArray &value, int priority = 0) {
+    NDArrayHandle v = value.handle();
+    check(MXKVStorePush(h_, 1, &key, &v, priority), "MXKVStorePush");
+  }
+
+  void Pull(int key, NDArray *out, int priority = 0) {
+    NDArrayHandle o = out->handle();
+    check(MXKVStorePull(h_, 1, &key, &o, priority), "MXKVStorePull");
+  }
+
+  void PushPull(int key, const NDArray &value, NDArray *out,
+                int priority = 0) {
+    NDArrayHandle v = value.handle();
+    NDArrayHandle o = out->handle();
+    check(MXKVStorePushPull(h_, 1, &key, &v, &o, priority),
+          "MXKVStorePushPull");
+  }
+
+  void SetGradientCompression(
+      const std::map<std::string, std::string> &params) {
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    check(MXKVStoreSetGradientCompression(
+              h_, static_cast<uint32_t>(keys.size()), keys.data(),
+              vals.data()),
+          "MXKVStoreSetGradientCompression");
+  }
+
+  // updater runs synchronously during Push; handles are borrowed for the
+  // duration of the callback (reference updater contract)
+  void SetUpdater(MXKVStoreUpdater updater, void *user_handle = nullptr) {
+    check(MXKVStoreSetUpdater(h_, updater, user_handle),
+          "MXKVStoreSetUpdater");
+  }
+
+  void Barrier() { check(MXKVStoreBarrier(h_), "MXKVStoreBarrier"); }
+
+ private:
+  KVStoreHandle h_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_KVSTORE_HPP_

@@ -1,0 +1,147 @@
+/*
+ * mxtpu::Optimizer — RAII C++ optimizer frontend (SGD/momentum, Adam).
+ *
+ * Role parity: /root/reference/cpp-package/include/mxnet-cpp/optimizer.hpp
+ * (OptimizerRegistry::Find("sgd")->Update(idx, w, g)). Updates execute as
+ * imperative ops through the ABI, so the math runs on the device (XLA
+ * fuses each rule into a couple of kernels); per-index state (momentum,
+ * adam moments) lives in device NDArrays owned by this object.
+ */
+#ifndef MXTPU_OPTIMIZER_HPP_
+#define MXTPU_OPTIMIZER_HPP_
+
+#include <cmath>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "c_api.h"
+#include "ndarray.hpp"
+
+namespace mxtpu {
+
+class Optimizer {
+ public:
+  virtual ~Optimizer() = default;
+
+  Optimizer &SetParam(const std::string &key, float value) {
+    params_[key] = value;
+    return *this;
+  }
+
+  float GetParam(const std::string &key, float fallback) const {
+    auto it = params_.find(key);
+    return it == params_.end() ? fallback : it->second;
+  }
+
+  // w <- update(w, g); device-side via imperative ops.
+  virtual void Update(int index, NDArray *weight, const NDArray &grad) = 0;
+
+ protected:
+  // a device 0-d scalar: binary ops broadcast it (np semantics)
+  static NDArray scalar(double v) {
+    float f = static_cast<float>(v);
+    return NDArray(&f, {}, DType::kFloat32);
+  }
+
+  static NDArray scale(const NDArray &a, double s) {
+    NDArray sv = scalar(s);
+    return invoke1("multiply", {&a, &sv});
+  }
+
+  // out = a * s1 + b * s2
+  static NDArray axpby(const NDArray &a, double s1, const NDArray &b,
+                       double s2) {
+    NDArray sa = scale(a, s1);
+    NDArray sb = scale(b, s2);
+    return invoke1("add", {&sa, &sb});
+  }
+
+  std::map<std::string, float> params_;
+};
+
+// SGD with optional momentum and weight decay (≙ mxnet-cpp SGDOptimizer).
+class SGDOptimizer : public Optimizer {
+ public:
+  void Update(int index, NDArray *weight, const NDArray &grad) override {
+    const float lr = GetParam("lr", 0.01f);
+    const float mom = GetParam("momentum", 0.0f);
+    const float wd = GetParam("wd", 0.0f);
+    NDArray g = wd != 0.0f ? axpby(grad, 1.0, *weight, wd)
+                           : invoke1("copy", {&grad});
+    if (mom != 0.0f) {
+      auto it = state_.find(index);
+      if (it == state_.end()) {
+        it = state_.emplace(index,
+                            NDArray::Zeros(weight->shape())).first;
+      }
+      // m <- mom * m + g ; w <- w - lr * m
+      NDArray m = axpby(it->second, mom, g, 1.0);
+      NDArray step = scale(m, lr);
+      *weight = invoke1("subtract", {weight, &step});
+      it->second = std::move(m);
+    } else {
+      NDArray step = scale(g, lr);
+      *weight = invoke1("subtract", {weight, &step});
+    }
+  }
+
+ private:
+  std::map<int, NDArray> state_;
+};
+
+// Adam (≙ mxnet-cpp AdamOptimizer): bias-corrected moments on device.
+class AdamOptimizer : public Optimizer {
+ public:
+  void Update(int index, NDArray *weight, const NDArray &grad) override {
+    const float lr = GetParam("lr", 0.001f);
+    const float b1 = GetParam("beta1", 0.9f);
+    const float b2 = GetParam("beta2", 0.999f);
+    const float eps = GetParam("epsilon", 1e-8f);
+    auto &st = state_[index];
+    if (!st.m.valid()) {
+      st.m = NDArray::Zeros(weight->shape());
+      st.v = NDArray::Zeros(weight->shape());
+      st.t = 0;
+    }
+    st.t += 1;
+    st.m = axpby(st.m, b1, grad, 1.0 - b1);
+    NDArray g2 = invoke1("multiply", {&grad, &grad});
+    st.v = axpby(st.v, b2, g2, 1.0 - b2);
+    const double corr1 = 1.0 - std::pow(b1, st.t);
+    const double corr2 = 1.0 - std::pow(b2, st.t);
+    NDArray vhat = scale(st.v, 1.0 / corr2);
+    NDArray denom = invoke1("sqrt", {&vhat});
+    NDArray eps_nd = scalar(eps);
+    denom = invoke1("add", {&denom, &eps_nd});
+    NDArray mhat = scale(st.m, lr / corr1);
+    NDArray step = invoke1("divide", {&mhat, &denom});
+    *weight = invoke1("subtract", {weight, &step});
+  }
+
+ private:
+  struct AdamState {
+    NDArray m, v;
+    int t = 0;
+  };
+  std::map<int, AdamState> state_;
+};
+
+// ≙ mxnet-cpp OptimizerRegistry::Find
+class OptimizerRegistry {
+ public:
+  static std::unique_ptr<Optimizer> Find(const std::string &name) {
+    if (name == "sgd") return std::unique_ptr<Optimizer>(new SGDOptimizer());
+    if (name == "adam")
+      return std::unique_ptr<Optimizer>(new AdamOptimizer());
+    throw std::runtime_error("unknown optimizer: " + name);
+  }
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_OPTIMIZER_HPP_

@@ -1,0 +1,217 @@
+/*
+ * mxtpu::Symbol + mxtpu::Operator — RAII C++ symbolic-graph frontend.
+ *
+ * Role parity: /root/reference/cpp-package/include/mxnet-cpp/symbol.hpp +
+ * operator.hpp (the builder pattern: Operator("Convolution")
+ * .SetParam(...).SetInput(...).CreateSymbol(name)). Graphs serialize to
+ * the reference symbol.json format; execution happens Python-side where
+ * the executor is a pure jax function (symbol/__init__.py bind_fn).
+ */
+#ifndef MXTPU_SYMBOL_HPP_
+#define MXTPU_SYMBOL_HPP_
+
+#include <map>
+#include <sstream>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "c_api.h"
+#include "ndarray.hpp"
+
+namespace mxtpu {
+
+class Symbol {
+ public:
+  Symbol() = default;
+  explicit Symbol(SymbolHandle h) : h_(h) {}
+
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h = nullptr;
+    check(MXSymbolCreateVariable(name.c_str(), &h), "MXSymbolCreateVariable");
+    return Symbol(h);
+  }
+
+  static Symbol Load(const std::string &file) {
+    SymbolHandle h = nullptr;
+    check(MXSymbolCreateFromFile(file.c_str(), &h), "MXSymbolCreateFromFile");
+    return Symbol(h);
+  }
+
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h = nullptr;
+    check(MXSymbolCreateFromJSON(json.c_str(), &h), "MXSymbolCreateFromJSON");
+    return Symbol(h);
+  }
+
+  ~Symbol() { reset(); }
+  Symbol(const Symbol &o) {
+    if (o.h_) check(MXSymbolCopy(o.h_, &h_), "MXSymbolCopy");
+  }
+  Symbol &operator=(const Symbol &o) {
+    if (this != &o) {
+      reset();
+      if (o.h_) check(MXSymbolCopy(o.h_, &h_), "MXSymbolCopy");
+    }
+    return *this;
+  }
+  Symbol(Symbol &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Symbol &operator=(Symbol &&o) noexcept {
+    if (this != &o) { reset(); h_ = o.h_; o.h_ = nullptr; }
+    return *this;
+  }
+
+  SymbolHandle handle() const { return h_; }
+  bool valid() const { return h_ != nullptr; }
+
+  std::string ToJSON() const {
+    const char *j = nullptr;
+    check(MXSymbolSaveToJSON(h_, &j), "MXSymbolSaveToJSON");
+    return j;
+  }
+
+  void Save(const std::string &file) const {
+    check(MXSymbolSaveToFile(h_, file.c_str()), "MXSymbolSaveToFile");
+  }
+
+  std::vector<std::string> ListArguments() const {
+    return str_list_call(MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return str_list_call(MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return str_list_call(MXSymbolListAuxiliaryStates);
+  }
+
+  std::string GetName() const {
+    const char *s = nullptr;
+    int ok = 0;
+    check(MXSymbolGetName(h_, &s, &ok), "MXSymbolGetName");
+    return ok ? s : "";
+  }
+
+  Symbol GetInternals() const {
+    SymbolHandle out = nullptr;
+    check(MXSymbolGetInternals(h_, &out), "MXSymbolGetInternals");
+    return Symbol(out);
+  }
+
+  Symbol operator[](uint32_t i) const {
+    SymbolHandle out = nullptr;
+    check(MXSymbolGetOutput(h_, i, &out), "MXSymbolGetOutput");
+    return Symbol(out);
+  }
+
+  // (arg_shapes, out_shapes, aux_shapes) given named input shapes.
+  void InferShape(
+      const std::map<std::string, std::vector<int64_t>> &input_shapes,
+      std::vector<std::vector<int64_t>> *arg_shapes,
+      std::vector<std::vector<int64_t>> *out_shapes,
+      std::vector<std::vector<int64_t>> *aux_shapes) const {
+    std::vector<const char *> keys;
+    std::vector<int64_t> ind_ptr{0};
+    std::vector<int64_t> data;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      for (int64_t d : kv.second) data.push_back(d);
+      ind_ptr.push_back(static_cast<int64_t>(data.size()));
+    }
+    size_t in_sz, out_sz, aux_sz;
+    const int *in_nd, *out_nd, *aux_nd;
+    const int64_t **in_d, **out_d, **aux_d;
+    int complete = 0;
+    check(MXSymbolInferShape64(
+              h_, static_cast<uint32_t>(keys.size()), keys.data(),
+              ind_ptr.data(), data.data(), &in_sz, &in_nd, &in_d, &out_sz,
+              &out_nd, &out_d, &aux_sz, &aux_nd, &aux_d, &complete),
+          "MXSymbolInferShape64");
+    auto unpack = [](size_t n, const int *nd, const int64_t **d,
+                     std::vector<std::vector<int64_t>> *out) {
+      if (!out) return;
+      out->clear();
+      for (size_t i = 0; i < n; ++i)
+        out->emplace_back(d[i], d[i] + (nd[i] < 0 ? 0 : nd[i]));
+    };
+    unpack(in_sz, in_nd, in_d, arg_shapes);
+    unpack(out_sz, out_nd, out_d, out_shapes);
+    unpack(aux_sz, aux_nd, aux_d, aux_shapes);
+  }
+
+  void reset() {
+    if (h_) { MXSymbolFree(h_); h_ = nullptr; }
+  }
+
+ private:
+  template <typename F>
+  std::vector<std::string> str_list_call(F fn) const {
+    uint32_t n = 0;
+    const char **arr = nullptr;
+    check(fn(h_, &n, &arr), "MXSymbolList*");
+    return std::vector<std::string>(arr, arr + n);
+  }
+
+  SymbolHandle h_ = nullptr;
+};
+
+// Builder for one graph node (≙ mxnet-cpp Operator): collect attribute
+// params and named inputs, then CreateSymbol(name).
+class Operator {
+ public:
+  explicit Operator(const std::string &op_name) : op_(op_name) {}
+
+  template <typename T>
+  Operator &SetParam(const std::string &key, const T &value) {
+    std::ostringstream os;
+    os << value;
+    params_[key] = os.str();
+    return *this;
+  }
+
+  Operator &SetParam(const std::string &key,
+                     const std::vector<int64_t> &tuple_value) {
+    std::ostringstream os;
+    os << "(";
+    for (size_t i = 0; i < tuple_value.size(); ++i)
+      os << (i ? ", " : "") << tuple_value[i];
+    os << ")";
+    params_[key] = os.str();
+    return *this;
+  }
+
+  Operator &SetInput(const std::string &name, const Symbol &sym) {
+    input_keys_.push_back(name);
+    inputs_.push_back(sym.handle());
+    return *this;
+  }
+
+  Symbol CreateSymbol(const std::string &name = "") {
+    std::vector<const char *> pkeys, pvals;
+    for (const auto &kv : params_) {
+      pkeys.push_back(kv.first.c_str());
+      pvals.push_back(kv.second.c_str());
+    }
+    SymbolHandle h = nullptr;
+    check(MXSymbolCreateAtomicSymbol(op_.c_str(),
+                                     static_cast<uint32_t>(pkeys.size()),
+                                     pkeys.data(), pvals.data(), &h),
+          "MXSymbolCreateAtomicSymbol");
+    std::vector<const char *> ikeys;
+    for (const auto &k : input_keys_) ikeys.push_back(k.c_str());
+    check(MXSymbolCompose(h, name.c_str(),
+                          static_cast<uint32_t>(inputs_.size()),
+                          ikeys.data(), inputs_.data()),
+          "MXSymbolCompose");
+    return Symbol(h);
+  }
+
+ private:
+  std::string op_;
+  std::map<std::string, std::string> params_;
+  std::vector<std::string> input_keys_;
+  std::vector<SymbolHandle> inputs_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_SYMBOL_HPP_

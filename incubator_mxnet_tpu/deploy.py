@@ -547,8 +547,7 @@ def _capi_symbol_get_name(s):
 
 
 def _capi_symbol_get_attr(s, key):
-    v = s.attr(key)
-    return v if v is not None else ""
+    return s.attr(key)   # None = absent; "" is a present empty value
 
 
 def _capi_symbol_set_attr(s, key, value):
@@ -818,13 +817,22 @@ def _capi_batchify_info(name):
 
 def _capi_batchify_create(name, keys, vals):
     from .gluon.data import batchify
+    kw = dict(zip(keys, vals))
     if name == "Stack":
         return batchify.Stack()
     if name == "Pad":
-        kw = dict(zip(keys, vals))
-        return batchify.Pad(val=float(kw.get("pad_val", 0)))
+        unknown = set(kw) - {"val", "pad_val", "axis", "dtype"}
+        if unknown:
+            raise MXNetError(f"Pad batchify: unknown params {sorted(unknown)}")
+        return batchify.Pad(
+            axis=int(kw.get("axis", 0)),
+            val=float(kw.get("val", kw.get("pad_val", 0))),
+            dtype=kw.get("dtype") or None)
     if name == "Group":
-        return batchify.Group(batchify.Stack(), batchify.Stack())
+        # components default to Stack x N (N from 'size'); richer nesting
+        # composes Python-side
+        n = int(kw.get("size", 2))
+        return batchify.Group(*[batchify.Stack() for _ in range(n)])
     raise MXNetError(f"unknown batchify {name!r}")
 
 
@@ -942,9 +950,18 @@ def _capi_profile_set_marker(domain, name, scope):
 # -- engine group (≙ MXEngine*, c_api.h:3028-3119) -------------------------
 def _capi_engine_set_bulk_size(size):
     from . import engine
-    prev = engine.effective_bulk_size()
-    engine.set_bulk_size(int(size))
-    return int(prev)
+    # set_bulk_size returns the previously CONFIGURED value — not
+    # effective_bulk_size(), which NaiveEngine forces to 0 and would make
+    # the save/restore pattern permanently disable bulking
+    return int(engine.set_bulk_size(int(size)))
+
+
+import ctypes as _ctypes
+
+# stable no-op completion callback for async engine pushes (kept as a
+# module global so the function pointer outlives every call)
+_ENGINE_NOOP_COMPLETE = _ctypes.CFUNCTYPE(None, _ctypes.c_void_p)(
+    lambda _param: None)
 
 
 def _capi_engine_push(fn_addr, param_addr, deleter_addr, is_async):
@@ -960,10 +977,14 @@ def _capi_engine_push(fn_addr, param_addr, deleter_addr, is_async):
     waitall()
     try:
         if int(is_async):
-            # async signature: void (*)(void* engine, void* param, void* cb)
+            # async signature: void (*)(void* engine, void* param, void* cb).
+            # cb must be a CALLABLE completion callback (the reference
+            # contract requires the func to invoke it) — never NULL.
             CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p,
                                   ctypes.c_void_p)
-            CB(fn_addr)(None, ctypes.c_void_p(param_addr or 0), None)
+            CB(fn_addr)(None, ctypes.c_void_p(param_addr or 0),
+                        ctypes.cast(_ENGINE_NOOP_COMPLETE,
+                                    ctypes.c_void_p))
         else:
             CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
             CB(fn_addr)(ctypes.c_void_p(param_addr or 0))
@@ -996,8 +1017,9 @@ def _capi_recordio_write(rec, buf):
 
 
 def _capi_recordio_read(rec):
-    data = rec.read()
-    return data if data is not None else b""
+    # None = EOF; b"" is a legitimate zero-length record — the C side
+    # distinguishes them (EOF -> *buf NULL, empty record -> non-NULL)
+    return rec.read()
 
 
 def _capi_recordio_tell(rec):

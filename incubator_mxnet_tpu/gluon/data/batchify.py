@@ -29,9 +29,6 @@ class Stack:
         from ... import np as mxnp
         return mxnp.array(_np.stack([_as_host(d) for d in data]))
 
-    def __mx_handle__(self):
-        return self
-
 
 class Pad:
     """Pad ragged samples to the batch max length per axis, then stack

@@ -914,6 +914,8 @@ int MXNDArrayLegacySave(const char *fname, uint32_t num_args,
 }
 
 int MXShallowCopyNDArray(NDArrayHandle handle, NDArrayHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;  // refcount mutation needs the GIL like every other entry
   *out = incref(handle);
   return 0;
 }
@@ -1111,9 +1113,18 @@ int MXSymbolGetAttr(SymbolHandle sym, const char *key, const char **out,
                     int *success) {
   if (!ensure_runtime()) return -1;
   Gil gil;
-  int rc = ret_cstr(call_deploy("_capi_symbol_get_attr",
-                                tup({incref(sym), str_or_empty(key)})), out);
-  if (success) *success = (rc == 0 && **out) ? 1 : 0;
+  PyObject *r = call_deploy("_capi_symbol_get_attr",
+                            tup({incref(sym), str_or_empty(key)}));
+  if (!r) return -1;
+  if (r == Py_None) {   // absent — distinct from a present empty value
+    Py_DECREF(r);
+    tl_str.clear();
+    *out = tl_str.c_str();
+    if (success) *success = 0;
+    return 0;
+  }
+  int rc = ret_cstr(r, out);
+  if (success) *success = (rc == 0) ? 1 : 0;
   return rc;
 }
 
@@ -1227,6 +1238,8 @@ int MXSymbolCreateGroup(uint32_t num_symbols, SymbolHandle *symbols,
 }
 
 int MXShallowCopySymbol(SymbolHandle sym, SymbolHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
   *out = incref(sym);
   return 0;
 }
@@ -1907,18 +1920,19 @@ int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const **buf,
   Gil gil;
   PyObject *r = call_deploy("_capi_recordio_read", tup({incref(handle)}));
   if (!r) return -1;
+  if (r == Py_None) {   // EOF — distinct from a zero-length record
+    Py_DECREF(r);
+    *buf = nullptr;
+    *size = 0;
+    return 0;
+  }
   thread_local std::string rec_buf;
   char *data = nullptr;
   Py_ssize_t n = 0;
   PyBytes_AsStringAndSize(r, &data, &n);
   rec_buf.assign(data ? data : "", static_cast<size_t>(n));
   Py_DECREF(r);
-  if (n == 0) {
-    *buf = nullptr;   // EOF (reference contract)
-    *size = 0;
-    return 0;
-  }
-  *buf = rec_buf.data();
+  *buf = rec_buf.data();   // non-NULL even for an empty record
   *size = rec_buf.size();
   return 0;
 }

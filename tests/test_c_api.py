@@ -199,3 +199,88 @@ def test_c_api_extended_groups(tmp_path):
     assert profile.exists(), r.stdout
     body = profile.read_text()
     assert "c_side_work" in body and "done_marker" in body
+
+
+CPP_EXAMPLES = os.path.join(REPO, "cpp_package", "examples")
+
+
+def test_cpp_class_frontend_trains_lenet(tmp_path):
+    """VERDICT-r3 Next #4: the C++ translation of examples/mnist.py trains
+    through the RAII class frontend (NDArray/Optimizer + MXAutograd*)."""
+    binpath = _compile_consumer(
+        os.path.join(CPP_EXAMPLES, "train_mnist.cc"),
+        str(tmp_path / "train_mnist"))
+    r = subprocess.run([binpath], env=_subprocess_env(),
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "CPP TRAIN MNIST OK" in r.stdout
+    assert "acc=1.000" in r.stdout or "acc=0.9" in r.stdout
+
+
+def test_cpp_multithreaded_inference_example(exported_net, tmp_path):
+    """≙ reference example/multi_threaded_inference: one shared predictor,
+    4 threads x 8 forwards, outputs bit-stable per thread."""
+    prefix, _ = exported_net
+    binpath = _compile_consumer(
+        os.path.join(CPP_EXAMPLES, "multithreaded_inference.cc"),
+        str(tmp_path / "mt_inference"))
+    r = subprocess.run([binpath, prefix, "4", "8"], env=_subprocess_env(),
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "MT INFERENCE OK" in r.stdout
+
+
+def test_cpp_symbol_and_kvstore_headers(tmp_path):
+    """Compile-and-run check of the Symbol/Operator and KVStore class
+    frontends (mxnet-cpp parity surface)."""
+    src = tmp_path / "hdr_check.cc"
+    src.write_text(r'''
+#include <cassert>
+#include <cstdio>
+#include <mxtpu/c_api.h>
+#include <mxtpu/ndarray.hpp>
+#include <mxtpu/symbol.hpp>
+#include <mxtpu/kvstore.hpp>
+#include <mxtpu/optimizer.hpp>
+using namespace mxtpu;
+int main() {
+  check(MXTPUInit(), "init");
+  Symbol data = Symbol::Variable("data");
+  Symbol fc = Operator("FullyConnected").SetParam("num_hidden", 4)
+                  .SetInput("data", data).CreateSymbol("fc1");
+  auto args = fc.ListArguments();
+  assert(args.size() == 3 && args[1] == "fc1_weight");
+  std::map<std::string, std::vector<int64_t>> in{{"data", {2, 6}}};
+  std::vector<std::vector<int64_t>> a, o, x;
+  fc.InferShape(in, &a, &o, &x);
+  assert(o[0][0] == 2 && o[0][1] == 4);
+  Symbol copy = fc;                       // deep copy via json
+  assert(copy.ListArguments() == args);
+
+  KVStore kv("local");
+  assert(kv.Type() == "local");
+  float ones[4] = {1, 1, 1, 1};
+  NDArray v(ones, {4}, DType::kFloat32);
+  kv.Init(3, v);
+  kv.Push(3, v);
+  NDArray out = NDArray::Zeros({4});
+  kv.Pull(3, &out);
+  auto host = out.copy_to_host<float>();
+  assert(host[0] == 1.0f);
+
+  auto opt = OptimizerRegistry::Find("adam");
+  opt->SetParam("lr", 0.01f);
+  NDArray w(ones, {4}, DType::kFloat32);
+  NDArray g(ones, {4}, DType::kFloat32);
+  opt->Update(0, &w, g);
+  auto wh = w.copy_to_host<float>();
+  assert(wh[0] < 1.0f);
+  std::printf("HEADER CLASSES OK\n");
+  return 0;
+}
+''')
+    binpath = _compile_consumer(str(src), str(tmp_path / "hdr_check"))
+    r = subprocess.run([binpath], env=_subprocess_env(),
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "HEADER CLASSES OK" in r.stdout
