@@ -91,6 +91,7 @@ class _Translator:
         "stop_gradient": "Identity", "copy": "Identity",
         "gt": "Greater", "lt": "Less", "ge": "GreaterOrEqual",
         "le": "LessOrEqual", "eq": "Equal",
+        "device_put": "Identity",   # placement is meaningless in the file
     }
 
     def translate(self, eqn, ins, outs):
@@ -149,6 +150,20 @@ class _Translator:
             g.add("Where", [ins[0], ins[2], ins[1]], outs)
         elif p == "concatenate":
             g.add("Concat", ins, outs, axis=int(params["dimension"]))
+        elif p == "split":
+            # lax.split: sizes along one axis -> one Slice per piece
+            axis = int(params["axis"])
+            sizes = [int(s) for s in params["sizes"]]
+            off = 0
+            for piece, out_name in zip(sizes, outs):
+                g.add("Slice",
+                      [ins[0],
+                       g.const(_np.asarray([off], _np.int64)),
+                       g.const(_np.asarray([off + piece], _np.int64)),
+                       g.const(_np.asarray([axis], _np.int64)),
+                       g.const(_np.asarray([1], _np.int64))],
+                      [out_name])
+                off += piece
         elif p == "reduce_sum":
             ax = g.const(_np.asarray(params["axes"], _np.int64), "axes")
             g.add("ReduceSum", [ins[0], ax], outs, keepdims=0)
@@ -192,6 +207,8 @@ class _Translator:
                    g.const(_np.asarray([int(s) for s in strides],
                                        _np.int64))],
                   outs)
+        elif p == "gather":
+            self._gather(eqn, ins, outs)
         elif p == "dot_general":
             self._dot_general(eqn, ins, outs)
         elif p == "conv_general_dilated":
@@ -202,6 +219,39 @@ class _Translator:
             raise MXNetError(
                 f"jax primitive {p!r} has no ONNX translation "
                 "(exporter covers the model-zoo inference op subset)")
+
+    # -- gather (axis-gather subset: embedding / take) ------------------
+    def _gather(self, eqn, ins, outs):
+        g = self.g
+        pr = eqn.params
+        dn = pr["dimension_numbers"]
+        op_shape = _aval_of(eqn.invars[0])[0]
+        idx_shape = _aval_of(eqn.invars[1])[0]
+        slice_sizes = tuple(int(s) for s in pr["slice_sizes"])
+        if (len(dn.start_index_map) != 1
+                or tuple(dn.collapsed_slice_dims) != tuple(dn.start_index_map)
+                or getattr(dn, "operand_batching_dims", ()) != ()
+                or idx_shape[-1] != 1):
+            raise MXNetError(
+                "only axis-gather (embedding/take) patterns are exportable")
+        axis = int(dn.start_index_map[0])
+        for d in range(len(op_shape)):
+            want = 1 if d == axis else op_shape[d]
+            if slice_sizes[d] != want:
+                raise MXNetError(
+                    "gather with partial slices is not exportable")
+        # indices carry a trailing length-1 coordinate dim: drop it (a
+        # scalar index reshapes to rank-0 so the output rank matches jax)
+        idx = ins[1]
+        flat = g.fresh("gidx")
+        g.add("Reshape",
+              [idx, g.const(_np.asarray(idx_shape[:-1], _np.int64),
+                            "shape")],
+              [flat])
+        idx = flat
+        idx64 = g.fresh("gidx64")
+        g.add("Cast", [idx], [idx64], to=int(P.DT[_np.dtype(_np.int64)]))
+        g.add("Gather", [ins[0], idx64], outs, axis=axis)
 
     # -- matmul ---------------------------------------------------------
     def _dot_general(self, eqn, ins, outs):
@@ -343,26 +393,103 @@ def export_model(net, example_input, path, input_name="data",
 
     g = _Graph()
     names = {}
+    const_cache = {}   # id(const value) -> initializer name (dedupe:
+    # scan unrolling re-binds body consts every iteration)
 
-    def name_of(v):
+    def cached_const(cval, hint):
+        key = id(cval)
+        nm = const_cache.get(key)
+        if nm is None:
+            arr = _np.asarray(cval)
+            if arr.dtype.name == "bfloat16":
+                arr = arr.astype(_np.float32)
+            nm = g.const(arr, hint)
+            const_cache[key] = nm
+        return nm
+
+    def name_of(env, v):
         import jax.extend.core as jcore
         if isinstance(v, jcore.Literal):
             arr = _np.asarray(v.val)
             if arr.dtype.name == "bfloat16":
                 arr = arr.astype(_np.float32)
             return g.const(arr, "lit")
-        return names[v]
+        return env[v]
 
     names[jaxpr.invars[0]] = input_name
     for cv, cval in zip(jaxpr.constvars, consts):
-        arr = _np.asarray(cval)
-        if arr.dtype.name == "bfloat16":
-            arr = arr.astype(_np.float32)
-        names[cv] = g.const(arr, "param")
+        names[cv] = cached_const(cval, "param")
 
     tr = _Translator(g)
+    MAX_UNROLL = 512
 
-    def walk(jx):
+    def unroll_scan(eqn, env):
+        """lax.scan -> static unroll (length is a trace constant): inline
+        the body once per step, slice xs rows in, stack ys rows out."""
+        pr = eqn.params
+        closed = pr["jaxpr"]
+        bj = closed.jaxpr
+        n_const, n_carry = pr["num_consts"], pr["num_carry"]
+        length, reverse = int(pr["length"]), bool(pr["reverse"])
+        if length > MAX_UNROLL:
+            raise MXNetError(
+                f"scan of length {length} exceeds the unroll bound "
+                f"({MAX_UNROLL}); not exportable")
+        const_names = [name_of(env, v) for v in eqn.invars[:n_const]]
+        carry = [name_of(env, v)
+                 for v in eqn.invars[n_const:n_const + n_carry]]
+        xs_vars = eqn.invars[n_const + n_carry:]
+        xs_names = [name_of(env, v) for v in xs_vars]
+        n_ys = len(bj.outvars) - n_carry
+        ys_rows = [[None] * length for _ in range(n_ys)]
+        # loop-invariant consts hoisted: only the t/t+1 slice bounds vary
+        axes0 = g.const(_np.asarray([0], _np.int64), "axes")
+        step1 = g.const(_np.asarray([1], _np.int64), "steps")
+        xs_shape_consts = [
+            g.const(_np.asarray(tuple(xv.aval.shape)[1:] or (1,),
+                                _np.int64), "shape")
+            for xv in xs_vars]
+        ys_shape_consts = [
+            g.const(_np.asarray((1,) + tuple(yv.aval.shape), _np.int64),
+                    "shape")
+            for yv in bj.outvars[n_carry:]]
+        steps = range(length - 1, -1, -1) if reverse else range(length)
+        for t in steps:
+            env_t = {}
+            for cv, cval in zip(bj.constvars, closed.consts):
+                env_t[cv] = cached_const(cval, "scan_c")
+            for bv, nm in zip(bj.invars[:n_const], const_names):
+                env_t[bv] = nm
+            for bv, nm in zip(bj.invars[n_const:n_const + n_carry], carry):
+                env_t[bv] = nm
+            for bv, nm, shp_c in zip(bj.invars[n_const + n_carry:],
+                                     xs_names, xs_shape_consts):
+                row = g.fresh("xs_row")
+                g.add("Slice",
+                      [nm, g.const(_np.asarray([t], _np.int64)),
+                       g.const(_np.asarray([t + 1], _np.int64)),
+                       axes0, step1], [row])
+                sq = g.fresh("x_t")
+                g.add("Reshape", [row, shp_c], [sq])
+                env_t[bv] = sq
+            walk(bj, env_t)
+            carry = [name_of(env_t, v) for v in bj.outvars[:n_carry]]
+            for i, yv in enumerate(bj.outvars[n_carry:]):
+                ynm = name_of(env_t, yv)
+                un = g.fresh("y_row")
+                g.add("Reshape", [ynm, ys_shape_consts[i]], [un])
+                ys_rows[i][t] = un
+        for ov, nm in zip(eqn.outvars[:n_carry], carry):
+            env[ov] = nm
+        for i, ov in enumerate(eqn.outvars[n_carry:]):
+            stacked = g.fresh("ys")
+            if length == 1:
+                g.add("Identity", [ys_rows[i][0]], [stacked])
+            else:
+                g.add("Concat", ys_rows[i], [stacked], axis=0)
+            env[ov] = stacked
+
+    def walk(jx, env):
         for eqn in jx.eqns:
             if eqn.primitive.name in ("pjit", "jit", "closed_call",
                                       "core_call", "custom_jvp_call",
@@ -371,28 +498,32 @@ def export_model(net, example_input, path, input_name="data",
                 inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
                 ij = inner.jaxpr if hasattr(inner, "jaxpr") else inner
                 iconsts = getattr(inner, "consts", [])
+                sub = {}
                 for cv, cval in zip(ij.constvars, iconsts):
-                    names[cv] = g.const(_np.asarray(cval), "param")
+                    sub[cv] = cached_const(cval, "param")
                 n_call_in = len(ij.invars)
                 for iv, ov in zip(ij.invars,
                                   eqn.invars[len(eqn.invars) - n_call_in:]):
-                    names[iv] = name_of(ov)
-                walk(ij)
+                    sub[iv] = name_of(env, ov)
+                walk(ij, sub)
                 for souter, sinner in zip(eqn.outvars, ij.outvars):
-                    names[souter] = name_of(sinner)
+                    env[souter] = name_of(sub, sinner)
                 continue
-            ins = [name_of(v) for v in eqn.invars]
+            if eqn.primitive.name == "scan":
+                unroll_scan(eqn, env)
+                continue
+            ins = [name_of(env, v) for v in eqn.invars]
             outs = []
             for ov in eqn.outvars:
                 nm = g.fresh("v")
-                names[ov] = nm
+                env[ov] = nm
                 outs.append(nm)
             tr.translate(eqn, ins, outs)
 
-    walk(jaxpr)
+    walk(jaxpr, names)
 
     out_var = jaxpr.outvars[0]
-    final = name_of(out_var)
+    final = name_of(names, out_var)
     g.add("Identity", [final], [output_name])
 
     in_shape, in_dtype = tuple(x_raw.shape), _canon_dtype(x_raw.dtype)

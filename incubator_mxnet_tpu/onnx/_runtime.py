@@ -340,6 +340,9 @@ def run(path_or_graph, inputs):
             o = i[0] <= i[1]
         elif op == "Equal":
             o = i[0] == i[1]
+        elif op == "Gather":
+            o = _np.take(i[0], i[1].astype(_np.int64),
+                         axis=int(nd.attrs.get("axis", 0)))
         elif op == "IsInf":
             o = _np.isinf(i[0])
         elif op == "IsNaN":

@@ -108,3 +108,71 @@ def test_export_isfinite_semantics(tmp_path):
     mxonnx.export_model(fn, x, path)
     got = _runtime.run(path, {"data": x})
     np.testing.assert_array_equal(got, [1.0, 0.0, 0.0, 0.0, 1.0])
+
+
+def test_export_resnet50_numeric(tmp_path):
+    """VERDICT-r3 Next #8: the flagship CNN exports (64px input keeps the
+    numpy-evaluator runtime bounded; the graph is identical to 224px)."""
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet50_v1(layout="NHWC")
+    net.initialize()
+    x = mx.np.array(
+        np.random.RandomState(5).randn(1, 64, 64, 3).astype(np.float32))
+    net(x)
+    path, ref, got = _export_and_run(net, x, tmp_path, "resnet50")
+    assert got.shape == ref.shape == (1, 1000)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_export_embedding_gather(tmp_path):
+    """Embedding exports as ONNX Gather (jax gather axis-pattern)."""
+    net = gluon.nn.Embedding(30, 8)
+    net.initialize()
+    t = mx.np.array(np.array([[1, 5, 7], [2, 0, 29]], np.int32))
+    net(t)
+    ref = net(t).asnumpy()
+    path = str(tmp_path / "emb.onnx")
+    mxonnx.export_model(net, t, path)
+    got = _runtime.run(path, {"data": t.asnumpy()})
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_export_lstm_lm_numeric(tmp_path):
+    """VERDICT-r3 Next #8: the LSTM LM exports — Embedding (gather) +
+    lax.scan (static unroll) + gate splits — and the numpy evaluator
+    reproduces the source logits."""
+    from incubator_mxnet_tpu.gluon import nn, rnn
+
+    class LM(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(50, 16)
+            self.lstm = rnn.LSTM(32, num_layers=1)
+            self.out = nn.Dense(50, flatten=False)
+
+        def forward(self, t):
+            e = self.emb(t)
+            h = self.lstm(e.transpose(1, 0, 2))
+            return self.out(h.transpose(1, 0, 2))
+
+    lm = LM()
+    lm.initialize()
+    t = mx.np.array(np.random.RandomState(3).randint(0, 50, (2, 12)))
+    ref = lm(t).asnumpy()
+    path = str(tmp_path / "lm.onnx")
+    mxonnx.export_model(lm, t, path)
+    got = _runtime.run(path, {"data": t.asnumpy()})
+    assert got.shape == ref.shape == (2, 12, 50)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_export_scan_unroll_bound(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x):
+        return jax.lax.scan(lambda c, t: (c + t, c), x[0], x)[1]
+
+    with pytest.raises(mx.MXNetError, match="unroll bound"):
+        mxonnx.export_model(fn, np.ones((600, 4), np.float32),
+                            str(tmp_path / "big.onnx"))
