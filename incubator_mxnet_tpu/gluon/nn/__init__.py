@@ -334,18 +334,43 @@ class RMSNorm(HybridBlock):
 # Lambda:904, Concatenate:1002, Identity:1066)
 # ---------------------------------------------------------------------------
 class Embedding(HybridBlock):
+    """≙ gluon.nn.Embedding. `sparse_grad=True` enables the TPU-native
+    counterpart of the reference's row_sparse gradient path
+    (python/mxnet/gluon/trainer.py:325 row-sparse pulls): the backward is
+    XLA's scatter-add (cost scales with tokens touched), and gluon.Trainer
+    applies a TOUCHED-ROWS optimizer update — only rows referenced since
+    the last step are updated (the reference's lazy_update semantics:
+    untouched rows receive no decay/momentum aging). Promoted from
+    tests/nightly/test_large_vocab_embedding.py viability evidence."""
+
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False):
         super().__init__()
-        if sparse_grad:
-            raise MXNetError("sparse_grad embedding is unsupported on TPU "
-                             "(dense grads only; SURVEY §7 hard-part #4)")
         self._input_dim = input_dim
         self._output_dim = output_dim
+        self._sparse_grad = bool(sparse_grad)
         self.weight = Parameter(shape=(input_dim, output_dim), dtype=dtype,
                                 init=weight_initializer, name="weight")
+        if self._sparse_grad:
+            self.weight._sparse_grad = True
 
     def forward(self, x):
+        if self._sparse_grad:
+            import jax
+            from ... import autograd as _ag
+            raw = x._arr if hasattr(x, "_arr") else x
+            if isinstance(raw, jax.core.Tracer):
+                # symbolic indices (hybridize/jit): the trainer falls back
+                # to the dense update
+                self.weight._last_tokens = None
+            elif _ag.is_recording():
+                # ACCUMULATE recorded batches (grad_req='add' / multiple
+                # calls per iteration touch the union of their rows);
+                # inference forwards between backward and step must not
+                # disturb the recorded set
+                prev = getattr(self.weight, "_last_tokens", None)
+                self.weight._last_tokens = (list(prev) if prev else []) \
+                    + [raw]
         return npx.embedding(x, self.weight.data())
 
     def __repr__(self):

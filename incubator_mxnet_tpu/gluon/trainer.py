@@ -156,6 +156,14 @@ class Trainer:
                 self._states[i] = \
                     self._optimizer.create_state_multi_precision(i, p.data())
                 self._states_created[i] = True
+            if getattr(p, "_sparse_grad", False) \
+                    and getattr(p, "_last_tokens", None) is not None:
+                # row-sparse path (≙ trainer.py:325 row-sparse pull +
+                # lazy_update): only rows touched since the last step
+                self._row_sparse_update(i, p, self._states[i])
+                if p.data()._var is not None:
+                    p.data()._var.fresh = False
+                continue
             items.append((i, p.data(), p.grad(), self._states[i]))
         # one fused XLA computation for all params when the rule supports
         # it (≙ multi_sgd_update etc.). Under engine op-bulking the update
@@ -170,6 +178,51 @@ class Trainer:
         for i, w, g, s in items:
             if w._var is not None:
                 w._var.fresh = False
+
+    def _row_sparse_update(self, i, p, state):
+        """Touched-rows optimizer update for sparse_grad parameters.
+
+        TPU-native ≙ the reference's row_sparse gradient + row-sparse
+        kvstore pull (trainer.py:325) with lazy_update semantics: gather
+        the unique touched rows of weight/grad/state, run the optimizer's
+        own step_one on the row block, scatter back. Cost scales with
+        rows touched, not the vocabulary."""
+        import numpy as _onp
+
+        from ..ndarray import _wrap
+
+        token_batches = p._last_tokens
+        p._last_tokens = None
+        idx = _onp.unique(_onp.concatenate(
+            [_onp.asarray(t).ravel() for t in token_batches])
+        ).astype(_onp.int32)
+        w_nd, g_nd = p.data(), p.grad()
+        w, g = w_nd._arr, g_nd._arr
+
+        def gather_state(s):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(gather_state(x) for x in s)
+            return _wrap(s._arr[idx])
+
+        def scatter_state(s, rows):
+            if s is None:
+                return
+            if isinstance(s, tuple):
+                for sub, r in zip(s, rows):
+                    scatter_state(sub, r)
+                return
+            s._set_arr(s._arr.at[idx].set(rows._arr))
+
+        w_rows = _wrap(w[idx])
+        g_rows = _wrap(g[idx])
+        s_rows = gather_state(state)
+        # the optimizer's own rule on the row block (lr/wd resolved as
+        # usual, multi-precision state handled); mutates the row copies
+        self._optimizer.update_multi_precision(i, w_rows, g_rows, s_rows)
+        w_nd._set_arr(w.at[idx].set(w_rows._arr))
+        scatter_state(state, s_rows)
 
     def _mark_consumed(self):
         for p in self._params:

@@ -343,9 +343,38 @@ class KVStore(KVStoreBase):
             self.pull(key, out, priority)
         return out
 
-    def row_sparse_pull(self, *a, **kw):
-        raise MXNetError("row_sparse storage is unsupported on TPU "
-                         "(SURVEY §7 hard-part #4: dense only)")
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """≙ KVStore::PullRowSparse (kvstore.h:320 + trainer.py:325): pull
+        only the requested rows of a stored table. Dense-native semantics:
+        `out` of shape (len(rows), D) receives the gathered rows; `out` of
+        full table shape receives the rows written in place (other rows
+        untouched). Cost scales with rows requested, not the table."""
+        import jax.numpy as jnp
+        if row_ids is None or out is None:
+            raise MXNetError("row_sparse_pull needs out= and row_ids=")
+        keys, outs = _pairs(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) \
+            else [row_ids] * len(keys)
+        for k, o, r in zip(keys, outs, rids):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized in kvstore")
+            val = self._store[k]
+            idx = jnp.asarray(
+                r._arr if hasattr(r, "_arr") else _np.asarray(r)
+            ).reshape(-1).astype(jnp.int32)
+            rows = val._arr[idx]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                if tuple(t.shape) == tuple(rows.shape):
+                    t._set_arr(rows)
+                elif tuple(t.shape) == tuple(val.shape):
+                    t._set_arr(t._arr.at[idx].set(rows))
+                else:
+                    raise MXNetError(
+                        f"row_sparse_pull out shape {tuple(t.shape)} "
+                        f"matches neither rows {tuple(rows.shape)} nor "
+                        f"table {tuple(val.shape)}")
+        return out
 
     # ------------------------------------------------------------------
     def set_updater(self, updater):
