@@ -400,6 +400,10 @@ int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
 int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
                                    const char *cmd_body);
 int MXInitPSEnv(uint32_t num_vars, const char **keys, const char **vals);
+/* Load an extension library: a Python module with register_ops(mx), or a
+ * native .so implementing the mxtpu_ext_* ABI (see mx.library docs;
+ * role parity with the reference MXLoadLib + lib_api.h). */
+int MXLoadLib(const char *path, unsigned verbose);
 
 #ifdef __cplusplus
 }

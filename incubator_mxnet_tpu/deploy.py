@@ -1110,3 +1110,10 @@ def _capi_kv_is_scheduler(_kv):
 
 def _capi_kv_num_dead(_kv, _node_id):
     return 0   # PJRT surfaces failures as errors, not dead-node counts
+
+
+def _capi_load_lib(path, verbose=0):
+    """≙ MXLoadLib: load a Python or native (.so) extension."""
+    from . import library
+    library.load(path, verbose=bool(verbose))
+    return True

@@ -9,6 +9,7 @@ from __future__ import annotations
 from .parameter import Parameter, Constant, DeferredInitializationError
 from .block import Block, HybridBlock, SymbolBlock
 from .trainer import Trainer
+from . import subgraph
 from . import nn
 from . import loss
 from . import metric

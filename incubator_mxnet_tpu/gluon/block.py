@@ -380,6 +380,7 @@ class HybridBlock(Block):
         self._cached_params = None  # stable param order for the cache
         self._shapes_ready = False
         self._jit_kwargs = {}
+        self._subgraph_backend = None  # optimize_for rewriter (subgraph.py)
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
                   **kwargs):
@@ -505,6 +506,15 @@ class HybridBlock(Block):
             aux = tuple(mutated[i] for i in sorted(mutated))
             return out_raw, aux, None
 
+        fwd = pure_fn
+        if self._subgraph_backend is not None:
+            # optimize_for plug-in point: the backend rewrites the traced
+            # equations; the result still compiles as one XLA program.
+            # The backward below recomputes through THIS wrapped forward,
+            # so gradients flow through the rewritten math, not the
+            # original equations.
+            fwd = self._subgraph_backend.transform_callable(pure_fn)
+
         bwd_cache = {}
 
         def get_bwd(n_in):
@@ -525,7 +535,7 @@ class HybridBlock(Block):
             def bwd_fn(key, flat_args, cts):
                 def flat_fn(*a):
                     inputs, pbufs = a[:n_in], a[n_in:]
-                    outs, aux, _ = pure_fn(pbufs, key, *inputs)
+                    outs, aux, _ = fwd(pbufs, key, *inputs)
                     return tuple(outs) + tuple(aux)
 
                 # replay the forward's autocast state: backward runs with
@@ -546,23 +556,29 @@ class HybridBlock(Block):
             bwd_cache[n_in] = bwd
             return bwd
 
-        return jax.jit(pure_fn), meta, get_bwd
+        return jax.jit(fwd), meta, get_bwd
 
     # ------------------------------------------------------------------
     def optimize_for(self, x, *args, backend=None, **kwargs):
-        """≙ HybridBlock.optimize_for (block.py:1272): on TPU all graph
-        optimization happens in XLA; this hybridizes and warms the cache.
+        """≙ HybridBlock.optimize_for (block.py:1272).
 
-        Unknown backends raise (reference semantics: partitioning for an
-        unregistered backend is an error, not a silent no-op)."""
+        On TPU the baseline graph optimization happens in XLA, so
+        backend=None/'xla' hybridizes and warms the cache. Registered
+        SUBGRAPH BACKENDS (gluon.subgraph.register_subgraph_backend, the
+        plug-in point ≙ subgraph_property.h) additionally rewrite the
+        traced equations before jit — third-party rewrites compose into
+        the same compiled program. Unknown backends raise (reference
+        semantics: partitioning for an unregistered backend is an error,
+        not a silent no-op)."""
         _KNOWN = (None, "xla", "XLA", "tpu", "TPU")
         if backend not in _KNOWN:
-            from ..base import MXNetError
-            raise MXNetError(
-                f"optimize_for backend {backend!r} is not available on this "
-                "stack; XLA owns graph partitioning/optimization (pass "
-                "backend=None or 'xla'). Reference backends like 'MKLDNN' "
-                "or 'TensorRT' have no TPU equivalent")
+            from .subgraph import get_subgraph_backend
+            self._subgraph_backend = get_subgraph_backend(backend)
+            self._cached_graph.clear()   # rebuild with the rewriter applied
+        elif self._subgraph_backend is not None:
+            # explicit revert to the baseline stack
+            self._subgraph_backend = None
+            self._cached_graph.clear()
         self.hybridize(True)
         self(x, *args)
 

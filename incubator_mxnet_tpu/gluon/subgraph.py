@@ -1,0 +1,128 @@
+"""Subgraph backend plug-in point (≙ the reference's subgraph property
+framework, src/operator/subgraph/subgraph_property.h:88-211 + the
+`optimize_for(backend)` partitioning API, block.py:1272).
+
+TPU-native redesign: the reference partitions an nnvm graph and hands
+subgraphs to a backend's C++ operators; here the graph IS the traced
+jaxpr, so a backend is a REWRITER over jaxpr equations. When a block is
+`optimize_for`'d with a registered backend, its hybridize cache re-traces
+the forward and re-evaluates it equation by equation, letting the backend
+substitute any primitive application with its own (traceable) computation
+— the whole result still compiles into ONE XLA program, so a backend
+rewrite composes with jit/grad like native code.
+
+    class MyBackend(SubgraphBackend):
+        def rewrite_eqn(self, eqn, invals):
+            if eqn.primitive.name == "tanh":
+                return [my_fast_tanh(invals[0])]
+            return None                      # default lowering
+
+    register_subgraph_backend("mine", MyBackend())
+    net.optimize_for(x, backend="mine")
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["SubgraphBackend", "register_subgraph_backend",
+           "list_subgraph_backends", "get_subgraph_backend",
+           "rewrite_callable"]
+
+_BACKENDS = {}
+
+
+class SubgraphBackend:
+    """Override one (or both) hooks.
+
+    rewrite_eqn(eqn, invals) -> list-of-outputs | None
+        Called per traced equation with concrete (traced) input values;
+        return replacement outputs, or None to keep the default lowering.
+
+    transform_callable(fn) -> fn
+        Whole-function hook: wrap/replace the pure callable before jit
+        (e.g. to pre/post-process or re-trace with custom logic)."""
+
+    def rewrite_eqn(self, eqn, invals):
+        return None
+
+    def transform_callable(self, fn):
+        return rewrite_callable(fn, self)
+
+
+def register_subgraph_backend(name, backend):
+    """≙ MXNET_REGISTER_SUBGRAPH_BACKEND."""
+    if not isinstance(backend, SubgraphBackend):
+        raise MXNetError("backend must be a SubgraphBackend")
+    _BACKENDS[name] = backend
+    return backend
+
+
+def list_subgraph_backends():
+    return sorted(_BACKENDS)
+
+
+def get_subgraph_backend(name):
+    b = _BACKENDS.get(name)
+    if b is None:
+        raise MXNetError(
+            f"subgraph backend {name!r} is not registered "
+            f"(registered: {list_subgraph_backends() or 'none'})")
+    return b
+
+
+def _eval_with_rewrites(closed, backend, *args):
+    """Evaluate a closed jaxpr, offering every equation to the backend."""
+    import jax.extend.core as jcore
+
+    jaxpr, consts = closed.jaxpr, closed.consts
+    env = {}
+
+    def read(v):
+        if isinstance(v, jcore.Literal):
+            return v.val
+        return env[v]
+
+    for cv, c in zip(jaxpr.constvars, consts):
+        env[cv] = c
+    for iv, a in zip(jaxpr.invars, args):
+        env[iv] = a
+    for eqn in jaxpr.eqns:
+        invals = [read(v) for v in eqn.invars]
+        outs = backend.rewrite_eqn(eqn, invals)
+        if outs is None:
+            subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
+            res = eqn.primitive.bind(*subfuns, *invals, **bind_params)
+            outs = res if eqn.primitive.multiple_results else [res]
+        elif not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        if len(outs) != len(eqn.outvars):
+            raise MXNetError(
+                f"backend rewrite of {eqn.primitive.name!r} returned "
+                f"{len(outs)} outputs, expected {len(eqn.outvars)}")
+        for ov, o in zip(eqn.outvars, outs):
+            env[ov] = o
+    return [read(v) for v in jaxpr.outvars]
+
+
+def rewrite_callable(fn, backend):
+    """fn -> fn with the backend's equation rewrites applied. The wrapper
+    traces `fn` (abstractly) per call and re-evaluates with substitutions
+    — safe under an outer jit (everything stays traceable, and tracing
+    inside the outer trace costs nothing at runtime)."""
+    import jax
+
+    def wrapped(*args):
+        flat, treedef = jax.tree_util.tree_flatten(args)
+        out_tree = {}
+
+        def flat_fn(*xs):
+            out = fn(*jax.tree_util.tree_unflatten(treedef, xs))
+            out_flat, tree = jax.tree_util.tree_flatten(out)
+            out_tree["tree"] = tree
+            return out_flat
+
+        closed = jax.make_jaxpr(flat_fn)(*flat)
+        out_flat = _eval_with_rewrites(closed, backend, *flat)
+        return jax.tree_util.tree_unflatten(out_tree["tree"], out_flat)
+
+    return wrapped

@@ -2073,6 +2073,14 @@ int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
   return 0;  // no server processes in the SPMD runtime (≙ reference no-op)
 }
 
+int MXLoadLib(const char *path, unsigned verbose) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(
+      "_capi_load_lib",
+      tup({str_or_empty(path), PyLong_FromUnsignedLong(verbose)})));
+}
+
 int MXInitPSEnv(uint32_t num_vars, const char **keys, const char **vals) {
   (void)num_vars;
   (void)keys;

@@ -1,0 +1,143 @@
+"""Native extension-library ABI (VERDICT-r3 Missing #6, ≙ MXLoadLib +
+include/mxnet/lib_api.h:649-771 CustomOp from an external .so): a C
+extension compiled in-test registers ops that run eagerly, under jit,
+and through the C ABI's MXLoadLib."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+EXT_SRC = r'''
+#include <stdint.h>
+#include <string.h>
+
+/* two ops: "ext_scale2" (y = 2x, same shape) and "ext_rowsum"
+   (y[i] = sum_j x[i][j], rank-2 -> rank-1) */
+
+int mxtpu_ext_abi_version(void) { return 1; }
+int mxtpu_ext_num_ops(void) { return 2; }
+const char* mxtpu_ext_op_name(int i) {
+  return i == 0 ? "ext_scale2" : "ext_rowsum";
+}
+
+int mxtpu_ext_infer_shape(const char* op, int n_in,
+                          const int64_t* shapes_flat, const int* ndims,
+                          int64_t* out_shape, int* out_ndim) {
+  if (n_in != 1) return 1;
+  if (strcmp(op, "ext_scale2") == 0) {
+    for (int i = 0; i < ndims[0]; ++i) out_shape[i] = shapes_flat[i];
+    *out_ndim = ndims[0];
+    return 0;
+  }
+  if (strcmp(op, "ext_rowsum") == 0) {
+    if (ndims[0] != 2) return 2;
+    out_shape[0] = shapes_flat[0];
+    *out_ndim = 1;
+    return 0;
+  }
+  return 3;
+}
+
+int mxtpu_ext_compute(const char* op, int n_in, const float** ins,
+                      const int64_t* shapes_flat, const int* ndims,
+                      float* out, const int64_t* out_shape, int out_ndim) {
+  (void)n_in; (void)out_ndim;
+  if (strcmp(op, "ext_scale2") == 0) {
+    int64_t n = 1;
+    for (int i = 0; i < ndims[0]; ++i) n *= shapes_flat[i];
+    for (int64_t i = 0; i < n; ++i) out[i] = 2.0f * ins[0][i];
+    return 0;
+  }
+  if (strcmp(op, "ext_rowsum") == 0) {
+    int64_t rows = shapes_flat[0], cols = shapes_flat[1];
+    for (int64_t r = 0; r < rows; ++r) {
+      float s = 0.f;
+      for (int64_t c = 0; c < cols; ++c) s += ins[0][r * cols + c];
+      out[r] = s;
+    }
+    return 0;
+  }
+  return 3;
+}
+'''
+
+
+@pytest.fixture(scope="module")
+def ext_so(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "myext.c"
+    src.write_text(EXT_SRC)
+    out = d / "libmyext.so"
+    subprocess.run(["gcc", "-O2", "-shared", "-fPIC", str(src),
+                    "-o", str(out)], check=True, capture_output=True)
+    return str(out)
+
+
+def test_load_native_and_invoke(ext_so):
+    from incubator_mxnet_tpu import library, npx
+    library.load(ext_so, verbose=False)
+    x = mx.np.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = npx.ext_scale2(x)
+    np.testing.assert_allclose(y.asnumpy(), 2 * x.asnumpy())
+    rs = npx.ext_rowsum(x)
+    np.testing.assert_allclose(rs.asnumpy(), x.asnumpy().sum(axis=1))
+
+
+def test_extension_op_under_jit(ext_so):
+    """pure_callback bridging: the host kernel composes into a jitted
+    graph (the property lib_api.h cannot offer — here extensions ride
+    inside compiled programs)."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import library
+    ext = library.load_native(ext_so, verbose=False)
+    fn = ext["ops"]["ext_scale2"]
+
+    @jax.jit
+    def f(a):
+        return fn(a) + 1.0
+
+    a = jnp.asarray(np.ones((3,), np.float32))
+    np.testing.assert_allclose(np.asarray(f(a)), 3.0)
+
+
+def test_mxloadlib_through_c_abi(ext_so):
+    import ctypes
+    from incubator_mxnet_tpu.native import build_capi
+    lib = ctypes.CDLL(build_capi())
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    # 64-bit handles MUST have argtypes declared — the ctypes default
+    # converts them through a 32-bit C int and truncates the pointer
+    lib.MXNDArraySyncCopyToCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    assert lib.MXLoadLib(ext_so.encode(), 0) == 0, lib.MXGetLastError()
+    # the op is now reachable via MXImperativeInvoke
+    data = (ctypes.c_float * 4)(1, 2, 3, 4)
+    shape = (ctypes.c_int64 * 1)(4)
+    h = ctypes.c_void_p()
+    assert lib.MXNDArrayCreate(data, shape, 1, 0, ctypes.byref(h)) == 0
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    ins = (ctypes.c_void_p * 1)(h)
+    assert lib.MXImperativeInvoke(b"ext_scale2", 1, ins, b"",
+                                  ctypes.byref(n_out),
+                                  ctypes.byref(outs)) == 0, \
+        lib.MXGetLastError()
+    host = (ctypes.c_float * 4)()
+    assert lib.MXNDArraySyncCopyToCPU(outs[0], host, 16) == 0
+    assert list(host) == [2.0, 4.0, 6.0, 8.0]
+
+
+def test_bad_extension_rejected(tmp_path):
+    from incubator_mxnet_tpu import library
+    src = tmp_path / "bad.c"
+    src.write_text("int nothing(void){return 0;}")
+    out = tmp_path / "libbad.so"
+    subprocess.run(["gcc", "-shared", "-fPIC", str(src), "-o", str(out)],
+                   check=True, capture_output=True)
+    with pytest.raises(mx.MXNetError, match="missing symbol"):
+        library.load_native(str(out))

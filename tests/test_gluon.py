@@ -661,7 +661,7 @@ def test_optimize_for_rejects_unknown_backend():
     net = nn.Dense(4, in_units=4)
     net.initialize()
     x = mx.np.ones((2, 4))
-    with pytest.raises(mx.MXNetError, match="not available"):
+    with pytest.raises(mx.MXNetError, match="not registered"):
         net.optimize_for(x, backend="TensorRT")
     net.optimize_for(x, backend="xla")  # known backend works
     assert net._active  # hybridized

@@ -1,0 +1,84 @@
+"""Subgraph backend plug-in point (VERDICT-r3 Missing #7 / Weak #7,
+≙ src/operator/subgraph/subgraph_property.h:88-211): optimize_for with a
+REGISTERED backend rewrites the traced equations before jit."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.gluon.subgraph import (
+    SubgraphBackend, register_subgraph_backend, list_subgraph_backends)
+
+
+class _TanhToIdentity(SubgraphBackend):
+    """A visible rewrite: tanh(x) -> x (checkable numerically)."""
+
+    def __init__(self):
+        self.hits = 0
+
+    def rewrite_eqn(self, eqn, invals):
+        if eqn.primitive.name == "tanh":
+            self.hits += 1
+            return [invals[0]]
+        return None
+
+
+def test_backend_rewrites_and_composes_with_jit():
+    backend = _TanhToIdentity()
+    register_subgraph_backend("tanh_ident", backend)
+    assert "tanh_ident" in list_subgraph_backends()
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(5, activation="tanh", in_units=4), nn.Dense(3))
+    net.initialize()
+    x = mx.np.array(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+    ref = net(x).asnumpy()          # eager, un-rewritten
+
+    net.optimize_for(x, backend="tanh_ident")
+    got = net(x).asnumpy()
+    assert backend.hits >= 1
+    # manual expectation: identity instead of tanh in the hidden layer
+    w1 = net[0].weight.data().asnumpy()
+    b1 = net[0].bias.data().asnumpy()
+    w2 = net[1].weight.data().asnumpy()
+    b2 = net[1].bias.data().asnumpy()
+    h = x.asnumpy() @ w1.T + b1
+    expect = h @ w2.T + b2
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+    assert not np.allclose(got, ref)   # the rewrite visibly changed math
+
+
+def test_unregistered_backend_raises():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    x = mx.np.array(np.ones((1, 2), np.float32))
+    with pytest.raises(mx.MXNetError, match="not registered"):
+        net.optimize_for(x, backend="no_such_backend")
+
+
+def test_xla_backend_still_warms():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    x = mx.np.array(np.ones((1, 2), np.float32))
+    net.optimize_for(x, backend="xla")
+    assert net._active
+
+
+def test_gradients_flow_through_rewrite():
+    """The backward recomputes through the REWRITTEN forward: with tanh
+    replaced by identity, the hidden-layer gradient must be the identity
+    chain rule, not tanh's."""
+    backend = _TanhToIdentity()
+    register_subgraph_backend("tanh_ident_grad", backend)
+    net = nn.Dense(1, activation="tanh", in_units=3)
+    net.initialize()
+    x = mx.np.array(np.array([[10.0, 10.0, 10.0]], np.float32))  # saturates
+    net.optimize_for(x, backend="tanh_ident_grad")
+    with mx.autograd.record():
+        y = net(x)
+    y.backward()
+    gw = net.weight.grad().asnumpy()
+    # identity rewrite: dy/dw = x (nonzero); through real tanh at
+    # saturation the gradient would be ~0
+    np.testing.assert_allclose(gw, x.asnumpy(), rtol=1e-4)
