@@ -1,0 +1,122 @@
+"""Actor-critic policy gradient (≙ example/gluon/actor_critic/
+actor_critic.py). The reference drives OpenAI Gym's CartPole; this
+environment has no gym, so a self-contained CartPole physics step
+(standard Barto-Sutton-Anderson dynamics) keeps the example runnable
+end-to-end in zero-egress environments.
+
+    python examples/actor_critic.py [--episodes 150]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn
+
+
+class CartPole:
+    """Classic cart-pole balancing, 4-dim state, 2 actions."""
+
+    def __init__(self, seed=0):
+        self.rng = np.random.RandomState(seed)
+        self.reset()
+
+    def reset(self):
+        self.s = self.rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        return self.s.copy()
+
+    def step(self, action):
+        g, mc, mp, l, f, dt = 9.8, 1.0, 0.1, 0.5, 10.0, 0.02
+        x, xd, th, thd = self.s
+        force = f if action == 1 else -f
+        cos, sin = np.cos(th), np.sin(th)
+        tmp = (force + mp * l * thd ** 2 * sin) / (mc + mp)
+        thacc = (g * sin - cos * tmp) / (
+            l * (4.0 / 3.0 - mp * cos ** 2 / (mc + mp)))
+        xacc = tmp - mp * l * thacc * cos / (mc + mp)
+        self.s = np.array([x + dt * xd, xd + dt * xacc,
+                           th + dt * thd, thd + dt * thacc], np.float32)
+        done = bool(abs(self.s[0]) > 2.4 or abs(self.s[2]) > 0.2095)
+        return self.s.copy(), 1.0, done
+
+
+class ActorCritic(gluon.HybridBlock):
+    def __init__(self, num_actions=2):
+        super().__init__()
+        self.body = nn.Dense(128, activation="relu", in_units=4)
+        self.policy = nn.Dense(num_actions, in_units=128)
+        self.value = nn.Dense(1, in_units=128)
+
+    def forward(self, x):
+        h = self.body(x)
+        return self.policy(h), self.value(h)
+
+
+def run(episodes=150, gamma=0.99, lr=3e-2, seed=0):
+    mx.seed(seed)
+    env = CartPole(seed)
+    net = ActorCritic()
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    running = 10.0
+    for ep in range(episodes):
+        states, actions, rewards = [], [], []
+        s = env.reset()
+        for _ in range(500):
+            logits, _ = net(mx.np.array(s[None]))
+            p = np.asarray(mx.npx.softmax(logits).asnumpy())[0]
+            a = int(np.random.choice(len(p), p=p / p.sum()))
+            states.append(s)
+            actions.append(a)
+            s, r, done = env.step(a)
+            rewards.append(r)
+            if done:
+                break
+        # discounted returns, normalized (reference recipe)
+        R, returns = 0.0, []
+        for r in reversed(rewards):
+            R = r + gamma * R
+            returns.append(R)
+        returns = np.array(returns[::-1], np.float32)
+        returns = (returns - returns.mean()) / (returns.std() + 1e-6)
+
+        S = mx.np.array(np.stack(states))
+        A = mx.np.array(np.array(actions, np.int32))
+        G = mx.np.array(returns)
+        with mx.autograd.record():
+            logits, values = net(S)
+            logp = mx.npx.log_softmax(logits)
+            chosen = mx.npx.pick(logp, A.astype("float32"))
+            adv = G - mx.np.squeeze(values, axis=-1)
+            # actor loss on detached advantage + critic smooth-l1
+            actor = -(chosen * adv.detach()).sum()
+            critic = mx.np.abs(adv).sum()
+            loss = actor + critic
+        loss.backward()
+        trainer.step(1)
+        running = 0.95 * running + 0.05 * len(rewards)
+        if (ep + 1) % 25 == 0:
+            print(f"episode {ep + 1}: length {len(rewards)}, "
+                  f"running {running:.1f}")
+    return running
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=150)
+    args = ap.parse_args()
+    final = run(args.episodes)
+    print(f"final running episode length: {final:.1f}")
+    if final < 25:
+        raise SystemExit("policy did not improve")
+
+
+if __name__ == "__main__":
+    main()

@@ -12,3 +12,7 @@ from .distributions import (Distribution, Normal, Bernoulli, Categorical,
                             Geometric, Binomial, MultivariateNormal,
                             kl_divergence, register_kl)
 from .stochastic_block import StochasticBlock, StochasticSequential
+from .transformation import (Transformation, ComposeTransform, ExpTransform,
+                             AffineTransform, PowerTransform,
+                             SigmoidTransform, SoftmaxTransform,
+                             AbsTransform, TransformedDistribution)

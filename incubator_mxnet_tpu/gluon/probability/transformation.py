@@ -1,0 +1,238 @@
+"""Invertible transformations (≙ python/mxnet/gluon/probability/
+transformation/transformation.py: Transformation, ComposeTransform, Exp/
+Affine/Power/Sigmoid/Softmax/Abs transforms + TransformedDistribution
+support).
+
+TPU-native: each transform is a pair of pure jnp maps plus an analytic
+log|det J| — everything traces into the surrounding program; no
+per-transform ops."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+
+__all__ = ["Transformation", "ComposeTransform", "ExpTransform",
+           "AffineTransform", "PowerTransform", "SigmoidTransform",
+           "SoftmaxTransform", "AbsTransform", "TransformedDistribution"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _raw(x):
+    return x._arr if hasattr(x, "_arr") else x
+
+
+def _wrap(a):
+    from ...ndarray import _wrap as w
+    return w(a)
+
+
+class Transformation:
+    """y = f(x), invertible; event_dim = rank of the event a single
+    transform consumes (0 = elementwise)."""
+
+    bijective = True
+    event_dim = 0
+
+    def __call__(self, x):
+        return _wrap(self._forward(_raw(x)))
+
+    @property
+    def inv(self):
+        return _Inverse(self)
+
+    def inverse(self, y):
+        return _wrap(self._inverse(_raw(y)))
+
+    def log_det_jacobian(self, x, y=None):
+        xr = _raw(x)
+        yr = _raw(y) if y is not None else self._forward(xr)
+        return _wrap(self._log_det(xr, yr))
+
+    # -- to implement ----------------------------------------------------
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _log_det(self, x, y):
+        raise NotImplementedError
+
+
+class _Inverse(Transformation):
+    def __init__(self, base):
+        self._base = base
+        self.event_dim = base.event_dim
+
+    @property
+    def inv(self):
+        return self._base
+
+    def _forward(self, x):
+        return self._base._inverse(x)
+
+    def _inverse(self, y):
+        return self._base._forward(y)
+
+    def _log_det(self, x, y):
+        return -self._base._log_det(y, x)
+
+
+class ComposeTransform(Transformation):
+    """f_n ∘ ... ∘ f_1 (applied left to right, reference order)."""
+
+    def __init__(self, parts):
+        if not parts:
+            raise MXNetError("ComposeTransform needs at least one part")
+        self._parts = list(parts)
+        self.event_dim = max(p.event_dim for p in parts)
+
+    def _forward(self, x):
+        for p in self._parts:
+            x = p._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for p in reversed(self._parts):
+            y = p._inverse(y)
+        return y
+
+    def _log_det(self, x, y):
+        jnp = _jnp()
+        total = None
+        cur = x
+        for p in self._parts:
+            nxt = p._forward(cur)
+            ld = p._log_det(cur, nxt)
+            # reduce finer-grained event dims so parts sum consistently
+            while ld.ndim > 0 and p.event_dim < self.event_dim \
+                    and ld.ndim > x.ndim - self.event_dim:
+                ld = jnp.sum(ld, axis=-1)
+            total = ld if total is None else total + ld
+            cur = nxt
+        return total
+
+
+class ExpTransform(Transformation):
+    def _forward(self, x):
+        return _jnp().exp(x)
+
+    def _inverse(self, y):
+        return _jnp().log(y)
+
+    def _log_det(self, x, y):
+        return x
+
+
+class AffineTransform(Transformation):
+    def __init__(self, loc, scale):
+        self._loc = _raw(loc)
+        self._scale = _raw(scale)
+
+    def _forward(self, x):
+        return self._loc + self._scale * x
+
+    def _inverse(self, y):
+        return (y - self._loc) / self._scale
+
+    def _log_det(self, x, y):
+        jnp = _jnp()
+        return jnp.broadcast_to(jnp.log(jnp.abs(self._scale)), jnp.shape(x))
+
+
+class PowerTransform(Transformation):
+    def __init__(self, exponent):
+        self._exp = float(exponent)
+        if self._exp == 0:
+            raise MXNetError("PowerTransform exponent must be nonzero")
+
+    def _forward(self, x):
+        return x ** self._exp
+
+    def _inverse(self, y):
+        return y ** (1.0 / self._exp)
+
+    def _log_det(self, x, y):
+        jnp = _jnp()
+        return jnp.log(jnp.abs(self._exp * y / x))
+
+
+class SigmoidTransform(Transformation):
+    def _forward(self, x):
+        import jax
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        jnp = _jnp()
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _log_det(self, x, y):
+        import jax
+        jnp = _jnp()
+        # log sigma'(x) = -softplus(-x) - softplus(x)
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class SoftmaxTransform(Transformation):
+    """Not bijective (maps onto the simplex); log_det undefined."""
+
+    bijective = False
+    event_dim = 1
+
+    def _forward(self, x):
+        import jax
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return _jnp().log(y)
+
+    def _log_det(self, x, y):
+        raise MXNetError("SoftmaxTransform is not bijective")
+
+
+class AbsTransform(Transformation):
+    bijective = False
+
+    def _forward(self, x):
+        return _jnp().abs(x)
+
+    def _inverse(self, y):
+        return y   # a right-inverse (reference semantics)
+
+    def _log_det(self, x, y):
+        raise MXNetError("AbsTransform is not bijective")
+
+
+class TransformedDistribution:
+    """Distribution of f(X) for X ~ base (≙ transformed_distribution.py):
+    log_prob via the change-of-variables formula, sampling by pushing base
+    samples through the transform chain."""
+
+    def __init__(self, base, transforms):
+        if isinstance(transforms, Transformation):
+            transforms = [transforms]
+        self._base = base
+        self._chain = ComposeTransform(list(transforms))
+        if not self._chain.bijective or any(
+                not p.bijective for p in self._chain._parts):
+            raise MXNetError(
+                "TransformedDistribution needs bijective transforms")
+
+    def sample(self, shape=()):
+        x = self._base.sample(shape)
+        return self._chain(x)
+
+    def log_prob(self, value):
+        jnp = _jnp()
+        yr = _raw(value)
+        xr = self._chain._inverse(yr)
+        base_lp = _raw(self._base.log_prob(_wrap(xr)))
+        ld = self._chain._log_det(xr, yr)
+        while ld.ndim > base_lp.ndim:
+            ld = jnp.sum(ld, axis=-1)
+        return _wrap(base_lp - ld)

@@ -566,3 +566,172 @@ class GRU(_FusedRNNLayer):
 
     def __init__(self, hidden_size, num_layers=1, **kwargs):
         super().__init__("gru", hidden_size, num_layers, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Convolutional recurrent cells (≙ python/mxnet/gluon/rnn/conv_rnn_cell.py:
+# Conv{1D,2D,3D}{RNN,LSTM,GRU}Cell — gates computed by convolutions over
+# spatially-structured states). TPU-native: gate convs are lax convs via
+# npx.convolution, so a cell step fuses into one XLA program under
+# hybridize/unroll.
+# ---------------------------------------------------------------------------
+class _ConvGateCell(RecurrentCell):
+    """Shared conv-gate plumbing. input_shape is (C, *spatial) — required
+    up front (the reference also needs it: state shape depends on it)."""
+
+    def __init__(self, input_shape, hidden_channels, num_gates,
+                 i2h_kernel=(3, 3), h2h_kernel=(3, 3),
+                 i2h_pad=None, conv_layout="NCHW",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 activation="tanh"):
+        super().__init__()
+        if not conv_layout.startswith("NC"):
+            raise MXNetError("conv cells use channel-first layouts")
+        self._input_shape = tuple(input_shape)
+        self._hc = hidden_channels
+        self._ng = num_gates
+        nd = len(self._input_shape) - 1
+        derived_layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[nd]
+        if conv_layout not in ("NCHW", derived_layout):
+            # "NCHW" is the reference default arg; anything else must
+            # match the rank implied by input_shape
+            raise MXNetError(
+                f"conv_layout {conv_layout!r} does not match input_shape "
+                f"{input_shape} (expected {derived_layout!r})")
+        def _t(v):
+            return (v,) * nd if isinstance(v, int) else tuple(v)
+        self._i2h_kernel = _t(i2h_kernel)
+        self._h2h_kernel = _t(h2h_kernel)
+        if any(k % 2 == 0 for k in self._i2h_kernel + self._h2h_kernel):
+            # even kernels break the recurrence: k//2 padding would grow
+            # the state spatially every step (same constraint as the
+            # reference's conv cells in practice)
+            raise MXNetError(
+                "conv cell kernels must be odd so SAME padding keeps "
+                f"state spatial dims constant; got i2h={self._i2h_kernel} "
+                f"h2h={self._h2h_kernel}")
+        # SAME padding so state spatial dims stay constant
+        self._i2h_pad = _t(i2h_pad) if i2h_pad is not None else tuple(
+            k // 2 for k in self._i2h_kernel)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        self._act = activation
+        in_c = self._input_shape[0]
+        self._spatial = self._input_shape[1:]
+        self._layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[nd]
+        self.i2h_weight = _cell_param(
+            (num_gates * hidden_channels, in_c) + self._i2h_kernel,
+            i2h_weight_initializer, "i2h_weight")
+        self.h2h_weight = _cell_param(
+            (num_gates * hidden_channels, hidden_channels)
+            + self._h2h_kernel,
+            h2h_weight_initializer, "h2h_weight")
+        self.i2h_bias = _cell_param((num_gates * hidden_channels,),
+                                    i2h_bias_initializer, "i2h_bias")
+        self.h2h_bias = _cell_param((num_gates * hidden_channels,),
+                                    h2h_bias_initializer, "h2h_bias")
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hc) + self._spatial
+        n_states = 2 if self._ng == 4 else 1
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[-len(
+            self._spatial):]} for _ in range(n_states)]
+
+    def _gates(self, inputs, h):
+        """Returns (gi, gh) separately — the GRU needs the h-branch
+        pre-activation apart; RNN/LSTM callers sum them."""
+        gi = npx.convolution(inputs, self.i2h_weight.data(),
+                             self.i2h_bias.data(), pad=self._i2h_pad,
+                             num_filter=self._ng * self._hc,
+                             layout=self._layout)
+        gh = npx.convolution(h, self.h2h_weight.data(),
+                             self.h2h_bias.data(), pad=self._h2h_pad,
+                             num_filter=self._ng * self._hc,
+                             layout=self._layout)
+        return gi, gh
+
+    def _split_gates(self, g):
+        return [g[:, i * self._hc:(i + 1) * self._hc] for i in
+                range(self._ng)]
+
+
+class _ConvRNNCellImpl(_ConvGateCell):
+    def __init__(self, input_shape, hidden_channels, **kw):
+        super().__init__(input_shape, hidden_channels, 1, **kw)
+
+    def forward(self, inputs, states):
+        gi, gh = self._gates(inputs, states[0])
+        out = npx.activation(gi + gh, act_type=self._act)
+        return out, [out]
+
+
+class _ConvLSTMCellImpl(_ConvGateCell):
+    def __init__(self, input_shape, hidden_channels, **kw):
+        super().__init__(input_shape, hidden_channels, 4, **kw)
+
+    def forward(self, inputs, states):
+        h0, c0 = states
+        gi, gh = self._gates(inputs, h0)
+        i, f, g, o = self._split_gates(gi + gh)
+        i = npx.activation(i, act_type="sigmoid")
+        f = npx.activation(f, act_type="sigmoid")
+        g = npx.activation(g, act_type=self._act)
+        o = npx.activation(o, act_type="sigmoid")
+        c = f * c0 + i * g
+        h = o * npx.activation(c, act_type=self._act)
+        return h, [h, c]
+
+
+class _ConvGRUCellImpl(_ConvGateCell):
+    def __init__(self, input_shape, hidden_channels, **kw):
+        super().__init__(input_shape, hidden_channels, 3, **kw)
+
+    def forward(self, inputs, states):
+        h0 = states[0]
+        gi, gh = self._gates(inputs, h0)
+        ir, iz, inw = [gi[:, k * self._hc:(k + 1) * self._hc]
+                       for k in range(3)]
+        hr, hz, hnw = [gh[:, k * self._hc:(k + 1) * self._hc]
+                       for k in range(3)]
+        r = npx.activation(ir + hr, act_type="sigmoid")
+        z = npx.activation(iz + hz, act_type="sigmoid")
+        n = npx.activation(inw + r * hnw, act_type=self._act)
+        out = (1 - z) * n + z * h0
+        return out, [out]
+
+
+def _conv_cell_family(impl, suffix):
+    """1D/2D/3D named classes over one implementation (kernel rank comes
+    from input_shape; the named classes validate it, reference-style)."""
+    classes = {}
+    for nd, name in ((1, "Conv1D"), (2, "Conv2D"), (3, "Conv3D")):
+        def _make(nd=nd, name=name):
+            class Cell(impl):
+                def __init__(self, input_shape, hidden_channels,
+                             i2h_kernel=3, h2h_kernel=3, **kw):
+                    if len(tuple(input_shape)) != nd + 1:
+                        raise MXNetError(
+                            f"{name}{suffix} needs input_shape of "
+                            f"(C, {'x'.join('S' * nd)}), got {input_shape}")
+                    if isinstance(i2h_kernel, int):
+                        i2h_kernel = (i2h_kernel,) * nd
+                    if isinstance(h2h_kernel, int):
+                        h2h_kernel = (h2h_kernel,) * nd
+                    super().__init__(input_shape, hidden_channels,
+                                     i2h_kernel=i2h_kernel,
+                                     h2h_kernel=h2h_kernel, **kw)
+            Cell.__name__ = f"{name}{suffix}"
+            Cell.__qualname__ = Cell.__name__
+            Cell.__doc__ = (f"≙ rnn.conv_rnn_cell.{name}{suffix} "
+                            "(conv_rnn_cell.py)")
+            return Cell
+        classes[f"{name}{suffix}"] = _make()
+    return classes
+
+
+for _cls_name, _cls in {**_conv_cell_family(_ConvRNNCellImpl, "RNNCell"),
+                        **_conv_cell_family(_ConvLSTMCellImpl, "LSTMCell"),
+                        **_conv_cell_family(_ConvGRUCellImpl, "GRUCell")
+                        }.items():
+    globals()[_cls_name] = _cls
+    __all__.append(_cls_name)

@@ -6,11 +6,29 @@ decisively below its initial value (loss-trajectory assertion — the
 north-star "identical convergence" clause needs automated evidence, not
 examples).
 """
+import os
+
 import numpy as np
 import pytest
 
 
+def _cpu_backend_on_tiny_host():
+    # the suite conftest forces the CPU platform; 120 ResNet-50 steps
+    # there need a multicore host (hours on one core). On a real
+    # accelerator backend the test is cheap and always runs.
+    import jax
+    try:
+        backend = jax.default_backend()
+    except RuntimeError:
+        backend = "cpu"
+    return backend == "cpu" and (os.cpu_count() or 1) < 4
+
+
 @pytest.mark.nightly
+@pytest.mark.skipif(
+    _cpu_backend_on_tiny_host(),
+    reason="CPU fallback platform on a <4-core host: 120 ResNet-50 train "
+           "steps take hours; the real-chip path is exercised by bench.py")
 def test_resnet50_loss_trajectory_on_chip():
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import amp, gluon

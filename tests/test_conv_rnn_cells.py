@@ -1,0 +1,83 @@
+"""Conv-RNN cell family (≙ reference gluon/rnn/conv_rnn_cell.py):
+shapes across ranks, gate math vs a manual NumPy step, unroll, and
+hybridize equivalence."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import rnn
+
+
+@pytest.mark.parametrize("cls,nd,ns", [
+    (rnn.Conv1DRNNCell, 1, 1), (rnn.Conv2DRNNCell, 2, 1),
+    (rnn.Conv3DRNNCell, 3, 1), (rnn.Conv1DLSTMCell, 1, 2),
+    (rnn.Conv2DLSTMCell, 2, 2), (rnn.Conv3DLSTMCell, 3, 2),
+    (rnn.Conv1DGRUCell, 1, 1), (rnn.Conv2DGRUCell, 2, 1),
+    (rnn.Conv3DGRUCell, 3, 1),
+])
+def test_shapes_all_ranks(cls, nd, ns):
+    spatial = (6,) * nd
+    cell = cls((3,) + spatial, 5)
+    cell.initialize()
+    x = mx.np.array(np.random.RandomState(0).randn(
+        2, 3, *spatial).astype(np.float32))
+    states = cell.begin_state(2)
+    assert len(states) == ns
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 5) + spatial
+    for s in new_states:
+        assert s.shape == (2, 5) + spatial
+
+
+def test_conv_lstm_matches_manual():
+    """One step vs a hand-rolled NumPy conv-LSTM (gate order i,f,g,o)."""
+    from scipy import signal
+    cell = rnn.Conv2DLSTMCell((1, 5, 5), 1, i2h_kernel=3, h2h_kernel=3)
+    cell.initialize()
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 1, 5, 5).astype(np.float32)
+    h0 = rng.randn(1, 1, 5, 5).astype(np.float32)
+    c0 = rng.randn(1, 1, 5, 5).astype(np.float32)
+    out, (h, c) = cell(mx.np.array(x),
+                       [mx.np.array(h0), mx.np.array(c0)])
+
+    wi = cell.i2h_weight.data().asnumpy()   # (4, 1, 3, 3)
+    wh = cell.h2h_weight.data().asnumpy()
+    bi = cell.i2h_bias.data().asnumpy()
+    bh = cell.h2h_bias.data().asnumpy()
+
+    def conv(img, k):   # SAME cross-correlation
+        return signal.correlate2d(img, k, mode="same")
+
+    gates = np.stack([
+        conv(x[0, 0], wi[g, 0]) + bi[g] + conv(h0[0, 0], wh[g, 0]) + bh[g]
+        for g in range(4)])
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    i, f, g_, o = sig(gates[0]), sig(gates[1]), np.tanh(gates[2]), \
+        sig(gates[3])
+    c_ref = f * c0[0, 0] + i * g_
+    h_ref = o * np.tanh(c_ref)
+    np.testing.assert_allclose(c.asnumpy()[0, 0], c_ref, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(h.asnumpy()[0, 0], h_ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_unroll_and_grad():
+    cell = rnn.Conv2DGRUCell((2, 4, 4), 3)
+    cell.initialize()
+    seq = mx.np.array(np.random.RandomState(2).randn(
+        2, 5, 2, 4, 4).astype(np.float32))
+    merged, states = cell.unroll(5, seq, layout="NTC")
+    assert merged.shape == (2, 5, 3, 4, 4)
+    with mx.autograd.record():
+        m, _ = cell.unroll(5, seq, layout="NTC")
+        L = (m ** 2).sum()
+    L.backward()
+    assert float(np.abs(cell.i2h_weight.grad().asnumpy()).sum()) > 0
+
+
+def test_bad_input_shape_raises():
+    with pytest.raises(mx.MXNetError, match="input_shape"):
+        rnn.Conv2DLSTMCell((3, 8), 4)   # rank-1 spatial for a 2D cell

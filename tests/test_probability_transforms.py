@@ -1,0 +1,62 @@
+"""gluon.probability.transformation (≙ reference transformation.py):
+invertibility, log-det correctness vs numerics/scipy, composition, and
+TransformedDistribution change-of-variables."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.gluon import probability as P
+
+
+def test_lognormal_matches_scipy():
+    from scipy import stats
+    ln = P.TransformedDistribution(P.Normal(0.0, 1.0), P.ExpTransform())
+    y = mx.np.array(np.array([0.3, 0.5, 1.0, 2.0, 5.0], np.float32))
+    np.testing.assert_allclose(ln.log_prob(y).asnumpy(),
+                               stats.lognorm.logpdf(y.asnumpy(), 1.0),
+                               rtol=1e-5)
+    s = ln.sample((2000,))
+    assert float(s.asnumpy().min()) > 0     # support is positive reals
+
+
+@pytest.mark.parametrize("t", [
+    P.ExpTransform(),
+    P.AffineTransform(1.5, -2.0),
+    P.PowerTransform(3.0),
+    P.SigmoidTransform(),
+])
+def test_roundtrip_and_numeric_log_det(t):
+    x = mx.np.array(np.array([0.2, 0.9, 1.7], np.float32))
+    y = t(x)
+    np.testing.assert_allclose(t.inv(y).asnumpy(), x.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+    eps = 1e-3
+    y2 = t(mx.np.array(x.asnumpy() + eps))
+    num = np.log(np.abs((y2.asnumpy() - y.asnumpy()) / eps))
+    np.testing.assert_allclose(t.log_det_jacobian(x).asnumpy(), num,
+                               atol=2e-2)
+
+
+def test_compose_log_det_is_sum():
+    a, b = P.AffineTransform(0.0, 3.0), P.ExpTransform()
+    chain = P.ComposeTransform([a, b])
+    x = mx.np.array(np.array([-0.5, 0.1], np.float32))
+    mid = a(x)
+    expect = a.log_det_jacobian(x).asnumpy() \
+        + b.log_det_jacobian(mid).asnumpy()
+    np.testing.assert_allclose(chain.log_det_jacobian(x).asnumpy(), expect,
+                               rtol=1e-5)
+
+
+def test_non_bijective_rejected():
+    with pytest.raises(mx.MXNetError, match="bijective"):
+        P.TransformedDistribution(P.Normal(0.0, 1.0), P.SoftmaxTransform())
+    with pytest.raises(mx.MXNetError, match="not bijective"):
+        P.AbsTransform().log_det_jacobian(mx.np.array(np.ones(2)))
+
+
+def test_softmax_transform_simplex():
+    t = P.SoftmaxTransform()
+    x = mx.np.array(np.random.RandomState(0).randn(4, 6).astype(np.float32))
+    y = t(x).asnumpy()
+    np.testing.assert_allclose(y.sum(axis=-1), 1.0, rtol=1e-5)

@@ -1,0 +1,125 @@
+"""Collective/transfer bandwidth measurement (≙ reference
+tools/bandwidth/measure.py, which timed kvstore push-pull over NCCL/ps-lite).
+
+TPU-native: measures, over the ambient device set,
+  * allreduce (psum over a mesh axis — the DP gradient path),
+  * all_gather and reduce_scatter/psum_scatter (the sharded paths),
+  * host->device and device->host transfer,
+for a sweep of tensor sizes. Prints a table and optional JSON.
+
+    python tools/bandwidth.py [--sizes-mb 1 4 16 64] [--json out.json]
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/bandwidth.py            # virtual 8-device mesh
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _time(fn, sync, reps=5):
+    fn()
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    sync()
+    return (time.perf_counter() - t0) / reps
+
+
+def measure(sizes_mb, reps):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("x",))
+    repl = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P("x"))
+    rows = []
+    for mb in sizes_mb:
+        elems = int(mb * (1 << 20) // 4)
+        elems = max((elems // max(n, 1)) * max(n, 1), n)
+        host = np.random.RandomState(0).randn(elems).astype(np.float32)
+        nbytes = host.nbytes
+
+        # host -> device (block: device_put is async — unsynced timing
+        # would measure enqueue cost, not the transfer)
+        t_h2d = _time(
+            lambda: jax.block_until_ready(jax.device_put(host, devs[0])),
+            lambda: None, reps)
+        dev = jax.device_put(host, devs[0])
+        # device -> host
+        t_d2h = _time(lambda: np.asarray(dev), lambda: None, reps)
+
+        entry = {"size_mb": mb, "devices": n,
+                 "h2d_gbps": round(nbytes / t_h2d / 1e9, 2),
+                 "d2h_gbps": round(nbytes / t_d2h / 1e9, 2)}
+
+        if n > 1:
+            x = jax.device_put(host, shard)
+            # allreduce: psum inside shard_map over the axis
+            from jax.experimental.shard_map import shard_map
+            f_ar = jax.jit(shard_map(lambda v: jax.lax.psum(v, "x"),
+                                     mesh=mesh, in_specs=P("x"),
+                                     out_specs=P("x")))
+            f_ag = jax.jit(shard_map(lambda v: jax.lax.all_gather(v, "x"),
+                                     mesh=mesh, in_specs=P("x"),
+                                     out_specs=P("x", None)))
+            f_rs = jax.jit(shard_map(
+                lambda v: jax.lax.psum_scatter(v, "x", tiled=True),
+                mesh=mesh, in_specs=P("x"), out_specs=P("x")))
+            out = {"y": None}
+
+            def run_ar():
+                out["y"] = f_ar(x)
+
+            def run_ag():
+                out["y"] = f_ag(x)
+
+            def run_rs():
+                out["y"] = f_rs(x)
+
+            def sync():
+                jax.block_until_ready(out["y"])
+
+            t_ar = _time(run_ar, sync, reps)
+            t_ag = _time(run_ag, sync, reps)
+            t_rs = _time(run_rs, sync, reps)
+            # algorithmic bandwidth convention: 2*(n-1)/n * bytes / t
+            algo = 2 * (n - 1) / n * nbytes
+            entry["allreduce_gbps"] = round(algo / t_ar / 1e9, 2)
+            entry["allgather_gbps"] = round(
+                (n - 1) / n * nbytes / t_ag / 1e9, 2)
+            entry["reduce_scatter_gbps"] = round(
+                (n - 1) / n * nbytes / t_rs / 1e9, 2)
+        rows.append(entry)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes-mb", type=float, nargs="+",
+                    default=[1, 4, 16, 64])
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+    rows = measure(args.sizes_mb, args.reps)
+    cols = sorted({k for r in rows for k in r})
+    print("  ".join(f"{c:>16}" for c in cols))
+    for r in rows:
+        print("  ".join(f"{r.get(c, '-'):>16}" for c in cols))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=2)
+        print(f"wrote {args.json}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Flakiness checker (≙ reference tools/flakiness_checker.py): re-run a
+test many times under different seeds and report the failure rate.
+
+    python tools/flakiness_checker.py tests/test_gluon.py::test_dense -n 20
+    python tools/flakiness_checker.py test_gluon.test_dense   # ref syntax
+
+Each trial runs in a fresh pytest process with MXNET_TEST_SEED set (the
+per-test seeding hook in tests/conftest.py honors it), so flakes caused by
+seed sensitivity reproduce with the printed seed.
+"""
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def normalize(spec):
+    """Accept pytest node ids or the reference's module.test syntax."""
+    if "::" in spec or spec.endswith(".py"):
+        return spec
+    if "." in spec:
+        mod, test = spec.rsplit(".", 1)
+        path = os.path.join("tests", *mod.split(".")) + ".py"
+        return f"{path}::{test}"
+    return spec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("test", help="pytest node id or module.test_name")
+    ap.add_argument("-n", "--trials", type=int, default=10)
+    ap.add_argument("-s", "--seed", type=int, default=None,
+                    help="fixed seed for every trial (default: random)")
+    ap.add_argument("--stop-on-fail", action="store_true")
+    args = ap.parse_args()
+
+    node = normalize(args.test)
+    rng = random.SystemRandom() if args.seed is None \
+        else random.Random(args.seed)
+    failures = []
+    for i in range(args.trials):
+        seed = args.seed if args.seed is not None \
+            else rng.randrange(2 ** 31)
+        env = dict(os.environ)
+        env["MXNET_TEST_SEED"] = str(seed)
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", node, "-q", "-x"],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        ok = r.returncode == 0
+        print(f"trial {i + 1}/{args.trials} seed={seed}: "
+              f"{'PASS' if ok else 'FAIL'}", flush=True)
+        if not ok:
+            failures.append((seed, r.stdout[-2000:]))
+            if args.stop_on_fail:
+                break
+    print(f"\n{len(failures)}/{args.trials} trials failed")
+    for seed, tail in failures:
+        print(f"\n--- seed {seed} ---\n{tail}")
+    if failures:
+        print(f"reproduce: MXNET_TEST_SEED={failures[0][0]} "
+              f"python -m pytest {node}")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()
