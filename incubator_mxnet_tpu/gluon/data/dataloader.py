@@ -64,11 +64,15 @@ class DataLoader:
             raise MXNetError("batch_sampler conflicts with batch_size/"
                              "shuffle/sampler/last_batch")
         self._batch_sampler = batch_sampler
+        self._use_processes = (not thread_pool) and num_workers > 0
+        if self._use_processes and batchify_fn is None:
+            batchify_fn = _host_batchify
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = max(0, num_workers)
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
         self._pin_memory = pin_memory
+        self._timeout = timeout
 
     def _make_batch(self, indices):
         samples = [self._dataset[i] for i in indices]
@@ -78,6 +82,9 @@ class DataLoader:
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
+            return
+        if self._use_processes:
+            yield from self._iter_processes()
             return
         with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
             it = iter(self._batch_sampler)
@@ -91,5 +98,176 @@ class DataLoader:
                     pending.append(pool.submit(self._make_batch, nxt))
                 yield fut.result()
 
+    def _iter_processes(self):
+        """Spawned process workers + shared-memory batch rebuild
+        (≙ reference worker_loop; thread_pool=False, num_workers>0)."""
+        import multiprocessing as mp
+        import pickle
+        from concurrent.futures import ProcessPoolExecutor
+        ctx = mp.get_context("spawn")
+        payload = pickle.dumps((self._dataset, self._batchify_fn))
+        pending = []
+        with ProcessPoolExecutor(
+                max_workers=self._num_workers, mp_context=ctx,
+                initializer=_mp_worker_init,
+                initargs=(payload,)) as pool:
+            try:
+                it = iter(self._batch_sampler)
+                for indices in itertools.islice(it, self._prefetch + 1):
+                    pending.append(
+                        pool.submit(_mp_worker_batch, list(indices)))
+                while pending:
+                    fut = pending.pop(0)
+                    nxt = next(it, None)
+                    if nxt is not None:
+                        pending.append(
+                            pool.submit(_mp_worker_batch, list(nxt)))
+                    spec, descs = fut.result(timeout=self._timeout)
+                    yield _rebuild_batch(spec, descs)
+            finally:
+                # the PARENT owns every produced block (workers unregister
+                # them): on early exit / error, drain or cancel pending
+                # futures and unlink their segments, else up to prefetch+1
+                # batches of /dev/shm leak per abandoned epoch
+                for fut in pending:
+                    if not fut.cancel():
+                        try:
+                            _, descs = fut.result(timeout=self._timeout)
+                            _release_descs(descs)
+                        except Exception:
+                            pass
+
     def __len__(self):
         return len(self._batch_sampler)
+
+
+# ---------------------------------------------------------------------------
+# Multiprocessing workers + shared-memory batch rebuild (≙ the reference's
+# worker_loop + CPUSharedStorageManager, dataloader.py:47-88,514). For
+# GIL-BOUND Python transforms on multi-core hosts; numpy/PIL-heavy
+# pipelines usually do as well in thread mode (the default).
+#
+# Safety model: workers are SPAWNED (never forked — a live PJRT client is
+# not fork-safe) and pin JAX_PLATFORMS=cpu before anything imports jax, so
+# a dataset that materializes NDArrays cannot grab the accelerator.
+# Batches travel as multiprocessing.shared_memory blocks: the worker
+# assembles host arrays straight into the block, the parent wraps views
+# and uploads to the device — one copy on each side, no pickling of bulk
+# data.
+# ---------------------------------------------------------------------------
+
+_MP_STATE = {}
+
+
+def _host_array(x):
+    if hasattr(x, "asnumpy"):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def _host_batchify(data):
+    """Worker-side batchify: numpy in, numpy out (no NDArray creation)."""
+    if isinstance(data[0], (tuple, list)):
+        return tuple(_host_batchify(list(s)) for s in zip(*data))
+    return _np.stack([_host_array(d) for d in data])
+
+
+def _mp_worker_init(payload):
+    # the dataset/batchify travel as PICKLED BYTES: plain bytes deserialize
+    # without importing anything, so the platform pin below runs before a
+    # dataset containing NDArrays can initialize a jax backend (initargs
+    # themselves are unpickled before the initializer executes)
+    import os
+    import pickle
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    dataset, batchify_fn = pickle.loads(payload)
+    _MP_STATE["dataset"] = dataset
+    _MP_STATE["batchify"] = batchify_fn
+
+
+def _flatten_batch(batch):
+    if isinstance(batch, (tuple, list)):
+        leaves, subspecs = [], []
+        for b in batch:
+            sub_leaves, sub_spec = _flatten_batch(b)
+            leaves.extend(sub_leaves)
+            subspecs.append(sub_spec)
+        kind = "tuple" if isinstance(batch, tuple) else "list"
+        return leaves, (kind, subspecs)
+    if isinstance(batch, dict):
+        keys = list(batch)
+        leaves, subspecs = [], []
+        for k in keys:
+            sub_leaves, sub_spec = _flatten_batch(batch[k])
+            leaves.extend(sub_leaves)
+            subspecs.append(sub_spec)
+        return leaves, ("dict", keys, subspecs)
+    return [batch], None
+
+
+def _unflatten_batch(spec, leaves_iter):
+    if spec is None:
+        return next(leaves_iter)
+    if spec[0] == "dict":
+        _, keys, subspecs = spec
+        return {k: _unflatten_batch(s, leaves_iter)
+                for k, s in zip(keys, subspecs)}
+    kind, subspecs = spec
+    seq = [_unflatten_batch(s, leaves_iter) for s in subspecs]
+    return tuple(seq) if kind == "tuple" else seq
+
+
+def _mp_worker_batch(indices):
+    from multiprocessing import resource_tracker, shared_memory
+    ds = _MP_STATE["dataset"]
+    fn = _MP_STATE["batchify"]
+    samples = [ds[i] for i in indices]
+    batch = fn(samples)
+    leaves, spec = _flatten_batch(batch)
+    descs = []
+    for a in leaves:
+        a = _np.ascontiguousarray(_host_array(a))
+        shm = shared_memory.SharedMemory(create=True,
+                                         size=max(a.nbytes, 1))
+        view = _np.ndarray(a.shape, a.dtype, buffer=shm.buf)
+        view[...] = a
+        descs.append((shm.name, a.shape, str(a.dtype)))
+        shm.close()
+        # ownership transfers to the parent (which unlinks after upload);
+        # without unregistering, this process's resource tracker would
+        # whine about a "leaked" block it no longer owns
+        try:
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:
+            pass
+    return spec, descs
+
+
+def _release_descs(descs):
+    """Unlink produced-but-unconsumed shared-memory blocks."""
+    from multiprocessing import shared_memory
+    for name, _shape, _dtype in descs:
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+
+
+def _rebuild_batch(spec, descs):
+    """Parent side: attach each block, upload to device, release."""
+    from multiprocessing import shared_memory
+
+    from ...ndarray import array
+    leaves = []
+    for name, shape, dtype in descs:
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            view = _np.ndarray(tuple(shape), _np.dtype(dtype),
+                               buffer=shm.buf)
+            leaves.append(array(view.copy()))
+        finally:
+            shm.close()
+            shm.unlink()
+    return _unflatten_batch(spec, iter(leaves))

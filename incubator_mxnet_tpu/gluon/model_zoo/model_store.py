@@ -31,7 +31,7 @@ import numpy as _np
 from ...base import MXNetError
 
 __all__ = ["get_model_file", "load_params_file", "save_params_file",
-           "convert_params_to_npz", "load_pretrained"]
+           "convert_params_to_npz", "load_pretrained", "auto_name_map"]
 
 _LIST_MAGIC = 0x112
 _V2_MAGIC = 0xF993FAC9
@@ -140,8 +140,12 @@ def _read_one_ndarray(r):
     return arr.copy()
 
 
-def load_params_file(path):
-    """Parse a reference-format .params file -> dict {name: np.ndarray}."""
+def load_params_file(path, keep_prefixes=False):
+    """Parse a reference-format .params file -> dict {name: np.ndarray}.
+
+    Module-era files carry "arg:"/"aux:" name prefixes; they strip by
+    default (gluon load semantics) — keep_prefixes=True preserves them
+    (auto_name_map needs the grouping)."""
     with open(path, "rb") as f:
         r = _Reader(f.read())
     if r.u64() != _LIST_MAGIC:
@@ -158,6 +162,8 @@ def load_params_file(path):
         raise MXNetError(f"{path}: key/array count mismatch")
     if not names:
         names = [f"arg:arr_{i}" for i in range(len(arrays))]
+    if keep_prefixes:
+        return {nm: a for nm, a in zip(names, arrays)}
     # the reference prefixes "arg:"/"aux:" in Module-era files; strip
     return {nm.split(":", 1)[-1]: a for nm, a in zip(names, arrays)}
 
@@ -217,3 +223,62 @@ def load_pretrained(net, name, root=None):
         path = tmp.name
     net.load_parameters(path)
     return net
+
+
+def auto_name_map(params_path, model_name):
+    """Derive {checkpoint_name: framework_name} by aligning a reference
+    checkpoint with the zoo architecture in construction order.
+
+    Real reference zoo files use flat scoped names
+    (`resnetv10_conv0_weight`, ...) while this framework uses structural
+    names; both enumerate parameters in construction order for the same
+    architecture, so a positional alignment with a SHAPE CHECK on every
+    pair maps them without a hand-curated table. Raises on any mismatch
+    (wrong architecture or variant)."""
+    from . import vision
+
+    factory = getattr(vision, model_name, None)
+    if factory is None:
+        raise MXNetError(f"unknown model-zoo architecture {model_name!r}")
+    net = factory()
+    net.initialize()
+    # materialize deferred shapes with the architecture's standard input
+    from ... import np as mxnp
+    side = 299 if "inception" in model_name else 224
+    net(mxnp.zeros((1, 3, side, side)))
+    ours = list(net.collect_params().items())
+
+    raw = load_params_file(params_path, keep_prefixes=True)
+    has_prefixes = any(k.startswith(("arg:", "aux:")) for k in raw)
+    if has_prefixes:
+        # Module-era files group ALL args before ALL auxs; align each
+        # group against the matching split of our construction order
+        # (grad_req == 'null' marks auxiliary running stats)
+        their_args = [(k.split(":", 1)[1], v) for k, v in raw.items()
+                      if k.startswith("arg:")]
+        their_auxs = [(k.split(":", 1)[1], v) for k, v in raw.items()
+                      if k.startswith("aux:")]
+        our_args = [(n, p) for n, p in ours if p.grad_req != "null"]
+        our_auxs = [(n, p) for n, p in ours if p.grad_req == "null"]
+        groups = [(their_args, our_args, "arg"),
+                  (their_auxs, our_auxs, "aux")]
+    else:
+        groups = [(list(raw.items()), ours, "param")]
+
+    mapping = {}
+    for theirs, ours_group, kind in groups:
+        if len(theirs) != len(ours_group):
+            raise MXNetError(
+                f"{params_path}: {len(theirs)} {kind} arrays vs "
+                f"{len(ours_group)} in {model_name} — architecture "
+                "mismatch (or extra/missing aux states)")
+        for (their_name, their_arr), (our_name, our_p) in zip(theirs,
+                                                              ours_group):
+            if tuple(their_arr.shape) != tuple(our_p.data().shape):
+                raise MXNetError(
+                    f"shape mismatch aligning {their_name!r} "
+                    f"{tuple(their_arr.shape)} -> {our_name!r} "
+                    f"{tuple(our_p.data().shape)}; the checkpoint is not "
+                    f"{model_name} (pass explicit --rename entries)")
+            mapping[their_name] = our_name
+    return mapping

@@ -163,3 +163,32 @@ def test_params_bf16_and_v3_scalar_records(tmp_path):
     assert back["scalar"].shape == ()
     assert back["scalar"] == np.float32(7.25)
     np.testing.assert_array_equal(back["after"], after)
+
+
+def test_auto_name_map_round_trip(tmp_path):
+    """ROADMAP item: map a reference-zoo-style checkpoint (foreign flat
+    scoped names) onto the framework's structural names by order+shape
+    alignment; pretrained load reproduces the source logits."""
+    from incubator_mxnet_tpu.gluon.model_zoo import vision, model_store as ms
+    mx.seed(11)
+    name = "alexnet"
+    src_net = vision.alexnet()
+    src_net.initialize()
+    x = mx.np.array(
+        np.random.RandomState(5).randn(1, 3, 224, 224).astype(np.float32))
+    src_net(x)
+    ref = src_net(x).asnumpy()
+    foreign = {f"zoo0_param{i}_w": p.data().asnumpy()
+               for i, (nm, p) in
+               enumerate(src_net.collect_params().items())}
+    pfile = str(tmp_path / "zoo.params")
+    ms.save_params_file(pfile, foreign)
+    amap = ms.auto_name_map(pfile, name)
+    ms.convert_params_to_npz(pfile, str(tmp_path / f"{name}.npz"), amap)
+    net = getattr(vision, name)(pretrained=True, root=str(tmp_path))
+    np.testing.assert_allclose(net(x).asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+    # wrong architecture must be rejected, not silently mis-mapped
+    with pytest.raises(mx.MXNetError,
+                       match="architecture mismatch|shape mismatch"):
+        ms.auto_name_map(pfile, "resnet18_v1")
