@@ -13,6 +13,7 @@ import numpy as _np
 from ..base import MXNetError
 
 __all__ = [
+    "MeanAveragePrecision", "VOC07MApMetric",
     "EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy", "F1",
     "BinaryAccuracy", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy",
     "Perplexity", "NegativeLogLikelihood", "PearsonCorrelation", "Loss",
@@ -28,6 +29,7 @@ _ALIASES = {
     "crossentropy": ["ce", "cross-entropy"],
     "negativeloglikelihood": ["nll_loss", "nll-loss"],
     "pearsoncorrelation": ["pearsonr"],
+    "meanaverageprecision": ["map", "voc07mapmetric"],
 }
 
 
@@ -382,3 +384,136 @@ class CustomMetric(EvalMetric):
 
 
 np = _np  # reference module exposes numpy as mx.gluon.metric.numpy
+
+
+@register
+class MeanAveragePrecision(EvalMetric):
+    """Detection mAP (≙ gluon-cv VOCMApMetric / the reference SSD eval):
+    per-class average precision over an IoU threshold, averaged.
+
+    update(labels, preds):
+      labels: (B, M, 5) ground truth [cls, x1, y1, x2, y2] (cls -1 pads)
+      preds:  (B, N, 6) detections  [cls, score, x1, y1, x2, y2]
+              (cls -1 entries ignored — multibox_detection's pad rows)
+
+    `get()` computes integral AP per class (precision envelope, the
+    VOC2010+ convention) unless voc07=True (11-point interpolation).
+    """
+
+    def __init__(self, iou_thresh=0.5, class_names=None, voc07=False,
+                 name="mAP", **kwargs):
+        self._iou = float(iou_thresh)
+        self._voc07 = bool(voc07)
+        self._class_names = class_names
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        super().reset()
+        self._records = {}   # cls -> list of (score, is_tp)
+        self._npos = {}      # cls -> #ground-truth boxes
+
+    @staticmethod
+    def _iou_matrix(a, b):
+        # a: (n,4), b: (m,4) corner boxes
+        lt = _np.maximum(a[:, None, :2], b[None, :, :2])
+        rb = _np.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = _np.clip(rb - lt, 0, None)
+        inter = wh[..., 0] * wh[..., 1]
+        area_a = _np.clip(a[:, 2] - a[:, 0], 0, None) \
+            * _np.clip(a[:, 3] - a[:, 1], 0, None)
+        area_b = _np.clip(b[:, 2] - b[:, 0], 0, None) \
+            * _np.clip(b[:, 3] - b[:, 1], 0, None)
+        union = area_a[:, None] + area_b[None, :] - inter
+        return inter / _np.maximum(union, 1e-12)
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for lab_x, det_x in zip(labels, preds):
+            lab = _to_numpy(lab_x)
+            det = _to_numpy(det_x)
+            for b in range(lab.shape[0]):
+                self._update_one(lab[b], det[b])
+        self.num_inst = 1   # get() computes from the records
+
+    def _update_one(self, lab, det):
+        gts = lab[lab[:, 0] >= 0]
+        dets = det[det[:, 0] >= 0]
+        classes = set(gts[:, 0].astype(int)) | set(dets[:, 0].astype(int))
+        for c in classes:
+            g = gts[gts[:, 0].astype(int) == c][:, 1:5]
+            d = dets[dets[:, 0].astype(int) == c]
+            self._npos[c] = self._npos.get(c, 0) + len(g)
+            rec = self._records.setdefault(c, [])
+            if len(d) == 0:
+                continue
+            d = d[_np.argsort(-d[:, 1])]
+            if len(g) == 0:
+                rec.extend((float(s), False) for s in d[:, 1])
+                continue
+            ious = self._iou_matrix(d[:, 2:6], g)   # one (N, M) matrix
+            matched = _np.zeros(len(g), bool)
+            for i in range(len(d)):
+                j = int(_np.argmax(ious[i]))
+                if ious[i, j] >= self._iou and not matched[j]:
+                    matched[j] = True
+                    rec.append((float(d[i, 1]), True))
+                else:
+                    rec.append((float(d[i, 1]), False))
+
+    def _class_ap(self, c):
+        rec = self._records.get(c, [])
+        npos = self._npos.get(c, 0)
+        if npos == 0:
+            return None
+        if not rec:
+            return 0.0
+        arr = _np.array(sorted(rec, key=lambda r: -r[0]), dtype=_np.float64)
+        tp = _np.cumsum(arr[:, 1])
+        fp = _np.cumsum(1.0 - arr[:, 1])
+        recall = tp / npos
+        precision = tp / _np.maximum(tp + fp, 1e-12)
+        if self._voc07:
+            ap = 0.0
+            for t in _np.linspace(0, 1, 11):
+                p = precision[recall >= t]
+                ap += (p.max() if len(p) else 0.0) / 11.0
+            return float(ap)
+        # integral AP with the precision envelope
+        mrec = _np.concatenate([[0.0], recall, [1.0]])
+        mpre = _np.concatenate([[0.0], precision, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = _np.where(mrec[1:] != mrec[:-1])[0]
+        return float(_np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+    def get(self):
+        aps = [ap for ap in (self._class_ap(c) for c in
+                             sorted(self._npos)) if ap is not None]
+        if not aps:
+            return self.name, float("nan")
+        return self.name, float(_np.mean(aps))
+
+    def get_class_aps(self):
+        """Per-class APs, keyed by class id (or class_names entry)."""
+        out = {}
+        for c in sorted(self._npos):
+            ap = self._class_ap(c)
+            if ap is None:
+                continue
+            key = (self._class_names[c]
+                   if self._class_names and c < len(self._class_names)
+                   else c)
+            out[key] = ap
+        return out
+
+
+@register
+class VOC07MApMetric(MeanAveragePrecision):
+    """The 11-point interpolated VOC-2007 convention (≙ gluon-cv
+    VOC07MApMetric): same accumulation, voc07 AP by default."""
+
+    def __init__(self, iou_thresh=0.5, class_names=None, name="mAP_voc07",
+                 **kwargs):
+        kwargs.pop("voc07", None)
+        super().__init__(iou_thresh=iou_thresh, class_names=class_names,
+                         voc07=True, name=name, **kwargs)

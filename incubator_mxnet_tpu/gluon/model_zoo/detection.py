@@ -1,0 +1,164 @@
+"""Detection model zoo: SSD-300/VGG16 (≙ the reference's example/ssd
+model definition, symbol/symbol_vgg16_reduced.py + the gluon-cv
+ssd_300_vgg16_atrous preset) built on this framework's real multibox op
+tail (multibox_prior/target/detection, ops/contrib.py).
+
+TPU-native notes: NHWC-friendly convs via layout=, every head is a plain
+HybridBlock (one jit for the whole detector under hybridize), anchors are
+trace-time constants folded into the program.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ... import numpy_extension as npx
+from ... import numpy as mxnp
+from .. import nn
+from ..block import HybridBlock
+
+__all__ = ["SSD300", "ssd_300_vgg16", "ssd_anchor_sizes"]
+
+# SSD paper scales for 300px input: s_min=0.2, s_max=0.9 over 6 maps,
+# plus the geometric-mean extra size per map. Feature maps at 300px:
+# 38, 19, 10, 5, 3, 1 (asserted by the canonical 8732-anchor test).
+_RATIOS = ((1, 2, 0.5),
+           (1, 2, 0.5, 3, 1.0 / 3),
+           (1, 2, 0.5, 3, 1.0 / 3),
+           (1, 2, 0.5, 3, 1.0 / 3),
+           (1, 2, 0.5),
+           (1, 2, 0.5))
+
+
+def ssd_anchor_sizes(num_maps=6, s_min=0.2, s_max=0.9):
+    """Per-map (s_k, sqrt(s_k * s_{k+1})) size pairs (SSD paper eq. 4)."""
+    scales = [0.1] + [
+        s_min + (s_max - s_min) * k / (num_maps - 1)
+        for k in range(num_maps)]
+    return [(scales[k], float(_np.sqrt(scales[k] * scales[k + 1])))
+            for k in range(num_maps)]
+
+
+def _vgg16_reduced(layout):
+    """VGG16 through conv4_3 (the 38x38 SSD feature): three pooled stages
+    then the conv4 block WITHOUT its pool (that pool opens the conv5
+    branch). pool3 uses ceil (75 -> 38) — the SSD VGG16 variant's one
+    quirk, required for the canonical 8732-anchor layout."""
+    net = nn.HybridSequential()
+    for bi, (blocks, ch) in enumerate([(2, 64), (2, 128), (3, 256)]):
+        for _ in range(blocks):
+            net.add(nn.Conv2D(ch, 3, padding=1, activation="relu",
+                              layout=layout))
+        net.add(nn.MaxPool2D(2, 2, layout=layout, ceil_mode=(bi == 2)))
+    for _ in range(3):   # conv4_1..conv4_3 -> 38x38x512
+        net.add(nn.Conv2D(512, 3, padding=1, activation="relu",
+                          layout=layout))
+    return net
+
+
+class SSD300(HybridBlock):
+    """SSD with a VGG16-reduced backbone at 300x300 (8732 anchors).
+
+    forward(x) -> (anchors (1, 8732, 4), cls_preds (B, 8732, C+1),
+    loc_preds (B, 8732*4)); `detect(x)` runs softmax + multibox_detection
+    (NMS inside) and returns (B, N, 6) [cls, score, x1, y1, x2, y2].
+    """
+
+    def __init__(self, classes=20, layout="NCHW"):
+        super().__init__()
+        self._classes = classes
+        self._layout = layout
+        self._ch_axis = 1 if layout == "NCHW" else 3
+        sizes = ssd_anchor_sizes()
+        self._sizes = sizes
+        self._num_anchors = [len(s) + len(r) - 1
+                             for s, r in zip(sizes, _RATIOS)]
+
+        self.stem = _vgg16_reduced(layout)          # -> 38x38x512
+        self.conv5 = nn.HybridSequential()
+        self.conv5.add(nn.MaxPool2D(2, 2, layout=layout))   # pool4: 19
+        for _ in range(3):
+            self.conv5.add(nn.Conv2D(512, 3, padding=1, activation="relu",
+                                     layout=layout))
+        self.conv5.add(nn.MaxPool2D(3, 1, padding=1,
+                                    layout=layout))         # pool5: 19
+        self.fc = nn.HybridSequential()
+        self.fc.add(nn.Conv2D(1024, 3, padding=6, dilation=6,
+                              activation="relu", layout=layout),  # fc6
+                    nn.Conv2D(1024, 1, activation="relu",
+                              layout=layout))                     # fc7
+        # extra feature layers: 10, 5, 3, 1
+        self.extras = nn.HybridSequential()
+        for mid, out, stride, pad in ((256, 512, 2, 1), (128, 256, 2, 1),
+                                      (128, 256, 1, 0), (128, 256, 1, 0)):
+            blk = nn.HybridSequential()
+            blk.add(nn.Conv2D(mid, 1, activation="relu", layout=layout),
+                    nn.Conv2D(out, 3, strides=stride, padding=pad,
+                              activation="relu", layout=layout))
+            self.extras.add(blk)
+
+        self.cls_heads = nn.HybridSequential()
+        self.loc_heads = nn.HybridSequential()
+        for na in self._num_anchors:
+            self.cls_heads.add(nn.Conv2D(na * (classes + 1), 3, padding=1,
+                                         layout=layout))
+            self.loc_heads.add(nn.Conv2D(na * 4, 3, padding=1,
+                                         layout=layout))
+
+    # ------------------------------------------------------------------
+    def _flatten_pred(self, p, per_anchor):
+        # (B, C, H, W) or (B, H, W, C) -> (B, H*W*na, per_anchor)
+        if self._layout == "NCHW":
+            p = p.transpose(0, 2, 3, 1)
+        b = p.shape[0]
+        return p.reshape(b, -1, per_anchor)
+
+    def forward(self, x):
+        feats = []
+        h = self.stem(x)
+        feats.append(h)                       # 38
+        h = self.conv5(h)
+        h = self.fc(h)
+        feats.append(h)                       # 19
+        for blk in self.extras:
+            h = blk(h)
+            feats.append(h)                   # 10, 5, 3, 1
+        anchors, cls_preds, loc_preds = [], [], []
+        for i, f in enumerate(feats):
+            anchors.append(npx.multibox_prior(
+                f, sizes=self._sizes[i], ratios=_RATIOS[i],
+                layout=self._layout))
+            cls_preds.append(self._flatten_pred(
+                self.cls_heads[i](f), self._classes + 1))
+            loc_preds.append(self._flatten_pred(self.loc_heads[i](f), 4))
+        anchors = mxnp.concatenate(anchors, axis=1)
+        cls_preds = mxnp.concatenate(cls_preds, axis=1)
+        loc_preds = mxnp.concatenate(loc_preds, axis=1)
+        b = loc_preds.shape[0]
+        return anchors, cls_preds, loc_preds.reshape(b, -1)
+
+    def detect(self, x, nms_threshold=0.45, threshold=0.01):
+        anchors, cls_preds, loc_preds = self(x)
+        probs = npx.softmax(cls_preds, axis=-1).transpose(0, 2, 1)
+        return npx.multibox_detection(
+            probs, loc_preds, anchors, nms_threshold=nms_threshold,
+            threshold=threshold)
+
+    def targets(self, anchors, labels, cls_preds,
+                negative_mining_ratio=3.0):
+        """(loc_target, loc_mask, cls_target) ≙ MultiBoxTarget."""
+        return npx.multibox_target(
+            anchors, labels, cls_preds.transpose(0, 2, 1),
+            negative_mining_ratio=negative_mining_ratio)
+
+
+def ssd_300_vgg16(classes=20, layout="NCHW", pretrained=False, root=None):
+    """The SSD-300/VGG16 preset (≙ gluon-cv ssd_300_vgg16_atrous)."""
+    net = SSD300(classes=classes, layout=layout)
+    if pretrained:
+        from .model_store import load_pretrained
+        net.initialize()
+        x = mxnp.zeros((1, 3, 300, 300) if layout == "NCHW"
+                       else (1, 300, 300, 3))
+        net(x)
+        load_pretrained(net, "ssd_300_vgg16", root)
+    return net

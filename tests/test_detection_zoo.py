@@ -1,0 +1,82 @@
+"""SSD-300/VGG16 preset + detection mAP metric (ROADMAP items, ≙ the
+reference example/ssd model + VOC mAP evaluation)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.gluon.metric import MeanAveragePrecision
+from incubator_mxnet_tpu.gluon.model_zoo.detection import (SSD300,
+                                                           ssd_300_vgg16,
+                                                           ssd_anchor_sizes)
+
+
+def test_anchor_schedule():
+    sizes = ssd_anchor_sizes()
+    assert len(sizes) == 6
+    assert sizes[0][0] == pytest.approx(0.1)
+    assert all(s2 > s1 > 0 for s1, s2 in sizes)
+
+
+def test_ssd300_canonical_anchor_count():
+    """The defining invariant of SSD-300: 8732 anchors."""
+    net = ssd_300_vgg16(classes=20)
+    net.initialize()
+    x = mx.np.zeros((1, 3, 300, 300))
+    anchors, cls_preds, loc_preds = net(x)
+    assert anchors.shape == (1, 8732, 4)
+    assert cls_preds.shape == (1, 8732, 21)
+    assert loc_preds.shape == (1, 8732 * 4)
+
+
+def test_ssd300_detect_and_targets():
+    net = ssd_300_vgg16(classes=3)
+    net.initialize()
+    x = mx.np.array(
+        np.random.RandomState(0).randn(2, 3, 300, 300).astype(np.float32))
+    out = net.detect(x)
+    assert out.shape[0] == 2 and out.shape[2] == 6
+    # training targets from ground truth
+    labels = mx.np.array(np.array(
+        [[[0, 0.1, 0.1, 0.4, 0.4]], [[2, 0.5, 0.5, 0.9, 0.9]]],
+        np.float32))
+    anchors, cls_preds, loc_preds = net(x)
+    loc_t, loc_m, cls_t = net.targets(anchors, labels, cls_preds)
+    assert loc_t.shape == (2, 8732 * 4)
+    assert cls_t.shape == (2, 8732)
+    assert int((cls_t.asnumpy() > 0).sum()) > 0   # some anchors matched
+
+
+def test_map_metric_perfect_and_mixed():
+    m = MeanAveragePrecision(iou_thresh=0.5)
+    gt = mx.np.array(np.array([[[0, 0.1, 0.1, 0.5, 0.5],
+                                [1, 0.6, 0.6, 0.9, 0.9]]], np.float32))
+    perfect = mx.np.array(np.array(
+        [[[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+          [1, 0.8, 0.6, 0.6, 0.9, 0.9]]], np.float32))
+    m.update(gt, perfect)
+    assert m.get()[1] == pytest.approx(1.0)
+
+    m.reset()
+    # class 0: one perfect + one false positive at higher score
+    mixed = mx.np.array(np.array(
+        [[[0, 0.95, 0.7, 0.7, 0.8, 0.8],     # FP (wrong place)
+          [0, 0.90, 0.1, 0.1, 0.5, 0.5],     # TP
+          [1, 0.80, 0.6, 0.6, 0.9, 0.9]]], np.float32))
+    m.update(gt, mixed)
+    # class 0 AP: precision at its only TP is 1/2, recall 1 -> AP 0.5
+    # class 1 AP: 1.0  =>  mAP 0.75
+    assert m.get()[1] == pytest.approx(0.75)
+    aps = m.get_class_aps()
+    assert aps[0] == pytest.approx(0.5)
+    assert aps[1] == pytest.approx(1.0)
+
+
+def test_map_metric_missed_gt_counts_against_recall():
+    m = MeanAveragePrecision()
+    gt = mx.np.array(np.array([[[0, 0.1, 0.1, 0.5, 0.5],
+                                [0, 0.6, 0.6, 0.9, 0.9]]], np.float32))
+    one_hit = mx.np.array(np.array(
+        [[[0, 0.9, 0.1, 0.1, 0.5, 0.5]]], np.float32))
+    m.update(gt, one_hit)
+    # 1 TP of 2 gts, no FPs: integral AP = recall 0.5 at precision 1
+    assert m.get()[1] == pytest.approx(0.5)
