@@ -18,7 +18,7 @@ import numpy as _np
 from .base import MXNetError
 
 __all__ = ["save_checkpoint", "load_checkpoint", "save_sharded",
-           "load_sharded", "latest_step"]
+           "load_sharded", "rescale_sharded", "latest_step"]
 
 
 def _flatten(tree, prefix=""):
@@ -132,17 +132,21 @@ def save_sharded(directory, tree, step=0):
     return path
 
 
+def _resolve_step(directory, step):
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise MXNetError(f"no checkpoints under {directory}")
+    return step, os.path.join(os.path.abspath(directory), str(step))
+
+
 def load_sharded(directory, step=None, target=None):
     """Restore a sharded checkpoint (optionally resharded onto `target`'s
     shardings when a target pytree of ShapeDtypeStruct/arrays is given)."""
     ocp = _ocp()
     if ocp is None:
         raise MXNetError("orbax is unavailable")
-    if step is None:
-        step = latest_step(directory)
-        if step is None:
-            raise MXNetError(f"no checkpoints under {directory}")
-    path = os.path.join(os.path.abspath(directory), str(step))
+    step, path = _resolve_step(directory, step)
     ckptr = ocp.PyTreeCheckpointer()
     if target is not None:
         # modern orbax args API: reshard each leaf onto the target's
@@ -159,3 +163,60 @@ def latest_step(directory):
         return None
     steps = [int(d) for d in os.listdir(directory) if d.isdigit()]
     return max(steps) if steps else None
+
+
+def rescale_sharded(directory, mesh, specs, step=None):
+    """Elastic restart onto a DIFFERENT mesh (the rescale recipe the
+    reference leaves to outside tooling): restore a sharded checkpoint
+    saved under one device mesh onto `mesh` — any device count whose axes
+    satisfy `specs` — resharding every leaf as it streams in.
+
+    specs: a pytree of jax.sharding.PartitionSpec congruent with the
+    saved tree (None leaves mean replicated). Shapes/dtypes come from the
+    checkpoint's own metadata, so no model construction is needed before
+    restore. Returns (tree_of_resharded_arrays, step).
+    """
+    import jax
+    import jax.tree_util as jtu
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    ocp = _ocp()
+    if ocp is None:
+        raise MXNetError("orbax is unavailable")
+    step, path = _resolve_step(directory, step)
+    meta = ocp.PyTreeCheckpointer().metadata(path)
+    # orbax returns StepMetadata wrapping the leaf tree
+    meta = getattr(meta, "item_metadata", meta)
+    meta = getattr(meta, "tree", meta)
+
+    def build_target(m, spec):
+        if spec is None:
+            spec = PartitionSpec()
+        return jax.ShapeDtypeStruct(
+            tuple(m.shape), m.dtype,
+            sharding=NamedSharding(mesh, spec))
+
+    def fill_missing(m, spec):
+        """Dict specs may omit entries (treated as replicated); other
+        containers must be congruent with the checkpoint."""
+        if isinstance(m, dict):
+            if spec is None:
+                spec = {}
+            if not isinstance(spec, dict):
+                raise MXNetError(
+                    f"spec {type(spec).__name__} does not match the "
+                    "checkpoint's dict at this position")
+            return {k: fill_missing(m[k], spec.get(k)) for k in m}
+        if isinstance(m, (list, tuple)):
+            if spec is None:
+                spec = [None] * len(m)
+            if len(spec) != len(m):
+                raise MXNetError("spec sequence length does not match "
+                                 "the checkpoint")
+            return [fill_missing(mm, ss) for mm, ss in zip(m, spec)]
+        return spec   # leaf: PartitionSpec or None
+
+    specs = fill_missing(meta, specs)
+    target = jtu.tree_map(build_target, meta, specs)
+    tree, _ = load_sharded(directory, step=step, target=target)
+    return tree, step

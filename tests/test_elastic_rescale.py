@@ -1,0 +1,103 @@
+"""Elastic restart: rescale a sharded training checkpoint onto a
+DIFFERENT mesh size and keep training (the recipe the reference's
+ps-lite elasticity story never shipped; VERDICT §2.3 elastic row)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import checkpoint as ckpt
+
+
+def _mesh(devs, dp, tp, sp=1):
+    import jax
+    from jax.sharding import Mesh
+    n = dp * sp * tp
+    return Mesh(np.array(devs[:n]).reshape(dp, sp, tp),
+                ("dp", "sp", "tp"))
+
+
+def test_rescale_roundtrip_and_shrink(tmp_path):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the forced 8-device mesh")
+    mesh8 = _mesh(devs, 4, 2)
+    rng = np.random.RandomState(0)
+    state = {
+        "w": jax.device_put(rng.randn(8, 16).astype(np.float32),
+                            NamedSharding(mesh8, P("tp", None))),
+        "m": jax.device_put(rng.randn(8, 16).astype(np.float32),
+                            NamedSharding(mesh8, P("tp", None))),
+        "step": jax.device_put(np.float32(7.0),
+                               NamedSharding(mesh8, P())),
+    }
+    d = str(tmp_path / "ck")
+    ckpt.save_sharded(d, state, step=3)
+
+    specs = {"w": P("tp", None), "m": P("tp", None), "step": None}
+    mesh4 = _mesh(devs, 2, 2)
+    tree, step = ckpt.rescale_sharded(d, mesh4, specs)
+    assert step == 3
+    for k in ("w", "m"):
+        np.testing.assert_array_equal(np.asarray(tree[k]),
+                                      np.asarray(state[k]))
+        assert tree[k].sharding.mesh.devices.size == 4
+    assert float(tree["step"]) == 7.0
+
+    # grow back
+    tree8, _ = ckpt.rescale_sharded(d, mesh8, specs)
+    assert tree8["w"].sharding.mesh.devices.size == 8
+    np.testing.assert_array_equal(np.asarray(tree8["w"]),
+                                  np.asarray(state["w"]))
+
+
+def test_flagship_training_resumes_on_smaller_mesh(tmp_path):
+    """The full recipe: save flagship params+opt sharded under dp=4,tp=2;
+    restart on dp=2,tp=2 and run a REAL train step — losses stay finite
+    and the resharded weights are bit-identical before the step."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from incubator_mxnet_tpu.models import transformer as tfm
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the forced 8-device mesh")
+    cfg = tfm.TransformerConfig(vocab_size=128, num_layers=1, d_model=32,
+                                num_heads=4, d_ff=64, max_seq_len=32,
+                                dtype="float32")
+    mesh8 = _mesh(devs, 2, 2, sp=2)
+    with mesh8:
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+        pspecs = tfm.param_shardings(cfg, mesh8)
+        params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh8, s)),
+            params, pspecs,
+            is_leaf=lambda x: not isinstance(x, (dict, list)))
+        opt = tfm.init_opt_state(params)
+    d = str(tmp_path / "ck")
+    ckpt.save_sharded(d, {"params": params, "opt": opt}, step=11)
+    flat_before = jax.tree_util.tree_leaves(params)
+
+    mesh4 = _mesh(devs, 2, 2, sp=1)
+    pspecs4 = tfm.param_shardings(cfg, mesh4)
+    # the transformer opt state is an (m, v) pair of param-shaped trees
+    # (orbax restores tuples as lists, so the spec uses a list too)
+    tree, step = ckpt.rescale_sharded(
+        d, mesh4, {"params": pspecs4, "opt": [pspecs4, pspecs4]})
+    assert step == 11
+    flat_after = jax.tree_util.tree_leaves(tree["params"])
+    for a, b in zip(flat_before, flat_after):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    with mesh4:
+        step_fn = tfm.make_train_step(cfg, mesh4)
+        tokens = np.random.RandomState(1).randint(
+            0, cfg.vocab_size, (4, 17)).astype(np.int32)
+        batch = {"tokens": jax.device_put(
+            tokens, NamedSharding(mesh4, P("dp", None)))}
+        t = jax.device_put(np.int32(11), NamedSharding(mesh4, P()))
+        opt4 = tuple(tree["opt"])   # orbax restores the (m, v) pair as list
+        new_params, new_opt, loss = step_fn(tree["params"], opt4, batch, t)
+        assert np.isfinite(float(loss))
