@@ -206,6 +206,13 @@ def rescale_sharded(directory, mesh, specs, step=None):
                 raise MXNetError(
                     f"spec {type(spec).__name__} does not match the "
                     "checkpoint's dict at this position")
+            unknown = set(spec) - set(m)
+            if unknown:
+                # a typo'd key would silently leave its real parameter
+                # REPLICATED — a memory blowup at restart, not a no-op
+                raise MXNetError(
+                    f"spec keys {sorted(unknown)} not in the checkpoint "
+                    f"(has {sorted(m)})")
             return {k: fill_missing(m[k], spec.get(k)) for k in m}
         if isinstance(m, (list, tuple)):
             if spec is None:
