@@ -1,0 +1,13 @@
+"""Importing this module pins jax to the CPU platform — the shared
+header for host-side tools that must never touch an accelerator. The
+ambient axon sitecustomize rewrites JAX_PLATFORMS, so the env var alone
+is unreliable; the config API call is made LOUDLY (a failure here means
+a backend already initialized and the tool would otherwise grab it).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

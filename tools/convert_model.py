@@ -16,6 +16,15 @@ reference zoo files use flat scoped names (resnetv10_conv0_weight...)
 that differ from the structural names here; the architectures enumerate
 identically, so order+shape alignment maps them without a curated table.
 """
+# host-side tool: never touch an accelerator — force the CPU platform
+# via the shared helper (the ambient axon sitecustomize rewrites
+# JAX_PLATFORMS, so the env var alone is not reliable)
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _force_cpu  # noqa: F401  (import has the side effect)
+
 import argparse
 import os
 import sys

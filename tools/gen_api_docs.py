@@ -7,6 +7,15 @@ the reference can never drift silently.
 
     python tools/gen_api_docs.py [--out docs/api]
 """
+# host-side tool: never touch an accelerator — force the CPU platform
+# via the shared helper (the ambient axon sitecustomize rewrites
+# JAX_PLATFORMS, so the env var alone is not reliable)
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _force_cpu  # noqa: F401  (import has the side effect)
+
 import argparse
 import inspect
 import os
