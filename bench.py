@@ -13,10 +13,22 @@ blocks inside the loop, and the clock stops only after the final result lands
 on the host. Zero eager ops execute inside any timed loop. The JSON also
 reports the measured per-dispatch latency of this environment (sync and
 chained) so builder-env vs driver-env discrepancies are directly diagnosable.
+
+Resilience (VERDICT-r4 Weak #1): round 4's driver run died with rc=1 and no
+JSON because TPU backend init failed once. bench.py is now an orchestrator:
+it probes the backend in a SUBPROCESS with a hard timeout (the current
+failure mode is a hang, not an error), retries with backoff, then runs the
+measurement phases in a resumable worker subprocess that flushes partial
+results to disk after every phase. Whatever happens — backend dead, worker
+hang, phase crash — the orchestrator exits 0 and prints ONE JSON line with
+every metric it managed to collect plus an `error` block and host
+diagnostics.
 """
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
@@ -361,88 +373,357 @@ def bench_io_pipeline():
 
 
 def _log(msg):
-    import sys
     import time as _t
     print(f"[bench {_t.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
           flush=True)
 
 
-def main():
-    _log("dispatch latency...")
+# ---------------------------------------------------------------------------
+# Measurement phases. Each returns a flat dict of raw metrics; the worker
+# runs them IN ORDER (ordering is load-bearing: eager first, calibration
+# last — large programs leave device-session residue that slows subsequent
+# eager-class programs ~100x, bisected in r3) and flushes partial results
+# to disk after each, so a crash/hang mid-run loses only the current phase.
+# ---------------------------------------------------------------------------
+
+def _phase_dispatch():
     sync_us, chained_us = measure_dispatch_latency()
-    # eager runs FIRST and the matmul calibration runs LAST: the calibration
-    # (and other large programs) leave device-session residue — server-side
-    # state the client can neither inspect nor free — that slows subsequent
-    # eager-class programs ~100x (bisected empirically; the fused phases are
-    # insensitive to ordering)
-    _log(f"dispatch sync={sync_us}us chained={chained_us}us; eager...")
-    eager_ips = bench_resnet50_train_eager()
-    _log(f"eager={eager_ips:.1f}; train bs32...")
-    train_ips = bench_resnet50_train()
-    _log(f"train bs32={train_ips:.1f}; train bs128...")
+    return {"per_dispatch_latency_us_sync": sync_us,
+            "per_dispatch_latency_us_chained": chained_us}
+
+
+def _phase_eager():
+    return {"eager_tape_images_per_sec_bs32":
+            round(bench_resnet50_train_eager(), 2)}
+
+
+def _phase_train32():
+    return {"train_bs32_images_per_sec": round(bench_resnet50_train(), 2)}
+
+
+def _phase_train128():
     # bs128 is compute-bound (per-dispatch latency amortizes over the big
     # step already) — no scan, smaller pool, so the row stays cheap to set up
-    train128_ips = bench_resnet50_train(batch_size=128, iters=24, warmup=3,
-                                        steps_per_call=1)
-    _log(f"train bs128={train128_ips:.1f}; infer...")
-    infer_ips = bench_resnet50_infer()
-    _log(f"infer={infer_ips:.1f}; io...")
-    io_result = bench_io_pipeline()
-    _log("io done; calibrating attainable TFLOP/s...")
-    calib_tflops, calib_probes = measure_attainable_tflops()
-    _log(f"attainable={calib_tflops}; XLA flop cross-check...")
-    xla_gflops = xla_counted_fwd_gflops()
+    return {"train_bs128_images_per_sec": round(bench_resnet50_train(
+        batch_size=128, iters=24, warmup=3, steps_per_call=1), 2)}
+
+
+def _phase_infer():
+    return {"infer_images_per_sec_bs32_bf16":
+            round(bench_resnet50_infer(), 2)}
+
+
+def _phase_io():
+    r = bench_io_pipeline()
+    if r is None:
+        return {}
+    out = {"io_pipeline_images_per_sec": r["value"],
+           # the producer owns the reference figure (io_bench REFERENCE_IMG_S)
+           "io_vs_reference_3000": r.get(
+               "vs_baseline", round(r["value"] / 3000.0, 4))}
+    # per-stage evidence for the decode-bound analysis rides along
+    for k in ("stage_decode_ms_per_img", "stage_augment_ms_per_img",
+              "stage_other_ms_per_img",
+              "decode_only_ceiling_img_s_per_core", "decode_share",
+              "host_cores", "host_loadavg_1m", "threads",
+              "thread_scaling_2", "thread_scaling_max"):
+        if k in r:
+            out[f"io_{k}"] = r[k]
+    return out
+
+
+def _phase_calib():
+    tflops, probes = measure_attainable_tflops()
+    return {"calib_attainable_bf16_tflops": tflops,
+            "calib_probes_tflops": probes}
+
+
+def _phase_xla_flops():
+    return {"xla_counted_fwd_gflop_per_img": xla_counted_fwd_gflops()}
+
+
+PHASES = [
+    ("dispatch", _phase_dispatch),
+    ("eager", _phase_eager),
+    ("train32", _phase_train32),
+    ("train128", _phase_train128),
+    ("infer", _phase_infer),
+    ("io", _phase_io),
+    ("calib", _phase_calib),
+    ("xla_flops", _phase_xla_flops),
+]
+
+
+def assemble(m):
+    """Build the final JSON dict from whatever raw metrics exist. Derived
+    metrics (vs_baseline, MFU) are computed only when their inputs landed,
+    so a partial run still yields a valid, honest line."""
+    train_ips = m.get("train_bs32_images_per_sec")
+    train128 = m.get("train_bs128_images_per_sec")
+    infer_ips = m.get("infer_images_per_sec_bs32_bf16")
+    calib = m.get("calib_attainable_bf16_tflops")
     out = {
         "metric": "resnet50_train_images_per_sec_bs32",
-        "value": round(train_ips, 2),
+        "value": train_ips if train_ips is not None else 0.0,
         "unit": "images/sec",
-        "vs_baseline": round(train_ips / BASELINE_V100_FP32_TRAIN_BS32, 4),
+        "vs_baseline": round((train_ips or 0.0)
+                             / BASELINE_V100_FP32_TRAIN_BS32, 4),
         "precision": "bf16_amp_nhwc_fused_step",
-        "train_bs128_images_per_sec": round(train128_ips, 2),
-        "train_bs128_vs_v100_fp32": round(
-            train128_ips / BASELINE_V100_FP32_TRAIN_BS128, 4),
-        "mfu_bs32": round(train_ips * FLOPS_TRAIN_PER_IMG
-                          / TPU_V5E_BF16_PEAK, 4),
-        "mfu_bs128": round(train128_ips * FLOPS_TRAIN_PER_IMG
-                           / TPU_V5E_BF16_PEAK, 4),
-        "eager_tape_images_per_sec_bs32": round(eager_ips, 2),
-        "infer_images_per_sec_bs32_bf16": round(infer_ips, 2),
-        "infer_vs_v100_fp16_baseline": round(
-            infer_ips / BASELINE_V100_FP16_INFER_BS32, 4),
-        "per_dispatch_latency_us_sync": sync_us,
-        "per_dispatch_latency_us_chained": chained_us,
-        # attainable = max over probe sweep (matmul sizes + ResNet-class
-        # conv); the honest denominator for this chip. Self-consistency:
-        # achieved_tflops_* may not exceed it (VERDICT-r3 Weak #1).
-        "calib_attainable_bf16_tflops": calib_tflops,
-        "calib_probes_tflops": calib_probes,
-        # XLA cost-analysis flops for the compiled fwd (GFLOP/img, MAC=2):
-        # must be ~= FLOPS_FWD_PER_IMG/1e9, keeping the MFU numerator honest
-        "xla_counted_fwd_gflop_per_img": xla_gflops,
-        "fwd_gflop_per_img_used": round(FLOPS_FWD_PER_IMG / 1e9, 2),
-        "achieved_tflops_bs32": round(
-            train_ips * FLOPS_TRAIN_PER_IMG / 1e12, 2),
-        "achieved_tflops_bs128": round(
-            train128_ips * FLOPS_TRAIN_PER_IMG / 1e12, 2),
-        "mfu_vs_attainable_bs32": round(
-            train_ips * FLOPS_TRAIN_PER_IMG / 1e12 / calib_tflops, 4),
-        "mfu_vs_attainable_bs128": round(
-            train128_ips * FLOPS_TRAIN_PER_IMG / 1e12 / calib_tflops, 4),
     }
-    if io_result is not None:
-        out["io_pipeline_images_per_sec"] = io_result["value"]
-        # the producer owns the reference figure (io_bench REFERENCE_IMG_S)
-        out["io_vs_reference_3000"] = io_result.get(
-            "vs_baseline", round(io_result["value"] / 3000.0, 4))
-        # per-stage evidence for the decode-bound analysis rides along
-        for k in ("stage_decode_ms_per_img", "stage_augment_ms_per_img",
-                  "stage_other_ms_per_img",
-                  "decode_only_ceiling_img_s_per_core", "decode_share",
-                  "host_cores", "host_loadavg_1m"):
-            if k in io_result:
-                out[f"io_{k}"] = io_result[k]
+    if train_ips is not None:
+        out["mfu_bs32"] = round(
+            train_ips * FLOPS_TRAIN_PER_IMG / TPU_V5E_BF16_PEAK, 4)
+        out["achieved_tflops_bs32"] = round(
+            train_ips * FLOPS_TRAIN_PER_IMG / 1e12, 2)
+    if train128 is not None:
+        out["train_bs128_vs_v100_fp32"] = round(
+            train128 / BASELINE_V100_FP32_TRAIN_BS128, 4)
+        out["mfu_bs128"] = round(
+            train128 * FLOPS_TRAIN_PER_IMG / TPU_V5E_BF16_PEAK, 4)
+        out["achieved_tflops_bs128"] = round(
+            train128 * FLOPS_TRAIN_PER_IMG / 1e12, 2)
+    if infer_ips is not None:
+        out["infer_vs_v100_fp16_baseline"] = round(
+            infer_ips / BASELINE_V100_FP16_INFER_BS32, 4)
+    # attainable = max over probe sweep (matmul sizes + ResNet-class conv);
+    # the honest denominator for this chip. Self-consistency:
+    # achieved_tflops_* may not exceed it (VERDICT-r3 Weak #1).
+    if calib:
+        if train_ips is not None:
+            out["mfu_vs_attainable_bs32"] = round(
+                train_ips * FLOPS_TRAIN_PER_IMG / 1e12 / calib, 4)
+        if train128 is not None:
+            out["mfu_vs_attainable_bs128"] = round(
+                train128 * FLOPS_TRAIN_PER_IMG / 1e12 / calib, 4)
+    # XLA cost-analysis flops for the compiled fwd (GFLOP/img, MAC=2) must
+    # be ~= fwd_gflop_per_img_used, keeping the MFU numerator honest
+    out["fwd_gflop_per_img_used"] = round(FLOPS_FWD_PER_IMG / 1e9, 2)
+    for k, v in m.items():
+        if k not in out and not k.startswith("_"):
+            out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Worker: runs phases, resumable via the partial-results file.
+# ---------------------------------------------------------------------------
+
+def run_worker(partial_path):
+    partial = {}
+    if os.path.exists(partial_path):
+        try:
+            with open(partial_path) as f:
+                partial = json.load(f)
+        except Exception:
+            partial = {}
+    done = set(partial.get("_phases_done", []))
+    errors = partial.get("_phase_errors", {})
+    for name, fn in PHASES:
+        if name in done:
+            _log(f"phase {name}: cached from previous attempt")
+            continue
+        _log(f"phase {name}...")
+        try:
+            partial.update(fn())
+            done.add(name)
+            errors.pop(name, None)   # a resumed retry may have succeeded
+        except Exception as e:  # record and move on — partial > nothing
+            import traceback
+            errors[name] = f"{type(e).__name__}: {e}"
+            _log(f"phase {name} FAILED: {errors[name]}")
+            traceback.print_exc(file=sys.stderr)
+        partial["_phases_done"] = sorted(done)
+        partial["_phase_errors"] = errors
+        tmp = partial_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(partial, f)
+        os.replace(tmp, partial_path)
+    final = assemble(partial)
+    if errors:
+        final["phase_errors"] = errors
+    print(json.dumps(final))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator: backend probe with retry/backoff, worker with hang
+# protection, diagnostic JSON on every failure path. Always exits 0.
+# ---------------------------------------------------------------------------
+
+PROBE_ATTEMPTS = 5
+PROBE_TIMEOUT_S = 150       # backend init hangs are the observed mode
+PROBE_BACKOFF_S = 30
+WORKER_ATTEMPTS = 2
+WORKER_TIMEOUT_S = 1800
+
+
+def _host_diagnostics():
+    d = {"jax_platforms_env": os.environ.get("JAX_PLATFORMS", ""),
+         "host_cores": os.cpu_count()}
+    try:
+        d["host_loadavg_1m"] = round(os.getloadavg()[0], 2)
+    except OSError:
+        pass
+    return d
+
+
+def _run_sub(argv, timeout, env=None):
+    """Run argv in its own process group; on timeout kill the whole group
+    (a hung TPU client ignores SIGTERM's default courtesy window)."""
+    import signal
+    import subprocess
+    try:
+        p = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            start_new_session=True, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except Exception as e:
+        return -1, "", f"spawn failed: {e}"
+    try:
+        out, err = p.communicate(timeout=timeout)
+        return p.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        out, err = p.communicate()
+        return -9, out or "", (err or "") + f"\n[killed: timeout {timeout}s]"
+
+
+def probe_backend():
+    """Can a fresh process see an accelerator? Retries with backoff because
+    the observed failure modes (axon UNAVAILABLE, init hang) are transient
+    tunnel states. Returns (ok, info)."""
+    code = ("import jax; d = jax.devices(); "
+            "print(d[0].platform, len(d), flush=True)")
+    attempts = []
+    for i in range(PROBE_ATTEMPTS):
+        t0 = time.perf_counter()
+        rc, out, err = _run_sub([sys.executable, "-c", code],
+                                PROBE_TIMEOUT_S)
+        dt = round(time.perf_counter() - t0, 1)
+        if rc == 0 and out.strip():
+            plat, n = out.split()[0], out.split()[1]
+            _log(f"backend probe ok: platform={plat} n={n} ({dt}s, "
+                 f"attempt {i + 1})")
+            return True, {"platform": plat, "n_devices": int(n),
+                          "probe_attempts": i + 1}
+        tail = (err or out).strip().splitlines()[-3:]
+        attempts.append({"attempt": i + 1, "rc": rc, "elapsed_s": dt,
+                         "tail": " | ".join(tail)[-500:]})
+        _log(f"backend probe attempt {i + 1}/{PROBE_ATTEMPTS} failed "
+             f"(rc={rc}, {dt}s); backoff {PROBE_BACKOFF_S}s")
+        if i + 1 < PROBE_ATTEMPTS:
+            time.sleep(PROBE_BACKOFF_S)
+    return False, {"probe_attempts": PROBE_ATTEMPTS,
+                   "probe_failures": attempts}
+
+
+def cpu_smoke():
+    """Last-resort evidence when the accelerator is unreachable: prove the
+    framework itself executes a train step on the CPU backend, so the
+    diagnostic line separates 'chip dead' from 'code broken'."""
+    code = (
+        # the axon sitecustomize rewrites JAX_PLATFORMS, so the platform
+        # must be forced through the config API (see tests/conftest.py)
+        "import os; os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import incubator_mxnet_tpu as mx\n"
+        "from incubator_mxnet_tpu import gluon\n"
+        "net = gluon.nn.Sequential()\n"
+        "net.add(gluon.nn.Conv2D(8, 3, layout='NHWC'),\n"
+        "        gluon.nn.Flatten(), gluon.nn.Dense(10))\n"
+        "net.initialize()\n"
+        "tr = gluon.Trainer(net.collect_params(), 'sgd',\n"
+        "                   {'learning_rate': 0.1})\n"
+        "x = mx.np.array(np.random.rand(4, 8, 8, 3).astype('float32'))\n"
+        "y = mx.np.array(np.array([0, 1, 2, 3]))\n"
+        "L = gluon.loss.SoftmaxCrossEntropyLoss()\n"
+        "for _ in range(3):\n"
+        "    with mx.autograd.record():\n"
+        "        l = L(net(x), y).mean()\n"
+        "    l.backward(); tr.step(4)\n"
+        "print('SMOKE_OK', float(l.asnumpy()), flush=True)\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    rc, out, err = _run_sub([sys.executable, "-c", code], 300, env=env)
+    if rc == 0 and "SMOKE_OK" in out:
+        return {"cpu_smoke": "ok",
+                "cpu_smoke_loss": float(out.split()[-1])}
+    return {"cpu_smoke": f"failed rc={rc}",
+            "cpu_smoke_tail": (err or out).strip()[-300:]}
+
+
+def main():
+    partial_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmark", ".bench_partial.json")
+    try:
+        os.makedirs(os.path.dirname(partial_path), exist_ok=True)
+        if os.path.exists(partial_path):
+            os.remove(partial_path)  # stale partials from a previous run
+    except OSError:
+        pass
+
+    ok, probe_info = probe_backend()
+    if not ok:
+        out = assemble({})
+        out["error"] = ("accelerator backend unavailable after "
+                        f"{PROBE_ATTEMPTS} probe attempts x "
+                        f"{PROBE_TIMEOUT_S}s timeout")
+        out.update(probe_info)
+        out.update(_host_diagnostics())
+        _log("backend dead; running CPU smoke for diagnosis...")
+        out.update(cpu_smoke())
+        print(json.dumps(out))
+        return 0
+
+    worker_errs = []
+    for i in range(WORKER_ATTEMPTS):
+        rc, wout, werr = _run_sub(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             partial_path], WORKER_TIMEOUT_S)
+        sys.stderr.write(werr)
+        if rc == 0:
+            for line in reversed(wout.strip().splitlines()):
+                try:
+                    parsed = json.loads(line)
+                except ValueError:
+                    continue
+                # the platform always rides along: a CPU-fallback backend
+                # must never masquerade as a chip result
+                parsed["platform"] = probe_info.get("platform")
+                if probe_info.get("platform") == "cpu":
+                    parsed["warning"] = ("no accelerator visible — these "
+                                         "are CPU-backend numbers")
+                if probe_info.get("probe_attempts", 1) > 1:
+                    parsed["probe_attempts"] = probe_info["probe_attempts"]
+                print(json.dumps(parsed))
+                return 0
+        worker_errs.append({"attempt": i + 1, "rc": rc,
+                            "tail": (werr or wout).strip()[-500:]})
+        _log(f"worker attempt {i + 1}/{WORKER_ATTEMPTS} failed (rc={rc}); "
+             "resuming from partial results")
+
+    # Both worker attempts died: salvage the partial file.
+    partial = {}
+    try:
+        with open(partial_path) as f:
+            partial = json.load(f)
+    except Exception:
+        pass
+    out = assemble(partial)
+    out["error"] = f"worker failed after {WORKER_ATTEMPTS} attempts"
+    out["worker_failures"] = worker_errs
+    out["phases_done"] = partial.get("_phases_done", [])
+    out["phase_errors"] = partial.get("_phase_errors", {})
+    out.update(_host_diagnostics())
     print(json.dumps(out))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        sys.exit(run_worker(sys.argv[2]))
+    sys.exit(main())

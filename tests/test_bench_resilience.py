@@ -1,0 +1,88 @@
+"""bench.py resilience (VERDICT-r4 Weak #1): the bench must survive a flaky
+backend — partial results flush per phase, failed phases are recorded and
+skipped, a resumed worker re-runs only what's missing, and assemble() yields
+a valid JSON dict from ANY subset of raw metrics."""
+import json
+import os
+
+import bench
+
+
+def test_assemble_empty_is_valid_line():
+    out = bench.assemble({})
+    assert out["metric"] == "resnet50_train_images_per_sec_bs32"
+    assert out["value"] == 0.0
+    assert out["unit"] == "images/sec"
+    assert out["vs_baseline"] == 0.0
+
+
+def test_assemble_partial_derives_only_available():
+    out = bench.assemble({"train_bs32_images_per_sec": 2600.0})
+    assert out["value"] == 2600.0
+    assert out["vs_baseline"] > 8.0
+    assert "mfu_bs32" in out
+    assert "mfu_vs_attainable_bs32" not in out  # no calibration ran
+    out2 = bench.assemble({"train_bs32_images_per_sec": 2600.0,
+                           "calib_attainable_bf16_tflops": 176.5})
+    assert abs(out2["mfu_vs_attainable_bs32"]
+               - 2600.0 * bench.FLOPS_TRAIN_PER_IMG / 1e12 / 176.5) < 1e-3
+
+
+def test_worker_records_failures_and_resumes(tmp_path, capsys, monkeypatch):
+    calls = []
+
+    def ok_a():
+        calls.append("a")
+        return {"metric_a": 1}
+
+    def boom():
+        calls.append("b")
+        raise RuntimeError("backend fell over")
+
+    def ok_c():
+        calls.append("c")
+        return {"metric_c": 3}
+
+    path = str(tmp_path / "partial.json")
+    monkeypatch.setattr(bench, "PHASES",
+                        [("a", ok_a), ("b", boom), ("c", ok_c)])
+    assert bench.run_worker(path) == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["metric_a"] == 1 and line["metric_c"] == 3
+    assert "backend fell over" in line["phase_errors"]["b"]
+    saved = json.load(open(path))
+    assert sorted(saved["_phases_done"]) == ["a", "c"]
+
+    # resume: a and c are cached; only b re-runs (and now succeeds)
+    calls.clear()
+    monkeypatch.setattr(
+        bench, "PHASES",
+        [("a", ok_a), ("b", lambda: {"metric_b": 2}), ("c", ok_c)])
+    assert bench.run_worker(path) == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert calls == []  # lambda isn't in calls; a/c never re-ran
+    assert line["metric_a"] == 1 and line["metric_b"] == 2
+    assert line["metric_c"] == 3
+
+
+def test_orchestrator_emits_diagnostic_json_when_backend_dead(monkeypatch,
+                                                              capsys,
+                                                              tmp_path):
+    monkeypatch.setattr(bench, "probe_backend",
+                        lambda: (False, {"probe_attempts": 5,
+                                         "probe_failures": []}))
+    monkeypatch.setattr(bench, "cpu_smoke", lambda: {"cpu_smoke": "ok"})
+    assert bench.main() == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["value"] == 0.0
+    assert "error" in line and "unavailable" in line["error"]
+    assert line["probe_attempts"] == 5
+    assert line["cpu_smoke"] == "ok"
+
+
+def test_phase_list_ordering_is_loadbearing():
+    # eager before the big fused programs, calibration last (device-session
+    # residue slows subsequent eager-class programs; bisected in r3)
+    names = [n for n, _ in bench.PHASES]
+    assert names.index("eager") < names.index("train32")
+    assert names.index("calib") > names.index("infer")
