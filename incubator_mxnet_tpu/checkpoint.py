@@ -220,7 +220,10 @@ def rescale_sharded(directory, mesh, specs, step=None):
             if len(spec) != len(m):
                 raise MXNetError("spec sequence length does not match "
                                  "the checkpoint")
-            return [fill_missing(mm, ss) for mm, ss in zip(m, spec)]
+            out = [fill_missing(mm, ss) for mm, ss in zip(m, spec)]
+            # tree_map below needs IDENTICAL treedefs: a tuple node in the
+            # checkpoint metadata must stay a tuple in the filled spec
+            return tuple(out) if isinstance(m, tuple) else out
         return spec   # leaf: PartitionSpec or None
 
     specs = fill_missing(meta, specs)

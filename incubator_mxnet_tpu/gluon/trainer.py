@@ -147,6 +147,10 @@ class Trainer:
             var = p._data._var
             if var is not None and not var.fresh:
                 if ignore_stale_grad:
+                    # a skipped sparse param must not carry its token
+                    # batches into a later step (stale-row update + leak)
+                    if getattr(p, "_last_tokens", None) is not None:
+                        p._last_tokens = None
                     continue  # skip params whose grad was not refreshed
                 raise MXNetError(
                     f"gradient of parameter {p.name} has not been updated by "
@@ -158,12 +162,20 @@ class Trainer:
                 self._states_created[i] = True
             if getattr(p, "_sparse_grad", False) \
                     and getattr(p, "_last_tokens", None) is not None:
-                # row-sparse path (≙ trainer.py:325 row-sparse pull +
-                # lazy_update): only rows touched since the last step
-                self._row_sparse_update(i, p, self._states[i])
-                if p.data()._var is not None:
-                    p.data()._var.fresh = False
-                continue
+                if self._kvstore is not None and getattr(
+                        self._kvstore, "type", "").startswith("dist"):
+                    # dist: the allreduced dense grad carries rows touched
+                    # ONLY on other workers; updating just the locally
+                    # touched rows would drop those contributions and let
+                    # replicas diverge — fall through to the dense update
+                    p._last_tokens = None
+                else:
+                    # row-sparse path (≙ trainer.py:325 row-sparse pull +
+                    # lazy_update): only rows touched since the last step
+                    self._row_sparse_update(i, p, self._states[i])
+                    if p.data()._var is not None:
+                        p.data()._var.fresh = False
+                    continue
             items.append((i, p.data(), p.grad(), self._states[i]))
         # one fused XLA computation for all params when the rule supports
         # it (≙ multi_sgd_update etc.). Under engine op-bulking the update
@@ -228,6 +240,11 @@ class Trainer:
         for p in self._params:
             if p._data is not None and p._data._var is not None:
                 p._data._var.fresh = False
+            # update happened on the kvstore: the sparse-row path never
+            # runs here, so token batches must be dropped or they pile up
+            # without bound (and would stale-update rows much later)
+            if getattr(p, "_last_tokens", None) is not None:
+                p._last_tokens = None
 
     # ------------------------------------------------------------------
     def save_states(self, fname):
