@@ -10,7 +10,11 @@ from .distributions import (Distribution, Normal, Bernoulli, Categorical,
                             Uniform, Exponential, Gamma, Poisson, Laplace,
                             Beta, Dirichlet, StudentT, HalfNormal, Cauchy,
                             Geometric, Binomial, MultivariateNormal,
-                            kl_divergence, register_kl)
+                            Gumbel, Weibull, Pareto, HalfCauchy, Chi2,
+                            FisherSnedecor, NegativeBinomial, Multinomial,
+                            OneHotCategorical, RelaxedBernoulli,
+                            RelaxedOneHotCategorical, Independent,
+                            kl_divergence, register_kl, empirical_kl)
 from .stochastic_block import StochasticBlock, StochasticSequential
 from .transformation import (Transformation, ComposeTransform, ExpTransform,
                              AffineTransform, PowerTransform,

@@ -13,7 +13,11 @@ from ... import random as _random
 __all__ = ["Distribution", "Normal", "Bernoulli", "Categorical", "Uniform",
            "Exponential", "Gamma", "Poisson", "Laplace", "Beta", "Dirichlet",
            "StudentT", "HalfNormal", "Cauchy", "Geometric", "Binomial",
-           "MultivariateNormal", "kl_divergence", "register_kl"]
+           "MultivariateNormal", "Gumbel", "Weibull", "Pareto", "HalfCauchy",
+           "Chi2", "FisherSnedecor", "NegativeBinomial", "Multinomial",
+           "OneHotCategorical", "RelaxedBernoulli",
+           "RelaxedOneHotCategorical", "Independent",
+           "kl_divergence", "register_kl", "empirical_kl"]
 
 _KL_REGISTRY = {}
 
@@ -618,6 +622,483 @@ class MultivariateNormal(Distribution):
         return self.loc
 
 
+class Gumbel(Distribution):
+    """≙ distributions/gumbel.py:48 — Gumbel(loc, scale)."""
+
+    def __init__(self, loc, scale=1.0):
+        super().__init__(loc=loc, scale=scale)
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            import jax.numpy as jnp
+            z = (v - loc) / scale
+            return -jnp.log(scale) - z - jnp.exp(-z)
+        return _call(f, value, self.loc, self.scale)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(loc, scale):
+            import jax
+            return loc + scale * jax.random.gumbel(key, shape)
+        return _call(f, self.loc, self.scale)
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * _np.euler_gamma
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6) * self.scale ** 2
+
+    def entropy(self):
+        from ... import numpy as mxnp
+        return mxnp.log(self.scale) + 1 + _np.euler_gamma
+
+
+class Weibull(Distribution):
+    """≙ distributions/weibull.py:49 — Weibull(concentration, scale)."""
+
+    def __init__(self, concentration, scale=1.0):
+        super().__init__(concentration=concentration, scale=scale)
+
+    def log_prob(self, value):
+        def f(v, k, s):
+            import jax.numpy as jnp
+            lp = (jnp.log(k / s) + (k - 1) * (jnp.log(v) - jnp.log(s))
+                  - (v / s) ** k)
+            return jnp.where(v >= 0, lp, -_np.inf)
+        return _call(f, value, self.concentration, self.scale)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(k, s):
+            import jax
+            # inverse-CDF: s * (-log U)^(1/k), U~Uniform — exponential
+            # draw keeps it numerically clean at U→{0,1}
+            e = jax.random.exponential(key, shape)
+            return s * e ** (1.0 / k)
+        return _call(f, self.concentration, self.scale)
+
+    @property
+    def mean(self):
+        def f(k, s):
+            import jax
+            import jax.numpy as jnp
+            return s * jnp.exp(jax.scipy.special.gammaln(1 + 1 / k))
+        return _call(f, self.concentration, self.scale)
+
+    @property
+    def variance(self):
+        def f(k, s):
+            import jax
+            import jax.numpy as jnp
+            g1 = jnp.exp(jax.scipy.special.gammaln(1 + 1 / k))
+            g2 = jnp.exp(jax.scipy.special.gammaln(1 + 2 / k))
+            return s ** 2 * (g2 - g1 ** 2)
+        return _call(f, self.concentration, self.scale)
+
+    def entropy(self):
+        from ... import numpy as mxnp
+        k, s = self.concentration, self.scale
+        return _np.euler_gamma * (1 - 1 / k) + mxnp.log(s / k) + 1
+
+
+class Pareto(Distribution):
+    """≙ distributions/pareto.py:47 — Pareto(alpha, scale); support
+    [scale, inf)."""
+
+    def __init__(self, alpha, scale=1.0):
+        super().__init__(alpha=alpha, scale=scale)
+
+    def log_prob(self, value):
+        def f(v, a, s):
+            import jax.numpy as jnp
+            lp = jnp.log(a) + a * jnp.log(s) - (a + 1) * jnp.log(v)
+            return jnp.where(v >= s, lp, -_np.inf)
+        return _call(f, value, self.alpha, self.scale)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(a, s):
+            import jax
+            import jax.numpy as jnp
+            # X = s * e^(E/a), E~Exp(1)  (inverse-CDF of the power law)
+            e = jax.random.exponential(key, shape)
+            return s * jnp.exp(e / a)
+        return _call(f, self.alpha, self.scale)
+
+    @property
+    def mean(self):
+        def f(a, s):
+            import jax.numpy as jnp
+            return jnp.where(a > 1, a * s / (a - 1), jnp.inf)
+        return _call(f, self.alpha, self.scale)
+
+    @property
+    def variance(self):
+        def f(a, s):
+            import jax.numpy as jnp
+            v = s ** 2 * a / ((a - 1) ** 2 * (a - 2))
+            return jnp.where(a > 2, v, jnp.inf)
+        return _call(f, self.alpha, self.scale)
+
+    def entropy(self):
+        from ... import numpy as mxnp
+        a, s = self.alpha, self.scale
+        return mxnp.log(s / a) + 1 + 1 / a
+
+
+class HalfCauchy(Distribution):
+    """≙ distributions/half_cauchy.py:48 — HalfCauchy(scale), support
+    [0, inf)."""
+
+    def __init__(self, scale=1.0):
+        super().__init__(scale=scale)
+
+    def log_prob(self, value):
+        def f(v, s):
+            import jax.numpy as jnp
+            lp = (math.log(2.0) - math.log(math.pi) - jnp.log(s)
+                  - jnp.log1p((v / s) ** 2))
+            return jnp.where(v >= 0, lp, -_np.inf)
+        return _call(f, value, self.scale)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(s):
+            import jax
+            import jax.numpy as jnp
+            return jnp.abs(s * jax.random.cauchy(key, shape))
+        return _call(f, self.scale)
+
+
+class Chi2(Gamma):
+    """≙ distributions/chi2.py:40 — Chi2(df) = Gamma(df/2, scale=2)."""
+
+    def __init__(self, df):
+        super().__init__(shape=_as_nd(df) / 2, scale=2.0)
+
+    @property
+    def df(self):
+        return self.shape_param * 2
+
+
+class FisherSnedecor(Distribution):
+    """≙ distributions/fishersnedecor.py:47 — F(df1, df2)."""
+
+    def __init__(self, df1, df2):
+        super().__init__(df1=df1, df2=df2)
+
+    def log_prob(self, value):
+        def f(v, d1, d2):
+            import jax
+            import jax.numpy as jnp
+            lbeta = (jax.scipy.special.gammaln(d1 / 2)
+                     + jax.scipy.special.gammaln(d2 / 2)
+                     - jax.scipy.special.gammaln((d1 + d2) / 2))
+            return ((d1 / 2) * jnp.log(d1 / d2)
+                    + (d1 / 2 - 1) * jnp.log(v)
+                    - ((d1 + d2) / 2) * jnp.log1p(d1 * v / d2) - lbeta)
+        return _call(f, value, self.df1, self.df2)
+
+    def sample(self, size=None):
+        k1, k2 = _random.next_key(), _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(d1, d2):
+            import jax
+            x1 = jax.random.gamma(k1, d1 / 2, shape) * 2
+            x2 = jax.random.gamma(k2, d2 / 2, shape) * 2
+            return (x1 / d1) / (x2 / d2)
+        return _call(f, self.df1, self.df2)
+
+    @property
+    def mean(self):
+        def f(d1, d2):
+            import jax.numpy as jnp
+            return jnp.where(d2 > 2, d2 / (d2 - 2), jnp.nan)
+        return _call(f, self.df1, self.df2)
+
+    @property
+    def variance(self):
+        def f(d1, d2):
+            import jax.numpy as jnp
+            v = (2 * d2 ** 2 * (d1 + d2 - 2)
+                 / (d1 * (d2 - 2) ** 2 * (d2 - 4)))
+            return jnp.where(d2 > 4, v, jnp.nan)
+        return _call(f, self.df1, self.df2)
+
+
+class NegativeBinomial(Distribution):
+    """≙ distributions/negative_binomial.py:51 — successes-before-n-failures
+    count; mean = n * prob / (1 - prob) (reference line 91)."""
+
+    has_grad = False
+
+    def __init__(self, n, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("pass exactly one of prob/logit")
+        if prob is None:
+            from ... import numpy_extension as npx
+            prob = npx.sigmoid(_as_nd(logit))
+        super().__init__(n=n, prob=prob)
+
+    @property
+    def logit(self):
+        from ... import numpy as mxnp
+        return mxnp.log(self.prob) - mxnp.log1p(-self.prob)
+
+    def log_prob(self, value):
+        def f(v, n, p):
+            import jax
+            import jax.numpy as jnp
+            coef = (jax.scipy.special.gammaln(v + n)
+                    - jax.scipy.special.gammaln(1 + v)
+                    - jax.scipy.special.gammaln(n))
+            return coef + n * jnp.log1p(-p) + v * jnp.log(p)
+        return _call(f, value, self.n, self.prob)
+
+    def sample(self, size=None):
+        # Poisson-Gamma mixture (≙ negative_binomial.py:121)
+        kg, kp = _random.next_key(), _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(n, p):
+            import jax
+            rate = jax.random.gamma(kg, n, shape) * (p / (1 - p))
+            return jax.random.poisson(kp, rate).astype("float32")
+        return _call(f, self.n, self.prob)
+
+    @property
+    def mean(self):
+        return self.n * self.prob / (1 - self.prob)
+
+    @property
+    def variance(self):
+        return self.n * self.prob / (1 - self.prob) ** 2
+
+
+class Multinomial(Distribution):
+    """≙ distributions/multinomial.py:48 — Multinomial(num_events,
+    prob/logit, total_count)."""
+
+    has_grad = False
+
+    def __init__(self, num_events, prob=None, logit=None, total_count=1):
+        if not isinstance(total_count, int):
+            raise MXNetError("total_count must be a scalar int")
+        if (prob is None) == (logit is None):
+            raise MXNetError("pass exactly one of prob/logit")
+        if prob is None:
+            from ... import numpy_extension as npx
+            prob = npx.softmax(_as_nd(logit), axis=-1)
+        super().__init__(prob=prob)
+        self.num_events = num_events
+        self.total_count = total_count
+
+    def log_prob(self, value):
+        n = float(self.total_count)
+
+        def f(v, p):
+            import jax
+            import jax.numpy as jnp
+            coef = (jax.scipy.special.gammaln(n + 1.0)
+                    - jnp.sum(jax.scipy.special.gammaln(v + 1.0), -1))
+            # xlogy: a zero count against a zero probability contributes 0
+            return coef + jnp.sum(jax.scipy.special.xlogy(v, p), -1)
+        return _call(f, value, self.prob)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape[:-1])
+        n, k = self.total_count, self.num_events
+
+        def f(p):
+            import jax
+            import jax.numpy as jnp
+            draws = jax.random.categorical(
+                key, jnp.log(p), shape=(n,) + shape)
+            return jnp.sum(jax.nn.one_hot(draws, k, dtype=p.dtype), 0)
+        return _call(f, self.prob)
+
+    @property
+    def mean(self):
+        return self.prob * self.total_count
+
+    @property
+    def variance(self):
+        return self.total_count * self.prob * (1 - self.prob)
+
+
+class OneHotCategorical(Categorical):
+    """≙ distributions/one_hot_categorical.py:46 — samples are one-hot;
+    log_prob consumes one-hot values."""
+
+    def log_prob(self, value):
+        def f(v, logit):
+            import jax
+            import jax.numpy as jnp
+            logp = jax.nn.log_softmax(logit, axis=-1)
+            return jnp.sum(v * logp, -1)
+        return _call(f, value, self.logit)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape[:-1])
+        k = self.num_events
+
+        def f(logit):
+            import jax
+            draws = jax.random.categorical(key, logit, shape=shape)
+            return jax.nn.one_hot(draws, k, dtype=logit.dtype)
+        return _call(f, self.logit)
+
+    @property
+    def mean(self):
+        return self.prob
+
+    @property
+    def variance(self):
+        return self.prob * (1 - self.prob)
+
+
+class RelaxedBernoulli(Distribution):
+    """≙ distributions/relaxed_bernoulli.py:50 — binary Concrete with
+    temperature T; reparameterized (gradients flow through sample)."""
+
+    def __init__(self, T, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("pass exactly one of prob/logit")
+        if logit is None:
+            from ... import numpy as mxnp
+            p = _as_nd(prob)
+            logit = mxnp.log(p) - mxnp.log1p(-p)
+        super().__init__(T=T, logit=logit)
+
+    @property
+    def prob(self):
+        from ... import numpy_extension as npx
+        return npx.sigmoid(self.logit)
+
+    def log_prob(self, value):
+        def f(v, t, logit):
+            import jax.numpy as jnp
+            # binary Concrete density (Maddison et al. 2017, eq. 24)
+            diff = logit - t * (jnp.log(v) - jnp.log1p(-v))
+            return (jnp.log(t) + diff - jnp.log(v) - jnp.log1p(-v)
+                    - 2 * jnp.logaddexp(0.0, diff))
+        return _call(f, value, self.T, self.logit)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(t, logit):
+            import jax
+            import jax.numpy as jnp
+            u = jax.random.uniform(key, shape, minval=1e-7, maxval=1 - 1e-7)
+            logistic = jnp.log(u) - jnp.log1p(-u)
+            return jax.nn.sigmoid((logit + logistic) / t)
+        return _call(f, self.T, self.logit)
+
+
+class RelaxedOneHotCategorical(Distribution):
+    """≙ distributions/relaxed_one_hot_categorical.py:53 — Concrete
+    distribution on the simplex with temperature T."""
+
+    def __init__(self, T, num_events, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("pass exactly one of prob/logit")
+        if logit is None:
+            from ... import numpy as mxnp
+            logit = mxnp.log(_as_nd(prob) + 1e-12)
+        super().__init__(T=T, logit=logit)
+        self.num_events = num_events
+
+    def log_prob(self, value):
+        k = self.num_events
+
+        def f(v, t, logit):
+            import jax
+            import jax.numpy as jnp
+            # Concrete density (Maddison et al. 2017, eq. 22)
+            score = logit - t * jnp.log(v)
+            return (jax.scipy.special.gammaln(float(k))
+                    + (k - 1) * jnp.log(t)
+                    + jnp.sum(score - jnp.log(v), -1)
+                    - k * jax.scipy.special.logsumexp(score, -1))
+        return _call(f, value, self.T, self.logit)
+
+    def sample(self, size=None):
+        key = _random.next_key()
+        shape = _sample_shape(size, self.batch_shape)
+
+        def f(t, logit):
+            import jax
+            g = jax.random.gumbel(key, shape)
+            return jax.nn.softmax((logit + g) / t, axis=-1)
+        return _call(f, self.T, self.logit)
+
+
+class Independent(Distribution):
+    """≙ distributions/independent.py:28 — reinterpret the rightmost
+    `reinterpreted_batch_ndims` batch dims of `base` as event dims (sums
+    them out of log_prob/entropy)."""
+
+    def __init__(self, base_distribution, reinterpreted_batch_ndims):
+        self.base_dist = base_distribution
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+        self._params = {}
+
+    @property
+    def has_grad(self):
+        return self.base_dist.has_grad
+
+    @property
+    def batch_shape(self):
+        b = self.base_dist.batch_shape
+        return b[:len(b) - self.reinterpreted_batch_ndims]
+
+    def _sum_rightmost(self, x):
+        from ... import numpy as mxnp
+        n = self.reinterpreted_batch_ndims
+        if n == 0:
+            return x
+        return mxnp.sum(x.reshape(x.shape[:x.ndim - n] + (-1,)), axis=-1)
+
+    def log_prob(self, value):
+        return self._sum_rightmost(self.base_dist.log_prob(value))
+
+    def sample(self, size=None):
+        return self.base_dist.sample(size)
+
+    def sample_n(self, size=None):
+        return self.base_dist.sample_n(size)
+
+    def entropy(self):
+        return self._sum_rightmost(self.base_dist.entropy())
+
+    @property
+    def mean(self):
+        return self.base_dist.mean
+
+    @property
+    def variance(self):
+        return self.base_dist.variance
+
+    def __repr__(self):
+        return (f"Independent({self.base_dist!r}, "
+                f"{self.reinterpreted_batch_ndims})")
+
+
 # ---------------------------------------------------------------------------
 # KL divergences (≙ probability KL registry)
 # ---------------------------------------------------------------------------
@@ -660,3 +1141,180 @@ def _kl_exp_exp(p, q):
 def _kl_unif_unif(p, q):
     from ... import numpy as mxnp
     return mxnp.log((q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(OneHotCategorical, OneHotCategorical)
+def _kl_onehot_onehot(p, q):
+    return _kl_cat_cat(p, q)
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy_cauchy(p, q):
+    from ... import numpy as mxnp
+    return mxnp.log(((p.scale + q.scale) ** 2 + (p.loc - q.loc) ** 2)
+                    / (4 * p.scale * q.scale))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    from ... import numpy as mxnp
+    d = mxnp.abs(p.loc - q.loc)
+    return (mxnp.log(q.scale / p.scale) - 1
+            + (p.scale * mxnp.exp(-d / p.scale) + d) / q.scale)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    from ... import numpy as mxnp
+    return p.rate * (mxnp.log(p.rate) - mxnp.log(q.rate)) + q.rate - p.rate
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geom_geom(p, q):
+    from ... import numpy as mxnp
+    a, b = p.prob, q.prob
+    return (mxnp.log(a) - mxnp.log(b)
+            + (1 - a) / a * (mxnp.log1p(-a) - mxnp.log1p(-b)))
+
+
+@register_kl(Pareto, Pareto)
+def _kl_pareto_pareto(p, q):
+    """Finite only when p's support lies inside q's (scale_p >= scale_q)."""
+    def f(a1, s1, a2, s2):
+        import jax.numpy as jnp
+        kl = (a2 * (jnp.log(s1) - jnp.log(s2))
+              + jnp.log(a1 / a2) + (a2 - a1) / a1)
+        return jnp.where(s1 >= s2, kl, jnp.inf)
+    return _call(f, p.alpha, p.scale, q.alpha, q.scale)
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    def f(l1, s1, l2, s2):
+        import jax
+        import jax.numpy as jnp
+        ratio = s1 / s2
+        dz = (l1 - l2) / s2
+        return (jnp.log(s2 / s1) + _np.euler_gamma * (ratio - 1) + dz
+                + jnp.exp(-dz + jax.scipy.special.gammaln(ratio + 1)) - 1)
+    return _call(f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    def f(a1, s1, a2, s2):
+        import jax
+        import jax.numpy as jnp
+        dig = jax.scipy.special.digamma(a1)
+        return ((a1 - a2) * dig - jax.scipy.special.gammaln(a1)
+                + jax.scipy.special.gammaln(a2)
+                + a2 * (jnp.log(s2) - jnp.log(s1)) + a1 * (s1 / s2 - 1))
+    return _call(f, p.shape_param, p.scale, q.shape_param, q.scale)
+
+
+@register_kl(Chi2, Chi2)
+def _kl_chi2_chi2(p, q):
+    return _kl_gamma_gamma(p, q)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def f(a1, b1, a2, b2):
+        import jax
+        sp = jax.scipy.special
+        lbeta = lambda a, b: sp.gammaln(a) + sp.gammaln(b) - sp.gammaln(a + b)
+        return (lbeta(a2, b2) - lbeta(a1, b1)
+                + (a1 - a2) * sp.digamma(a1) + (b1 - b2) * sp.digamma(b1)
+                + (a2 - a1 + b2 - b1) * sp.digamma(a1 + b1))
+    return _call(f, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def f(a1, a2):
+        import jax
+        import jax.numpy as jnp
+        sp = jax.scipy.special
+        s1 = jnp.sum(a1, -1)
+        return (sp.gammaln(s1) - jnp.sum(sp.gammaln(a1), -1)
+                - sp.gammaln(jnp.sum(a2, -1)) + jnp.sum(sp.gammaln(a2), -1)
+                + jnp.sum((a1 - a2)
+                          * (sp.digamma(a1) - sp.digamma(s1)[..., None]),
+                          -1))
+    return _call(f, p.alpha, q.alpha)
+
+
+@register_kl(HalfNormal, HalfNormal)
+def _kl_halfnormal_halfnormal(p, q):
+    from ... import numpy as mxnp
+    var_ratio = (p.scale / q.scale) ** 2
+    return 0.5 * (var_ratio - 1 - mxnp.log(var_ratio))
+
+
+@register_kl(Binomial, Binomial)
+def _kl_binom_binom(p, q):
+    if p.n != q.n:
+        raise MXNetError("KL(Binomial||Binomial) needs equal trial counts")
+    return p.n * _kl_bern_bern(p, q)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    def f(l1, L1, l2, L2):
+        import jax
+        import jax.numpy as jnp
+        d = l1.shape[-1]
+        # tr(S2^-1 S1) = ||L2^-1 L1||_F^2 ; Mahalanobis via a solve
+        M = jax.scipy.linalg.solve_triangular(L2, L1, lower=True)
+        tr = jnp.sum(M * M, (-2, -1))
+        diff = jax.scipy.linalg.solve_triangular(
+            L2, (l2 - l1)[..., None], lower=True)[..., 0]
+        maha = jnp.sum(diff * diff, -1)
+        ld1 = jnp.sum(jnp.log(jnp.diagonal(L1, axis1=-2, axis2=-1)), -1)
+        ld2 = jnp.sum(jnp.log(jnp.diagonal(L2, axis1=-2, axis2=-1)), -1)
+        return 0.5 * (tr + maha - d) + ld2 - ld1
+    return _call(f, p.loc, p.scale_tril, q.loc, q.scale_tril)
+
+
+@register_kl(Uniform, Normal)
+def _kl_unif_normal(p, q):
+    def f(lo, hi, loc, scale):
+        import jax.numpy as jnp
+        w = hi - lo
+        # E[(x-mu)^2] for x~U[lo,hi]
+        ex2 = ((hi - loc) ** 3 - (lo - loc) ** 3) / (3 * w)
+        return (-jnp.log(w) + 0.5 * math.log(2 * math.pi)
+                + jnp.log(scale) + ex2 / (2 * scale ** 2))
+    return _call(f, p.low, p.high, q.loc, q.scale)
+
+
+@register_kl(Uniform, Gumbel)
+def _kl_unif_gumbel(p, q):
+    def f(lo, hi, loc, scale):
+        import jax.numpy as jnp
+        w = hi - lo
+        ez = ((hi + lo) / 2 - loc) / scale       # E[(x-loc)/scale]
+        # E[exp(-(x-loc)/scale)] in closed form over the box
+        eexp = (scale / w) * (jnp.exp(-(lo - loc) / scale)
+                              - jnp.exp(-(hi - loc) / scale))
+        return -jnp.log(w) + jnp.log(scale) + ez + eexp
+    return _call(f, p.low, p.high, q.loc, q.scale)
+
+
+@register_kl(Exponential, Gamma)
+def _kl_exp_gamma(p, q):
+    def f(s, a, th):
+        import jax
+        import jax.numpy as jnp
+        # E_p[ln x] = ln s - gamma for x~Exp(scale=s)
+        return (-jnp.log(s) - 1
+                - (a - 1) * (jnp.log(s) - _np.euler_gamma)
+                + jax.scipy.special.gammaln(a) + a * jnp.log(th) + s / th)
+    return _call(f, p.scale, q.shape_param, q.scale)
+
+
+def empirical_kl(p, q, n_samples=10000):
+    """Monte-Carlo KL(p||q) ≙ divergence.py `empirical_kl`."""
+    from ... import numpy as mxnp
+    x = p.sample((n_samples,))
+    return mxnp.mean(p.log_prob(x) - q.log_prob(x), axis=0)

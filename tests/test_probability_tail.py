@@ -1,0 +1,283 @@
+"""Round-5 probability tail (VERDICT-r4 Next #6): the ~12 distributions the
+repo lacked vs the reference catalog (gluon/probability/distributions/),
+each verified numerically against torch.distributions — log_prob on a value
+grid, closed-form KLs vs torch's registry (or empirical KL where torch has
+no closed form), and sample-moment sanity."""
+import numpy as np
+import pytest
+import torch
+import torch.distributions as td
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.gluon import probability as mgp
+
+
+def _np_of(x):
+    return np.asarray(x.asnumpy(), dtype=np.float64)
+
+
+def _assert_logprob_matches(ours, theirs, values, rtol=1e-4, atol=1e-5):
+    got = _np_of(ours.log_prob(mx.np.array(values.astype(np.float32))))
+    want = theirs.log_prob(torch.tensor(values)).numpy()
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+
+
+def test_gumbel():
+    d = mgp.Gumbel(loc=0.5, scale=2.0)
+    t = td.Gumbel(0.5, 2.0)
+    v = np.linspace(-3, 8, 23)
+    _assert_logprob_matches(d, t, v)
+    assert abs(float(d.mean.asnumpy() if hasattr(d.mean, "asnumpy")
+                     else d.mean) - float(t.mean)) < 1e-5
+    assert abs(float(np.asarray(d.variance)) - float(t.variance)) < 1e-4
+    assert abs(float(np.asarray(d.entropy().asnumpy()
+                                if hasattr(d.entropy(), "asnumpy")
+                                else d.entropy())) - float(t.entropy())) < 1e-4
+    s = _np_of(d.sample((20000,)))
+    assert abs(s.mean() - float(t.mean)) < 0.1
+
+
+def test_weibull():
+    d = mgp.Weibull(concentration=1.7, scale=2.5)
+    t = td.Weibull(2.5, 1.7)   # torch order: (scale, concentration)
+    v = np.linspace(0.05, 8, 21)
+    _assert_logprob_matches(d, t, v)
+    np.testing.assert_allclose(_np_of(d.mean), float(t.mean), rtol=1e-4)
+    np.testing.assert_allclose(_np_of(d.variance), float(t.variance),
+                               rtol=1e-4)
+    np.testing.assert_allclose(
+        float(np.asarray(d.entropy().asnumpy())), float(t.entropy()),
+        rtol=1e-4)
+    s = _np_of(d.sample((20000,)))
+    assert abs(s.mean() - float(t.mean)) < 0.08
+
+
+def test_pareto():
+    d = mgp.Pareto(alpha=3.0, scale=1.5)
+    t = td.Pareto(1.5, 3.0)    # torch order: (scale, alpha)
+    v = np.linspace(1.6, 9, 19)
+    _assert_logprob_matches(d, t, v)
+    np.testing.assert_allclose(_np_of(d.mean), float(t.mean), rtol=1e-4)
+    np.testing.assert_allclose(_np_of(d.variance), float(t.variance),
+                               rtol=1e-4)
+    # below-support values are impossible
+    assert _np_of(d.log_prob(mx.np.array(np.float32(1.0)))) == -np.inf
+    s = _np_of(d.sample((20000,)))
+    assert s.min() >= 1.5
+    assert abs(s.mean() - float(t.mean)) < 0.1
+
+
+def test_half_cauchy():
+    d = mgp.HalfCauchy(scale=1.3)
+    t = td.HalfCauchy(1.3)
+    v = np.linspace(0.01, 10, 20)
+    _assert_logprob_matches(d, t, v)
+    s = _np_of(d.sample((4000,)))
+    assert (s >= 0).all()
+    np.testing.assert_allclose(np.median(s), 1.3, atol=0.15)
+
+
+def test_chi2_is_gamma_df_over_2():
+    d = mgp.Chi2(df=5.0)
+    t = td.Chi2(5.0)
+    v = np.linspace(0.2, 15, 25)
+    _assert_logprob_matches(d, t, v)
+    assert float(_np_of(d.df)) == 5.0
+    np.testing.assert_allclose(_np_of(d.mean), 5.0, rtol=1e-5)
+    # Chi2 KL goes through the Gamma formula
+    q = mgp.Chi2(df=7.0)
+    got = float(_np_of(mgp.kl_divergence(d, q)))
+    want = float(td.kl_divergence(t, td.Chi2(7.0)))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_fisher_snedecor():
+    d = mgp.FisherSnedecor(df1=6.0, df2=9.0)
+    t = td.FisherSnedecor(6.0, 9.0)
+    v = np.linspace(0.1, 6, 22)
+    _assert_logprob_matches(d, t, v)
+    np.testing.assert_allclose(_np_of(d.mean), float(t.mean), rtol=1e-4)
+    np.testing.assert_allclose(_np_of(d.variance), float(t.variance),
+                               rtol=1e-4)
+    s = _np_of(d.sample((40000,)))
+    assert abs(s.mean() - float(t.mean)) < 0.1
+
+
+def test_negative_binomial():
+    d = mgp.NegativeBinomial(n=4.0, prob=0.3)
+    t = td.NegativeBinomial(4, probs=torch.tensor(0.3))
+    v = np.arange(0, 15, dtype=np.float64)
+    _assert_logprob_matches(d, t, v)
+    np.testing.assert_allclose(_np_of(d.mean), float(t.mean), rtol=1e-5)
+    np.testing.assert_allclose(_np_of(d.variance), float(t.variance),
+                               rtol=1e-5)
+    # logit construction matches the prob one
+    d2 = mgp.NegativeBinomial(n=4.0, logit=float(np.log(0.3 / 0.7)))
+    np.testing.assert_allclose(_np_of(d2.prob), 0.3, rtol=1e-5)
+    s = _np_of(d.sample((20000,)))
+    assert abs(s.mean() - float(t.mean)) < 0.12
+
+
+def test_multinomial():
+    p = np.array([0.2, 0.5, 0.3], np.float32)
+    d = mgp.Multinomial(3, prob=p, total_count=8)
+    t = td.Multinomial(8, probs=torch.tensor(p))
+    v = np.array([[2.0, 4.0, 2.0], [0.0, 8.0, 0.0], [3.0, 3.0, 2.0]])
+    got = _np_of(d.log_prob(mx.np.array(v.astype(np.float32))))
+    want = t.log_prob(torch.tensor(v.astype(np.float32))).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    s = _np_of(d.sample((2000,)))
+    assert s.shape == (2000, 3)
+    np.testing.assert_array_equal(s.sum(-1), 8.0)
+    np.testing.assert_allclose(s.mean(0), 8 * p, atol=0.25)
+
+
+def test_one_hot_categorical():
+    p = np.array([0.1, 0.6, 0.3], np.float32)
+    d = mgp.OneHotCategorical(prob=p)
+    t = td.OneHotCategorical(probs=torch.tensor(p))
+    eye = np.eye(3, dtype=np.float32)
+    got = _np_of(d.log_prob(mx.np.array(eye)))
+    want = t.log_prob(torch.tensor(eye)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    s = _np_of(d.sample((5000,)))
+    assert s.shape == (5000, 3)
+    np.testing.assert_array_equal(s.sum(-1), 1.0)
+    np.testing.assert_allclose(s.mean(0), p, atol=0.03)
+    # KL through the categorical formula, vs torch
+    q = mgp.OneHotCategorical(prob=np.array([0.3, 0.3, 0.4], np.float32))
+    tq = td.OneHotCategorical(probs=torch.tensor([0.3, 0.3, 0.4]))
+    np.testing.assert_allclose(float(_np_of(mgp.kl_divergence(d, q))),
+                               float(td.kl_divergence(t, tq)), rtol=1e-4)
+
+
+def test_relaxed_bernoulli():
+    d = mgp.RelaxedBernoulli(T=0.7, logit=0.4)
+    t = td.RelaxedBernoulli(torch.tensor(0.7), logits=torch.tensor(0.4))
+    v = np.linspace(0.02, 0.98, 25)
+    _assert_logprob_matches(d, t, v, rtol=1e-3, atol=1e-4)
+    s = _np_of(d.sample((4000,)))
+    assert ((s > 0) & (s < 1)).all()
+    want = t.sample((4000,)).numpy()
+    assert abs(s.mean() - want.mean()) < 0.05
+
+
+def test_relaxed_one_hot_categorical():
+    p = np.array([0.25, 0.45, 0.3], np.float32)
+    d = mgp.RelaxedOneHotCategorical(T=0.66, num_events=3, prob=p)
+    t = td.RelaxedOneHotCategorical(torch.tensor(0.66),
+                                    probs=torch.tensor(p))
+    rng = np.random.RandomState(0)
+    raw = rng.dirichlet([2.0, 2.0, 2.0], size=9).astype(np.float32)
+    got = _np_of(d.log_prob(mx.np.array(raw)))
+    want = t.log_prob(torch.tensor(raw)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+    s = _np_of(d.sample((3000,)))
+    np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-4)
+    want_s = t.sample((3000,)).numpy()
+    np.testing.assert_allclose(s.mean(0), want_s.mean(0), atol=0.05)
+
+
+def test_independent():
+    loc = np.zeros((4, 3), np.float32)
+    scale = np.ones((4, 3), np.float32) * 0.5
+    base = mgp.Normal(loc=loc, scale=scale)
+    d = mgp.Independent(base, 1)
+    t = td.Independent(td.Normal(torch.tensor(loc), torch.tensor(scale)), 1)
+    assert tuple(d.batch_shape) == (4,)
+    v = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+    got = _np_of(d.log_prob(mx.np.array(v)))
+    want = t.log_prob(torch.tensor(v)).numpy()
+    assert got.shape == (4,)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    ent = _np_of(d.entropy())
+    np.testing.assert_allclose(ent, t.entropy().numpy(), rtol=1e-4)
+    assert d.sample().shape == (4, 3)
+
+
+KL_CASES = [
+    ("cauchy", lambda: mgp.Cauchy(0.3, 1.2), lambda: mgp.Cauchy(-0.5, 0.8),
+     lambda: td.Cauchy(0.3, 1.2), lambda: td.Cauchy(-0.5, 0.8)),
+    ("laplace", lambda: mgp.Laplace(0.1, 2.0), lambda: mgp.Laplace(1.0, 1.0),
+     lambda: td.Laplace(0.1, 2.0), lambda: td.Laplace(1.0, 1.0)),
+    ("poisson", lambda: mgp.Poisson(3.0), lambda: mgp.Poisson(5.0),
+     lambda: td.Poisson(3.0), lambda: td.Poisson(5.0)),
+    ("geometric", lambda: mgp.Geometric(0.4), lambda: mgp.Geometric(0.7),
+     lambda: td.Geometric(0.4), lambda: td.Geometric(0.7)),
+    ("pareto", lambda: mgp.Pareto(3.0, 2.0), lambda: mgp.Pareto(2.0, 1.0),
+     lambda: td.Pareto(2.0, 3.0), lambda: td.Pareto(1.0, 2.0)),
+    ("gumbel", lambda: mgp.Gumbel(0.5, 1.5), lambda: mgp.Gumbel(-1.0, 2.0),
+     lambda: td.Gumbel(0.5, 1.5), lambda: td.Gumbel(-1.0, 2.0)),
+    ("gamma", lambda: mgp.Gamma(2.0, 1.5), lambda: mgp.Gamma(3.0, 0.5),
+     lambda: td.Gamma(2.0, 1 / 1.5), lambda: td.Gamma(3.0, 2.0)),
+    ("beta", lambda: mgp.Beta(2.0, 3.0), lambda: mgp.Beta(4.0, 1.5),
+     lambda: td.Beta(2.0, 3.0), lambda: td.Beta(4.0, 1.5)),
+    ("dirichlet",
+     lambda: mgp.Dirichlet(np.array([1.5, 2.5, 3.0], np.float32)),
+     lambda: mgp.Dirichlet(np.array([2.0, 1.0, 1.2], np.float32)),
+     lambda: td.Dirichlet(torch.tensor([1.5, 2.5, 3.0])),
+     lambda: td.Dirichlet(torch.tensor([2.0, 1.0, 1.2]))),
+    ("halfnormal", lambda: mgp.HalfNormal(0.0, 1.5),
+     lambda: mgp.HalfNormal(0.0, 0.7),
+     lambda: td.HalfNormal(1.5), lambda: td.HalfNormal(0.7)),
+    ("binomial", lambda: mgp.Binomial(6, 0.3), lambda: mgp.Binomial(6, 0.6),
+     lambda: td.Binomial(6, torch.tensor(0.3)),
+     lambda: td.Binomial(6, torch.tensor(0.6))),
+    ("uniform_normal", lambda: mgp.Uniform(-1.0, 2.0),
+     lambda: mgp.Normal(0.5, 1.5),
+     lambda: td.Uniform(-1.0, 2.0), lambda: td.Normal(0.5, 1.5)),
+    ("uniform_gumbel", lambda: mgp.Uniform(-1.0, 2.0),
+     lambda: mgp.Gumbel(0.5, 1.5),
+     lambda: td.Uniform(-1.0, 2.0), lambda: td.Gumbel(0.5, 1.5)),
+    ("exponential_gamma", lambda: mgp.Exponential(2.0),
+     lambda: mgp.Gamma(1.7, 1.4),
+     lambda: td.Exponential(0.5), lambda: td.Gamma(1.7, 1 / 1.4)),
+]
+
+
+@pytest.mark.parametrize("name,p,q,tp,tq", KL_CASES,
+                         ids=[c[0] for c in KL_CASES])
+def test_kl_matches_torch(name, p, q, tp, tq):
+    ours = float(_np_of(mgp.kl_divergence(p(), q())))
+    try:
+        want = float(td.kl_divergence(tp(), tq()))
+    except NotImplementedError:
+        want = None
+    if want is not None:
+        np.testing.assert_allclose(ours, want, rtol=1e-4, atol=1e-5)
+    # empirical cross-check regardless (catches BOTH formulas being wrong
+    # the same way only if torch is wrong too — acceptable risk)
+    emp = float(_np_of(mgp.empirical_kl(p(), q(), n_samples=60000)))
+    assert abs(ours - emp) < max(0.08, 0.12 * abs(ours))
+
+
+def test_kl_mvn():
+    rng = np.random.RandomState(3)
+    a = rng.randn(3, 3).astype(np.float32)
+    c1 = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    b = rng.randn(3, 3).astype(np.float32)
+    c2 = b @ b.T + 3 * np.eye(3, dtype=np.float32)
+    l1 = rng.randn(3).astype(np.float32)
+    l2 = rng.randn(3).astype(np.float32)
+    p = mgp.MultivariateNormal(loc=l1, cov=c1)
+    q = mgp.MultivariateNormal(loc=l2, cov=c2)
+    got = float(_np_of(mgp.kl_divergence(p, q)))
+    want = float(td.kl_divergence(
+        td.MultivariateNormal(torch.tensor(l1), torch.tensor(c1)),
+        td.MultivariateNormal(torch.tensor(l2), torch.tensor(c2))))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_catalog_count_meets_reference():
+    """Reference distributions/__init__.py exports ~30 concrete classes;
+    every one must exist here (n/a: ExponentialFamily internal base)."""
+    names = ["Normal", "Bernoulli", "Categorical", "Uniform", "Exponential",
+             "Gamma", "Poisson", "Laplace", "Beta", "Dirichlet", "StudentT",
+             "HalfNormal", "Cauchy", "Geometric", "Binomial",
+             "MultivariateNormal", "Gumbel", "Weibull", "Pareto",
+             "HalfCauchy", "Chi2", "FisherSnedecor", "NegativeBinomial",
+             "Multinomial", "OneHotCategorical", "RelaxedBernoulli",
+             "RelaxedOneHotCategorical", "Independent",
+             "TransformedDistribution"]
+    for n in names:
+        assert hasattr(mgp, n), f"missing distribution {n}"
