@@ -97,6 +97,11 @@ int MXKVStorePush(KVStoreHandle handle, int num, const int *keys,
 /* pull writes into the provided (pre-created) output arrays */
 int MXKVStorePull(KVStoreHandle handle, int num, const int *keys,
                   NDArrayHandle *outs, int priority);
+/* row-sparse pull: only the rows named by each row_ids array are
+ * guaranteed written into the paired out array (≙ c_api.h:2569) */
+int MXKVStorePullRowSparse(KVStoreHandle handle, int num, const int *keys,
+                           NDArrayHandle *outs, NDArrayHandle *row_ids,
+                           int priority);
 int MXKVStoreGetRank(KVStoreHandle handle, int *out);
 int MXKVStoreGetGroupSize(KVStoreHandle handle, int *out);
 
@@ -156,6 +161,16 @@ int MXNDArrayWaitToWrite(NDArrayHandle handle);
 int MXNDArrayGetShape64(NDArrayHandle handle, int *out_dim,
                         const int64_t **out_pdata);
 int MXNDArrayGetStorageType(NDArrayHandle handle, int *out);
+/* sparse storage group (codes: default=0, row_sparse=1, csr=2;
+ * CSR aux order indptr=0, indices=1; RSP aux indices=0) */
+int MXNDArrayCreateSparseEx(int storage_type, const int64_t *shape, int ndim,
+                            int dtype, NDArrayHandle *out);
+int MXNDArrayGetNumAux(NDArrayHandle handle, int *out);
+int MXNDArrayGetAuxType(NDArrayHandle handle, int i, int *out_type);
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, int i, NDArrayHandle *out);
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out);
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 NDArrayHandle handle_src, int i);
 int MXNDArraySave(const char *fname, uint32_t num_args, NDArrayHandle *args,
                   const char **keys);
 int MXNDArrayLoad(const char *fname, uint32_t *out_size,
@@ -246,6 +261,26 @@ int MXSymbolInferShapePartial64(
     const int **out_shape_ndim, const int64_t ***out_shape_data,
     size_t *aux_shape_size, const int **aux_shape_ndim,
     const int64_t ***aux_shape_data, int *complete);
+/* 32-bit shape-word variants (≙ reference c_api.h:1820-1876) */
+int MXSymbolInferShape(SymbolHandle sym, uint32_t num_args, const char **keys,
+                       const uint32_t *arg_ind_ptr,
+                       const uint32_t *arg_shape_data,
+                       uint32_t *in_shape_size, const uint32_t **in_shape_ndim,
+                       const uint32_t ***in_shape_data,
+                       uint32_t *out_shape_size,
+                       const uint32_t **out_shape_ndim,
+                       const uint32_t ***out_shape_data,
+                       uint32_t *aux_shape_size,
+                       const uint32_t **aux_shape_ndim,
+                       const uint32_t ***aux_shape_data, int *complete);
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, uint32_t num_args, const char **keys,
+    const uint32_t *arg_ind_ptr, const uint32_t *arg_shape_data,
+    uint32_t *in_shape_size, const uint32_t **in_shape_ndim,
+    const uint32_t ***in_shape_data, uint32_t *out_shape_size,
+    const uint32_t **out_shape_ndim, const uint32_t ***out_shape_data,
+    uint32_t *aux_shape_size, const uint32_t **aux_shape_ndim,
+    const uint32_t ***aux_shape_data, int *complete);
 int MXSymbolInferType(SymbolHandle sym, uint32_t num_args, const char **keys,
                       const int *arg_type_data, uint32_t *in_type_size,
                       const int **in_type_data, uint32_t *out_type_size,

@@ -387,7 +387,147 @@ def _capi_ndarray_wait_to_read(nd):
 
 
 def _capi_ndarray_storage_type(nd):
-    return 0   # kDefaultStorage; sparse storage unsupported by design
+    """≙ NDArrayStorageType codes (include/mxnet/ndarray.h:62):
+    default=0, row_sparse=1, csr=2."""
+    from .ndarray.sparse import BaseSparseNDArray
+    if isinstance(nd, BaseSparseNDArray):
+        return {"row_sparse": 1, "csr": 2}[nd.stype]
+    return 0
+
+
+# ---- sparse storage group (≙ c_api.h:653-1077, sparse aux access) --------
+
+def _capi_ndarray_create_sparse(storage_type, shape, dtype_code):
+    from .ndarray import sparse as _sp
+    stype = {1: "row_sparse", 2: "csr"}.get(int(storage_type))
+    if stype is None:
+        raise MXNetError(f"invalid sparse storage_type {storage_type}")
+    return _sp.zeros(stype, tuple(shape), dtype=str(_np_dtype(dtype_code)))
+
+
+def _sparse_aux_np(nd, i):
+    """Aux array i of a sparse handle. CSR order ≙ csr::kIndPtr=0,
+    csr::kIdx=1; RSP ≙ rowsparse::kIdx=0."""
+    from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+    if isinstance(nd, CSRNDArray):
+        if i == 0:
+            return nd._indptr_np
+        if i == 1:
+            return nd._indices_np
+    elif isinstance(nd, RowSparseNDArray) and i == 0:
+        return nd._indices_np
+    raise MXNetError(f"no aux array {i} on {type(nd).__name__}")
+
+
+def _capi_ndarray_num_aux(nd):
+    from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+    if isinstance(nd, CSRNDArray):
+        return 2
+    if isinstance(nd, RowSparseNDArray):
+        return 1
+    return 0
+
+
+def _capi_ndarray_aux_type(nd, i):
+    _sparse_aux_np(nd, i)      # validates the slot
+    return DTYPE_TO_CODE["int64"]
+
+
+class _HostNDArray:
+    """Host-side array handle for the C boundary: sparse aux arrays are
+    int64 by ABI contract, but the device stack (x64 disabled) would
+    silently narrow them to int32 — so aux reads stay on the host."""
+
+    def __init__(self, a):
+        self._a = _np.ascontiguousarray(a)
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    @property
+    def ndim(self):
+        return self._a.ndim
+
+    @property
+    def size(self):
+        return self._a.size
+
+    def asnumpy(self):
+        return self._a
+
+    def wait_to_read(self):
+        return self
+
+
+def _capi_ndarray_get_aux(nd, i):
+    return _HostNDArray(_sparse_aux_np(nd, i))
+
+
+def _capi_ndarray_get_data(nd):
+    from .ndarray.sparse import BaseSparseNDArray
+    if not isinstance(nd, BaseSparseNDArray):
+        raise MXNetError("GetDataNDArray expects a sparse handle")
+    return nd.data
+
+
+def _capi_ndarray_sync_copy_from_ndarray(dst, src, i):
+    """≙ MXNDArraySyncCopyFromNDArray: fill slot i of a sparse dst from a
+    dense src (i == -1 -> data, else aux i). Aux writes may resize nnz;
+    the paired data/indices slot is grown with it so the container stays
+    structurally valid between the two copies."""
+    import numpy as _onp
+
+    from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+    a = src.asnumpy() if hasattr(src, "asnumpy") else _onp.asarray(src)
+    if isinstance(dst, CSRNDArray):
+        if i == -1:
+            dst._data_np = a.astype(dst.dtype).ravel()
+            if dst._indices_np.size != dst._data_np.size:
+                dst._indices_np = _onp.resize(
+                    dst._indices_np, dst._data_np.size)
+        elif i == 0:
+            dst._indptr_np = a.astype(_onp.int64).ravel()
+        elif i == 1:
+            dst._indices_np = a.astype(_onp.int64).ravel()
+            if dst._data_np.size != dst._indices_np.size:
+                dst._data_np = _onp.resize(dst._data_np,
+                                           dst._indices_np.size)
+        else:
+            raise MXNetError(f"invalid slot {i} for csr")
+        return True
+    if isinstance(dst, RowSparseNDArray):
+        if i == -1:
+            dst._data_np = a.astype(dst.dtype).reshape(
+                (-1,) + dst.shape[1:])
+            if dst._indices_np.size != dst._data_np.shape[0]:
+                dst._indices_np = _onp.resize(
+                    dst._indices_np, dst._data_np.shape[0])
+        elif i == 0:
+            dst._indices_np = a.astype(_onp.int64).ravel()
+            if dst._data_np.shape[0] != dst._indices_np.size:
+                dst._data_np = _onp.resize(
+                    dst._data_np,
+                    (dst._indices_np.size,) + dst.shape[1:])
+        else:
+            raise MXNetError(f"invalid slot {i} for row_sparse")
+        return True
+    if i == -1:
+        from . import np as mxnp
+        dst[:] = mxnp.array(a)
+        return True
+    raise MXNetError("aux copy needs a sparse destination")
+
+
+def _capi_kv_pull_row_sparse(kv, keys, outs, row_ids, priority):
+    """≙ MXKVStorePullRowSparse (c_api.h:2569)."""
+    for k, out, rid in zip(keys, outs, row_ids):
+        kv.row_sparse_pull(k, out=out, row_ids=rid, priority=priority)
+    return True
 
 
 def _capi_ndarray_save(fname, arrays, names):

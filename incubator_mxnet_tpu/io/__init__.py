@@ -284,24 +284,28 @@ class CSVIter(NDArrayIter):
         super().__init__(data, label, batch_size, last_batch_handle="pad")
 
 
-class LibSVMIter(NDArrayIter):
-    """≙ mx.io.LibSVMIter (src/io/iter_libsvm.cc). The reference serves
-    sparse CSR batches from ZERO-BASED libsvm files; TPU has no sparse
-    storage, so rows densify into (batch, num_features) float arrays.
+class LibSVMIter(DataIter):
+    """≙ mx.io.LibSVMIter (src/io/iter_libsvm.cc): serves CSR batches from
+    ZERO-BASED libsvm files, like the reference's sparse batch loader
+    (iter_sparse_batchloader.h). batch.data[0] is a CSRNDArray (the
+    host-side sparse shim, ndarray/sparse.py) feeding `sparse.dot`'s
+    on-device kernel; pass data_stype='default' for dense rows instead.
     Out-of-range feature indices raise (a silent drop would corrupt
     training data — e.g. a 1-based file loaded as 0-based)."""
 
     def __init__(self, data_libsvm, data_shape, batch_size=1,
-                 round_batch=True, dtype="float32"):
+                 round_batch=True, dtype="float32", data_stype="csr"):
+        super().__init__(batch_size)
+        if data_stype not in ("csr", "default"):
+            raise MXNetError(f"invalid data_stype {data_stype!r}")
         num_features = int(_np.prod(data_shape))
-        rows, labels = [], []
+        vals, cols, indptr, labels = [], [], [0], []
         with open(data_libsvm) as f:
             for lineno, line in enumerate(f, 1):
                 parts = line.split()
                 if not parts:
                     continue
                 labels.append(float(parts[0]))
-                row = _np.zeros(num_features, dtype)
                 for tok in parts[1:]:
                     idx, val = tok.split(":")
                     idx = int(idx)
@@ -310,13 +314,63 @@ class LibSVMIter(NDArrayIter):
                             f"{data_libsvm}:{lineno}: feature index {idx} "
                             f"outside [0, {num_features}) — libsvm input "
                             "must be zero-based and match data_shape")
-                    row[idx] = float(val)
-                rows.append(row)
-        if not rows:
+                    cols.append(idx)
+                    vals.append(float(val))
+                indptr.append(len(cols))
+        if not labels:
             raise MXNetError(f"no examples in {data_libsvm}")
-        data = _np.stack(rows).reshape((-1,) + tuple(data_shape))
-        super().__init__(data, _np.asarray(labels, dtype), batch_size,
-                         last_batch_handle="pad")
+        from ..ndarray.sparse import CSRNDArray
+        self._csr = CSRNDArray(_np.asarray(vals, dtype),
+                               _np.asarray(cols, _np.int64),
+                               _np.asarray(indptr, _np.int64),
+                               (len(labels), num_features), dtype)
+        # built once: per-batch slicing must cost O(batch nnz), not a full
+        # O(total nnz) scipy reconstruction every getdata
+        self._scipy = self._csr.asscipy()
+        self._labels = _np.asarray(labels, dtype)
+        self._data_shape = tuple(data_shape)
+        self._stype = data_stype
+        self.num_data = len(labels)
+        self.cursor = -batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._data_shape,
+                         self._csr.dtype)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,),
+                         self._labels.dtype)]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        return end - self.num_data if end > self.num_data else 0
+
+    def _batch_rows(self):
+        idx = _np.arange(self.cursor,
+                         self.cursor + self.batch_size) % self.num_data
+        return idx
+
+    def getdata(self):
+        idx = self._batch_rows()
+        from ..ndarray.sparse import csr_matrix
+        sub = self._scipy[idx]
+        if self._stype == "default":
+            data = array(sub.toarray().reshape(
+                (self.batch_size,) + self._data_shape))
+            return [data]
+        return [csr_matrix(sub, dtype=self._csr.dtype)]
+
+    def getlabel(self):
+        return [array(self._labels[self._batch_rows()])]
 
 
 __all__ += ["CSVIter", "LibSVMIter"]

@@ -364,8 +364,14 @@ class KVStore(KVStoreBase):
             ).reshape(-1).astype(jnp.int32)
             rows = val._arr[idx]
             targets = o if isinstance(o, (list, tuple)) else [o]
+            from ..ndarray.sparse import RowSparseNDArray
             for t in targets:
-                if tuple(t.shape) == tuple(rows.shape):
+                if isinstance(t, RowSparseNDArray):
+                    # sparse out: becomes exactly the pulled row block
+                    # (≙ the reference's RSP pull filling data+indices aux)
+                    t._data_np = _np.asarray(rows).astype(t.dtype)
+                    t._indices_np = _np.asarray(idx, _np.int64)
+                elif tuple(t.shape) == tuple(rows.shape):
                     t._set_arr(rows)
                 elif tuple(t.shape) == tuple(val.shape):
                     t._set_arr(t._arr.at[idx].set(rows))

@@ -869,6 +869,56 @@ int MXNDArrayGetStorageType(NDArrayHandle handle, int *out) {
   return h_call_int("_capi_ndarray_storage_type", handle, out);
 }
 
+// ---- sparse storage group (≙ reference c_api.h:653-1077) -----------------
+
+int MXNDArrayCreateSparseEx(int storage_type, const int64_t *shape, int ndim,
+                            int dtype, NDArrayHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(
+      call_deploy("_capi_ndarray_create_sparse",
+                  tup({PyLong_FromLong(storage_type),
+                       shape_to_list(shape, ndim), PyLong_FromLong(dtype)})),
+      out);
+}
+
+int MXNDArrayGetNumAux(NDArrayHandle handle, int *out) {
+  return h_call_int("_capi_ndarray_num_aux", handle, out);
+}
+
+int MXNDArrayGetAuxType(NDArrayHandle handle, int i, int *out_type) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_int(call_deploy("_capi_ndarray_aux_type",
+                             tup({incref(handle), PyLong_FromLong(i)})),
+                 out_type);
+}
+
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, int i, NDArrayHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_ndarray_get_aux",
+                                tup({incref(handle), PyLong_FromLong(i)})),
+                    out);
+}
+
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_handle(call_deploy("_capi_ndarray_get_data",
+                                tup({incref(handle)})),
+                    out);
+}
+
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 NDArrayHandle handle_src, int i) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(
+      "_capi_ndarray_sync_copy_from_ndarray",
+      tup({incref(handle_dst), incref(handle_src), PyLong_FromLong(i)})));
+}
+
 int MXNDArraySave(const char *fname, uint32_t num_args,
                   NDArrayHandle *args, const char **keys) {
   if (!ensure_runtime()) return -1;
@@ -1373,6 +1423,98 @@ int MXSymbolInferShapePartial64(
                           out_shape_size, out_shape_ndim, out_shape_data,
                           aux_shape_size, aux_shape_ndim, aux_shape_data,
                           complete);
+}
+
+namespace {
+
+// 32-bit InferShape variants (≙ reference c_api.h:1820-1876): convert
+// uint32 shape words to the 64-bit impl and narrow the outputs into
+// dedicated thread-local buffers.
+int infer_shape_u32(SymbolHandle sym, uint32_t num_args, const char **keys,
+                    const uint32_t *arg_ind_ptr,
+                    const uint32_t *arg_shape_data, int partial,
+                    uint32_t *in_shape_size, const uint32_t **in_shape_ndim,
+                    const uint32_t ***in_shape_data,
+                    uint32_t *out_shape_size, const uint32_t **out_shape_ndim,
+                    const uint32_t ***out_shape_data,
+                    uint32_t *aux_shape_size, const uint32_t **aux_shape_ndim,
+                    const uint32_t ***aux_shape_data, int *complete) {
+  // num_args == 0 legally comes with NULL pointers (≙ reference, which
+  // never dereferences ind_ptr past num_args)
+  std::vector<int64_t> ind(num_args + 1, 0);
+  if (num_args)
+    std::copy(arg_ind_ptr, arg_ind_ptr + num_args + 1, ind.begin());
+  std::vector<int64_t> dat(arg_shape_data,
+                           arg_shape_data + (num_args ? ind[num_args] : 0));
+  size_t sz[3];
+  const int *nd64[3];
+  const int64_t **dt64[3];
+  int rc = infer_shape_impl(sym, num_args, keys, ind.data(), dat.data(),
+                            partial, &sz[0], &nd64[0], &dt64[0], &sz[1],
+                            &nd64[1], &dt64[1], &sz[2], &nd64[2], &dt64[2],
+                            complete);
+  if (rc != 0) return rc;
+  thread_local std::vector<uint32_t> ndims32[3];
+  thread_local std::vector<std::vector<uint32_t>> rows32[3];
+  thread_local std::vector<const uint32_t *> ptrs32[3];
+  uint32_t *sizes[3] = {in_shape_size, out_shape_size, aux_shape_size};
+  const uint32_t **ndims[3] = {in_shape_ndim, out_shape_ndim,
+                               aux_shape_ndim};
+  const uint32_t ***datas[3] = {in_shape_data, out_shape_data,
+                                aux_shape_data};
+  for (int g = 0; g < 3; ++g) {
+    ndims32[g].clear();
+    rows32[g].clear();
+    ptrs32[g].clear();
+    for (size_t i = 0; i < sz[g]; ++i) {
+      int nd = nd64[g][i];
+      ndims32[g].push_back(nd < 0 ? 0 : static_cast<uint32_t>(nd));
+      std::vector<uint32_t> row;
+      for (int j = 0; j < nd; ++j)
+        row.push_back(static_cast<uint32_t>(dt64[g][i][j]));
+      rows32[g].push_back(std::move(row));
+    }
+    for (auto &row : rows32[g]) ptrs32[g].push_back(row.data());
+    *sizes[g] = static_cast<uint32_t>(sz[g]);
+    *ndims[g] = ndims32[g].data();
+    *datas[g] = ptrs32[g].data();
+  }
+  return 0;
+}
+
+}  // namespace
+
+int MXSymbolInferShape(SymbolHandle sym, uint32_t num_args, const char **keys,
+                       const uint32_t *arg_ind_ptr,
+                       const uint32_t *arg_shape_data,
+                       uint32_t *in_shape_size, const uint32_t **in_shape_ndim,
+                       const uint32_t ***in_shape_data,
+                       uint32_t *out_shape_size,
+                       const uint32_t **out_shape_ndim,
+                       const uint32_t ***out_shape_data,
+                       uint32_t *aux_shape_size,
+                       const uint32_t **aux_shape_ndim,
+                       const uint32_t ***aux_shape_data, int *complete) {
+  return infer_shape_u32(sym, num_args, keys, arg_ind_ptr, arg_shape_data, 0,
+                         in_shape_size, in_shape_ndim, in_shape_data,
+                         out_shape_size, out_shape_ndim, out_shape_data,
+                         aux_shape_size, aux_shape_ndim, aux_shape_data,
+                         complete);
+}
+
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, uint32_t num_args, const char **keys,
+    const uint32_t *arg_ind_ptr, const uint32_t *arg_shape_data,
+    uint32_t *in_shape_size, const uint32_t **in_shape_ndim,
+    const uint32_t ***in_shape_data, uint32_t *out_shape_size,
+    const uint32_t **out_shape_ndim, const uint32_t ***out_shape_data,
+    uint32_t *aux_shape_size, const uint32_t **aux_shape_ndim,
+    const uint32_t ***aux_shape_data, int *complete) {
+  return infer_shape_u32(sym, num_args, keys, arg_ind_ptr, arg_shape_data, 1,
+                         in_shape_size, in_shape_ndim, in_shape_data,
+                         out_shape_size, out_shape_ndim, out_shape_data,
+                         aux_shape_size, aux_shape_ndim, aux_shape_data,
+                         complete);
 }
 
 int MXSymbolInferType(SymbolHandle sym, uint32_t num_args, const char **keys,
@@ -1981,6 +2123,18 @@ int MXKVStoreBroadcast(KVStoreHandle handle, int num, const int *keys,
                        int priority) {
   return kv_two_val_call("_capi_kv_broadcast", handle, num, keys, vals,
                          outs, priority);
+}
+
+int MXKVStorePullRowSparse(KVStoreHandle handle, int num, const int *keys,
+                           NDArrayHandle *outs, NDArrayHandle *row_ids,
+                           int priority) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(
+      "_capi_kv_pull_row_sparse",
+      tup({incref(handle), keys_to_list(num, keys),
+           handles_to_list(num, outs), handles_to_list(num, row_ids),
+           PyLong_FromLong(priority)})));
 }
 
 int MXKVStoreSetGradientCompression(KVStoreHandle handle, uint32_t num_params,

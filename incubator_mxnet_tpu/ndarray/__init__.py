@@ -753,6 +753,11 @@ def __getattr__(name):
     """mx.nd.<op> delegates to the numpy frontend: the reference's legacy nd
     namespace (hundreds of generated wrappers, python/mxnet/ndarray/) shares
     one implementation with mx.np here."""
+    if name == "sparse":   # mx.nd.sparse (≙ python/mxnet/ndarray/sparse.py)
+        import importlib
+        mod = importlib.import_module(".sparse", __name__)
+        globals()[name] = mod
+        return mod
     from .. import numpy as _mxnp
     fn = getattr(_mxnp, name, None)
     if fn is None:

@@ -302,3 +302,86 @@ def test_every_declared_abi_function_exports():
     lib = ctypes.CDLL(build_capi())
     missing = [n for n in set(names) if not hasattr(lib, n)]
     assert not missing, f"declared but not exported: {sorted(missing)}"
+
+
+def test_c_api_sparse_group():
+    """Round-5 sparse C API tail (≙ reference c_api.h:653-1077 + :2569):
+    create a CSR handle, fill data/aux slots via SyncCopyFromNDArray,
+    read them back, and row-sparse-pull from a kvstore."""
+    lib = ctypes.CDLL(build_capi())
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    assert lib.MXTPUInit() == 0, lib.MXGetLastError()
+
+    def make_dense(values, shape, code):
+        ctype = {0: ctypes.c_float, 6: ctypes.c_int64}[code]
+        flat = (ctype * len(values))(*values)
+        shp = (ctypes.c_int64 * len(shape))(*shape)
+        h = ctypes.c_void_p()
+        assert lib.MXNDArrayCreate(flat, shp, len(shape), code,
+                                   ctypes.byref(h)) == 0, lib.MXGetLastError()
+        return h
+
+    def read_floats(h, n):
+        buf = (ctypes.c_float * n)()
+        assert lib.MXNDArraySyncCopyToCPU(h, buf, 4 * n) == 0, \
+            lib.MXGetLastError()
+        return list(buf)
+
+    # create an empty CSR (3, 4) float32 and check the storage metadata
+    shape = (ctypes.c_int64 * 2)(3, 4)
+    csr = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateSparseEx(2, shape, 2, 0,
+                                       ctypes.byref(csr)) == 0, \
+        lib.MXGetLastError()
+    stype = ctypes.c_int()
+    assert lib.MXNDArrayGetStorageType(csr, ctypes.byref(stype)) == 0
+    assert stype.value == 2          # kCSRStorage
+    naux = ctypes.c_int()
+    assert lib.MXNDArrayGetNumAux(csr, ctypes.byref(naux)) == 0
+    assert naux.value == 2
+    at = ctypes.c_int()
+    assert lib.MXNDArrayGetAuxType(csr, 0, ctypes.byref(at)) == 0
+    assert at.value == 6             # int64
+
+    # fill: rows [[0,5,0,0],[0,0,0,6],[7,0,0,0]]
+    indptr = make_dense([0, 1, 2, 3], (4,), 6)
+    indices = make_dense([1, 3, 0], (3,), 6)
+    data = make_dense([5.0, 6.0, 7.0], (3,), 0)
+    assert lib.MXNDArraySyncCopyFromNDArray(csr, indices, 1) == 0, \
+        lib.MXGetLastError()
+    assert lib.MXNDArraySyncCopyFromNDArray(csr, indptr, 0) == 0
+    assert lib.MXNDArraySyncCopyFromNDArray(csr, data, -1) == 0
+
+    # read back through the aux/data accessors
+    d = ctypes.c_void_p()
+    assert lib.MXNDArrayGetDataNDArray(csr, ctypes.byref(d)) == 0
+    assert read_floats(d, 3) == [5.0, 6.0, 7.0]
+    aux = ctypes.c_void_p()
+    assert lib.MXNDArrayGetAuxNDArray(csr, 1, ctypes.byref(aux)) == 0
+    buf = (ctypes.c_int64 * 3)()
+    assert lib.MXNDArraySyncCopyToCPU(aux, buf, 8 * 3) == 0
+    assert list(buf) == [1, 3, 0]
+    # an out-of-range aux slot errors instead of corrupting
+    bad = ctypes.c_void_p()
+    assert lib.MXNDArrayGetAuxNDArray(csr, 7, ctypes.byref(bad)) == -1
+    for h in (indptr, indices, data, d, aux):
+        lib.MXNDArrayFree(h)
+    lib.MXNDArrayFree(csr)
+
+    # row-sparse pull through the ABI
+    kv = ctypes.c_void_p()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    init_val = make_dense([float(i) for i in range(8)], (4, 2), 0)
+    keys = (ctypes.c_int * 1)(9)
+    vals = (ctypes.c_void_p * 1)(init_val)
+    assert lib.MXKVStoreInit(kv, 1, keys, vals) == 0, lib.MXGetLastError()
+    out = make_dense([0.0] * 4, (2, 2), 0)
+    rows = make_dense([1, 3], (2,), 6)
+    outs = (ctypes.c_void_p * 1)(out)
+    rids = (ctypes.c_void_p * 1)(rows)
+    assert lib.MXKVStorePullRowSparse(kv, 1, keys, outs, rids, 0) == 0, \
+        lib.MXGetLastError()
+    assert read_floats(out, 4) == [2.0, 3.0, 6.0, 7.0]
+    for h in (init_val, out, rows):
+        lib.MXNDArrayFree(h)
+    lib.MXKVStoreFree(kv)
