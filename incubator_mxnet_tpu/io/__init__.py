@@ -331,6 +331,9 @@ class LibSVMIter(DataIter):
         self._data_shape = tuple(data_shape)
         self._stype = data_stype
         self.num_data = len(labels)
+        # round_batch=True (reference default): last partial batch wraps
+        # with its pad count exposed; False: the partial tail is discarded
+        self._round_batch = bool(round_batch)
         self.cursor = -batch_size
 
     @property
@@ -348,11 +351,15 @@ class LibSVMIter(DataIter):
 
     def iter_next(self):
         self.cursor += self.batch_size
+        if not self._round_batch:
+            return self.cursor + self.batch_size <= self.num_data
         return self.cursor < self.num_data
 
     def getpad(self):
         end = self.cursor + self.batch_size
-        return end - self.num_data if end > self.num_data else 0
+        if self._round_batch and end > self.num_data:
+            return end - self.num_data
+        return 0
 
     def _batch_rows(self):
         idx = _np.arange(self.cursor,

@@ -368,9 +368,18 @@ class KVStore(KVStoreBase):
             for t in targets:
                 if isinstance(t, RowSparseNDArray):
                     # sparse out: becomes exactly the pulled row block
-                    # (≙ the reference's RSP pull filling data+indices aux)
-                    t._data_np = _np.asarray(rows).astype(t.dtype)
-                    t._indices_np = _np.asarray(idx, _np.int64)
+                    # (≙ the reference's RSP pull filling data+indices aux).
+                    # Validate now — a mismatched container would only blow
+                    # up much later in asnumpy; duplicate ids are uniqued
+                    # (the reference guarantees unique RSP rows)
+                    if tuple(t.shape) != tuple(val.shape):
+                        raise MXNetError(
+                            f"row_sparse_pull out shape {tuple(t.shape)} "
+                            f"does not match value {tuple(val.shape)}")
+                    uniq = _np.unique(_np.asarray(idx, _np.int64))
+                    t._data_np = _np.asarray(
+                        val._arr[uniq]).astype(t.dtype)
+                    t._indices_np = uniq
                 elif tuple(t.shape) == tuple(rows.shape):
                     t._set_arr(rows)
                 elif tuple(t.shape) == tuple(val.shape):

@@ -99,7 +99,17 @@ class BaseSparseNDArray:
             other[:] = self.todense()
             return other
         if isinstance(other, BaseSparseNDArray):
-            return cast_storage(self, other.stype)
+            if other.shape != self.shape:
+                raise MXNetError(
+                    f"copyto shape mismatch: {self.shape} -> {other.shape}")
+            # in place, like the dense branch: callers rely on the side
+            # effect (≙ reference copyto semantics)
+            src = cast_storage(self, other.stype)
+            other._data_np = src._data_np
+            other._indices_np = src._indices_np
+            if hasattr(src, "_indptr_np"):
+                other._indptr_np = src._indptr_np
+            return other
         raise MXNetError(f"cannot copyto {type(other).__name__}")
 
     def copy(self):
@@ -311,8 +321,12 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         return out.astype(dtype, copy=False) if dtype else out
     if sp.issparse(arg1):
         m = arg1.tocsr()
-        return CSRNDArray(m.data, m.indices, m.indptr,
-                          shape or m.shape, dtype or m.dtype)
+        if shape is not None and tuple(shape) != m.shape:
+            raise MXNetError(
+                f"shape {tuple(shape)} does not match the source's "
+                f"{m.shape}")
+        return CSRNDArray(m.data, m.indices, m.indptr, m.shape,
+                          dtype or m.dtype)
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
         if shape is None:
@@ -330,9 +344,13 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 2:   # a plain shape tuple
         return zeros("csr", arg1, dtype=dtype)
     dense = arg1.asnumpy() if hasattr(arg1, "asnumpy") else _np.asarray(arg1)
+    if shape is not None and tuple(shape) != dense.shape:
+        raise MXNetError(
+            f"shape {tuple(shape)} does not match the source's "
+            f"{dense.shape}")
     m = sp.csr_matrix(dense)
-    return CSRNDArray(m.data, m.indices, m.indptr,
-                      shape or dense.shape, dtype or dense.dtype)
+    return CSRNDArray(m.data, m.indices, m.indptr, dense.shape,
+                      dtype or dense.dtype)
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
@@ -446,11 +464,12 @@ def dot(lhs, rhs, transpose_a=False):
     data_nd = lhs.data
     gather_nd = _wrap(_np.asarray(gather_ids))
     seg_nd = _wrap(_np.asarray(seg_ids))
+    vec = rhs.ndim == 1    # matvec: (m,n) x (n,) -> (m,)
 
     def f(vals, gat, seg, dense):
         import jax
         # out[s] = sum_{k: seg[k]=s} vals[k] * dense[gat[k]]
-        contrib = vals[:, None] * dense[gat]
+        contrib = vals * dense[gat] if vec else vals[:, None] * dense[gat]
         return jax.ops.segment_sum(contrib, seg, num_segments=num_seg)
 
     return invoke(f, (data_nd, gather_nd, seg_nd, rhs),

@@ -135,6 +135,32 @@ def test_dot_forward_matches_scipy():
         sparse.dot(z, mx.np.array(w)).asnumpy(), 0.0)
 
 
+def test_dot_matvec_and_copyto_and_shape_guard():
+    a = _rand_dense(5, 7)
+    c = sparse.csr_matrix(a)
+    v = np.random.RandomState(8).rand(7).astype(np.float32)
+    got = sparse.dot(c, mx.np.array(v))
+    assert got.shape == (5,)
+    np.testing.assert_allclose(got.asnumpy(), a @ v, rtol=1e-5)
+    # copyto fills the destination in place
+    dst = sparse.zeros("csr", (5, 7))
+    c.copyto(dst)
+    np.testing.assert_allclose(dst.asnumpy(), a)
+    # a contradicting explicit shape raises at the call site
+    with pytest.raises(mx.MXNetError):
+        sparse.csr_matrix(a, shape=(9, 7))
+
+
+def test_libsvm_round_batch_false_discards_tail(tmp_path):
+    from incubator_mxnet_tpu.io import LibSVMIter
+    f = tmp_path / "t.libsvm"
+    f.write_text("1 0:1.0\n0 1:1.0\n1 2:1.0\n")
+    it = LibSVMIter(str(f), (4,), batch_size=2, round_batch=False)
+    batches = list(it)
+    assert len(batches) == 1      # tail example dropped, nothing wrapped
+    assert batches[0].pad == 0
+
+
 def test_dot_backward_through_tape():
     a = _rand_dense(6, 8)
     c = sparse.csr_matrix(a)

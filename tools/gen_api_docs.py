@@ -25,6 +25,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 MODULES = [
     ("ndarray", "incubator_mxnet_tpu.ndarray", "NDArray core"),
+    ("ndarray.sparse", "incubator_mxnet_tpu.ndarray.sparse",
+     "Sparse storage shim (CSR/RSP + device CSR dot)"),
     ("np", "incubator_mxnet_tpu.numpy", "mx.np — NumPy-compatible ops"),
     ("npx", "incubator_mxnet_tpu.numpy_extension",
      "mx.npx — NN / extension ops"),
