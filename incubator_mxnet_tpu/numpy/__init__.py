@@ -186,6 +186,42 @@ for _name in _missing:
         __all__.append(_name)
 
 
+# dtype-metadata queries must NOT route through invoke (their results are
+# dtypes/bools, not arrays — the array wrapper mangles them; caught by the
+# r5 op sweep)
+def _dtype_meta_override(name):
+    def fn(*args, **kwargs):
+        jnp = _jnp()
+        conv = [a._arr if isinstance(a, NDArray) else a for a in args]
+        return getattr(jnp, name)(*conv, **kwargs)
+    fn.__name__ = name
+    fn.__doc__ = f"TPU-native equivalent of np.{name} (metadata query)."
+    return fn
+
+
+for _name in ("promote_types", "result_type", "can_cast"):
+    globals()[_name] = _dtype_meta_override(_name)
+    register_op("np." + _name, globals()[_name])
+
+
+# numpy's put_along_axis mutates in place; jax arrays are immutable, so the
+# wrapper computes functionally (inplace=False) and writes back into the
+# NDArray argument, returning the result as well
+_pala_functional = _make_wrapper("put_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis):
+    out = _pala_functional(arr, indices, values, axis, inplace=False)
+    if isinstance(arr, NDArray):
+        arr[:] = out
+    return out
+
+
+put_along_axis.__doc__ = ("TPU-native equivalent of np.put_along_axis "
+                          "(functional core + in-place write-back).")
+register_op("np.put_along_axis", put_along_axis)
+
+
 def fix(x):
     """Round toward zero (mx.np.fix; jnp.fix is deprecated — trunc is the
     same operation)."""
