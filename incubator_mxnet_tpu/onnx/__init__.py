@@ -21,23 +21,39 @@ import numpy as _np
 from ..base import MXNetError
 from . import _proto as P
 
-__all__ = ["export_model", "get_model_metadata"]
+__all__ = ["export_model", "export_detection_model", "get_model_metadata"]
 
 
 class _Graph:
+    """Node accumulator with a subgraph stack: Loop/If bodies push a new
+    node list; constants always land in the TOP-LEVEL initializers (ONNX
+    scoping makes outer initializers visible inside subgraphs, so bodies
+    stay initializer-free and consts dedupe across bodies)."""
+
     def __init__(self):
-        self.nodes = []
+        self._stack = [[]]
         self.inits = {}        # name -> ndarray (mutable: pre-transforms)
         self.counter = 0
         self.shapes = {}       # name -> (shape, dtype)
+
+    @property
+    def nodes(self):
+        return self._stack[-1]
+
+    def begin_subgraph(self):
+        self._stack.append([])
+
+    def end_subgraph(self):
+        return self._stack.pop()
 
     def fresh(self, hint="t"):
         self.counter += 1
         return f"{hint}_{self.counter}"
 
     def add(self, op, inputs, outputs, **attrs):
+        self.counter += 1
         self.nodes.append(P.node(op, inputs, outputs,
-                                 name=f"{op}_{len(self.nodes)}", **attrs))
+                                 name=f"{op}_{self.counter}", **attrs))
 
     def const(self, arr, hint="c"):
         name = self.fresh(hint)
@@ -376,25 +392,19 @@ def _trace(net_or_fn, x_raw):
     return jax.make_jaxpr(fn)(x_raw)
 
 
-def export_model(net, example_input, path, input_name="data",
-                 output_name="output", producer_doc=""):
-    """Export a Gluon block (or raw jax fn) to an ONNX (opset 13) file.
-
-    ≙ mx.onnx.export_model (python/mxnet/onnx/__init__.py): the inference
-    graph with baked parameters. Returns `path`.
-    """
-    import jax
-    from ..ndarray import NDArray
-
-    x_raw = example_input._arr if isinstance(example_input, NDArray) \
-        else example_input
-    closed = _trace(net, x_raw)
+def _build_graph(net, x_raw, input_name, output_names, closed=None):
+    """Trace + translate into a _Graph. Returns (g, final output names,
+    output (shape, dtype) pairs). Multi-output jaxprs are supported; the
+    caller serializes (and may append post-processing nodes first).
+    `closed` lets a caller reuse an existing trace (export_model counts
+    outputs first — no second make_jaxpr)."""
+    if closed is None:
+        closed = _trace(net, x_raw)
     jaxpr, consts = closed.jaxpr, closed.consts
 
     g = _Graph()
     names = {}
-    const_cache = {}   # id(const value) -> initializer name (dedupe:
-    # scan unrolling re-binds body consts every iteration)
+    const_cache = {}   # id(const value) -> initializer name
 
     def cached_const(cval, hint):
         key = id(cval)
@@ -421,73 +431,92 @@ def export_model(net, example_input, path, input_name="data",
         names[cv] = cached_const(cval, "param")
 
     tr = _Translator(g)
-    MAX_UNROLL = 512
 
-    def unroll_scan(eqn, env):
-        """lax.scan -> static unroll (length is a trace constant): inline
-        the body once per step, slice xs rows in, stack ys rows out."""
+    def emit_loop(eqn, env):
+        """lax.scan -> ONNX Loop (VERDICT-r4 Next #7: a real dynamic loop,
+        not a static unroll). Body subgraph: (iter, cond, carry...) ->
+        (cond, carry..., per-step ys). xs stay OUTER names; the body
+        gathers row `iter` (scalar Gather drops the axis — exactly the
+        scan slice). Loop concatenates per-step ys along a new axis 0,
+        which is precisely lax.scan's ys stacking."""
         pr = eqn.params
-        closed = pr["jaxpr"]
-        bj = closed.jaxpr
+        body_closed = pr["jaxpr"]
+        bj = body_closed.jaxpr
         n_const, n_carry = pr["num_consts"], pr["num_carry"]
         length, reverse = int(pr["length"]), bool(pr["reverse"])
-        if length > MAX_UNROLL:
-            raise MXNetError(
-                f"scan of length {length} exceeds the unroll bound "
-                f"({MAX_UNROLL}); not exportable")
         const_names = [name_of(env, v) for v in eqn.invars[:n_const]]
-        carry = [name_of(env, v)
-                 for v in eqn.invars[n_const:n_const + n_carry]]
+        carry_in = [name_of(env, v)
+                    for v in eqn.invars[n_const:n_const + n_carry]]
         xs_vars = eqn.invars[n_const + n_carry:]
         xs_names = [name_of(env, v) for v in xs_vars]
-        n_ys = len(bj.outvars) - n_carry
-        ys_rows = [[None] * length for _ in range(n_ys)]
-        # loop-invariant consts hoisted: only the t/t+1 slice bounds vary
-        axes0 = g.const(_np.asarray([0], _np.int64), "axes")
-        step1 = g.const(_np.asarray([1], _np.int64), "steps")
-        xs_shape_consts = [
-            g.const(_np.asarray(tuple(xv.aval.shape)[1:] or (1,),
-                                _np.int64), "shape")
-            for xv in xs_vars]
-        ys_shape_consts = [
-            g.const(_np.asarray((1,) + tuple(yv.aval.shape), _np.int64),
-                    "shape")
-            for yv in bj.outvars[n_carry:]]
-        steps = range(length - 1, -1, -1) if reverse else range(length)
-        for t in steps:
-            env_t = {}
-            for cv, cval in zip(bj.constvars, closed.consts):
-                env_t[cv] = cached_const(cval, "scan_c")
-            for bv, nm in zip(bj.invars[:n_const], const_names):
-                env_t[bv] = nm
-            for bv, nm in zip(bj.invars[n_const:n_const + n_carry], carry):
-                env_t[bv] = nm
-            for bv, nm, shp_c in zip(bj.invars[n_const + n_carry:],
-                                     xs_names, xs_shape_consts):
-                row = g.fresh("xs_row")
-                g.add("Slice",
-                      [nm, g.const(_np.asarray([t], _np.int64)),
-                       g.const(_np.asarray([t + 1], _np.int64)),
-                       axes0, step1], [row])
-                sq = g.fresh("x_t")
-                g.add("Reshape", [row, shp_c], [sq])
-                env_t[bv] = sq
-            walk(bj, env_t)
-            carry = [name_of(env_t, v) for v in bj.outvars[:n_carry]]
-            for i, yv in enumerate(bj.outvars[n_carry:]):
-                ynm = name_of(env_t, yv)
-                un = g.fresh("y_row")
-                g.add("Reshape", [ynm, ys_shape_consts[i]], [un])
-                ys_rows[i][t] = un
-        for ov, nm in zip(eqn.outvars[:n_carry], carry):
+
+        g.begin_subgraph()
+        iter_name = g.fresh("iter")
+        cond_in = g.fresh("cond_in")
+        env_b = {}
+        for cv, cval in zip(bj.constvars, body_closed.consts):
+            env_b[cv] = cached_const(cval, "scan_c")
+        for bv, nm in zip(bj.invars[:n_const], const_names):
+            env_b[bv] = nm            # outer-scope name, visible in body
+        carry_formals = []
+        for bv in bj.invars[n_const:n_const + n_carry]:
+            nm = g.fresh("carry")
+            carry_formals.append(nm)
+            env_b[bv] = nm
+        idx_name = iter_name
+        if reverse:
+            idx_name = g.fresh("rev_iter")
+            g.add("Sub",
+                  [g.const(_np.asarray(length - 1, _np.int64), "revN"),
+                   iter_name], [idx_name])
+        for bv, nm in zip(bj.invars[n_const + n_carry:], xs_names):
+            row = g.fresh("x_t")
+            g.add("Gather", [nm, idx_name], [row], axis=0)
+            env_b[bv] = row
+        walk(bj, env_b)
+        cond_out = g.fresh("cond_out")
+        g.add("Identity", [cond_in], [cond_out])
+        body_outs, body_out_infos = [cond_out], [
+            P.value_info(cond_out, _np.bool_, ())]
+        for bv in bj.outvars:
+            nm = g.fresh("body_out")
+            g.add("Identity", [name_of(env_b, bv)], [nm])
+            shape, dt = _aval_of(bv)
+            body_outs.append(nm)
+            body_out_infos.append(P.value_info(nm, dt, shape))
+        body_nodes = g.end_subgraph()
+
+        body_in_infos = [P.value_info(iter_name, _np.int64, ()),
+                         P.value_info(cond_in, _np.bool_, ())]
+        for nm, bv in zip(carry_formals,
+                          bj.invars[n_const:n_const + n_carry]):
+            shape, dt = _aval_of(bv)
+            body_in_infos.append(P.value_info(nm, dt, shape))
+        body_graph = P.graph(body_nodes, "loop_body", inputs=body_in_infos,
+                             outputs=body_out_infos, initializers=[])
+
+        trip = g.const(_np.asarray(length, _np.int64), "trip")
+        cond0 = g.const(_np.asarray(True, _np.bool_), "cond")
+        loop_outs = []
+        for ov in eqn.outvars:
+            nm = g.fresh("loop_out")
             env[ov] = nm
-        for i, ov in enumerate(eqn.outvars[n_carry:]):
-            stacked = g.fresh("ys")
-            if length == 1:
-                g.add("Identity", [ys_rows[i][0]], [stacked])
-            else:
-                g.add("Concat", ys_rows[i], [stacked], axis=0)
-            env[ov] = stacked
+            loop_outs.append(nm)
+        g.add("Loop", [trip, cond0] + carry_in, loop_outs,
+              body=P.SubGraph(body_graph))
+        if reverse:
+            # scan(reverse=True) emits ys in ORIGINAL index order; the
+            # loop ran reversed, so flip the stacked ys back
+            for k, ov in enumerate(eqn.outvars[n_carry:]):
+                flipped = g.fresh("ys")
+                g.add("Slice",
+                      [loop_outs[n_carry + k],
+                       g.const(_np.asarray([-1], _np.int64)),
+                       g.const(_np.asarray([-(2 ** 62)], _np.int64)),
+                       g.const(_np.asarray([0], _np.int64)),
+                       g.const(_np.asarray([-1], _np.int64))],
+                      [flipped])
+                env[ov] = flipped
 
     def walk(jx, env):
         for eqn in jx.eqns:
@@ -510,7 +539,7 @@ def export_model(net, example_input, path, input_name="data",
                     env[souter] = name_of(sub, sinner)
                 continue
             if eqn.primitive.name == "scan":
-                unroll_scan(eqn, env)
+                emit_loop(eqn, env)
                 continue
             ins = [name_of(env, v) for v in eqn.invars]
             outs = []
@@ -522,17 +551,27 @@ def export_model(net, example_input, path, input_name="data",
 
     walk(jaxpr, names)
 
-    out_var = jaxpr.outvars[0]
-    final = name_of(names, out_var)
-    g.add("Identity", [final], [output_name])
+    out_vars = jaxpr.outvars
+    if len(output_names) != len(out_vars):
+        raise MXNetError(
+            f"graph has {len(out_vars)} outputs; {len(output_names)} "
+            "names given")
+    out_infos = []
+    for ov, out_name in zip(out_vars, output_names):
+        g.add("Identity", [name_of(names, ov)], [out_name])
+        out_infos.append(_aval_of(ov))
+    return g, list(output_names), out_infos
 
+
+def _serialize(g, x_raw, input_name, output_names, out_infos,
+               path, producer_doc):
     in_shape, in_dtype = tuple(x_raw.shape), _canon_dtype(x_raw.dtype)
-    out_shape, out_dtype = _aval_of(out_var)
     inits = [P.tensor(n, a) for n, a in g.inits.items()]
     gb = P.graph(
         g.nodes, "incubator_mxnet_tpu_graph",
         inputs=[P.value_info(input_name, in_dtype, in_shape)],
-        outputs=[P.value_info(output_name, out_dtype, out_shape)],
+        outputs=[P.value_info(nm, dt, shape)
+                 for nm, (shape, dt) in zip(output_names, out_infos)],
         initializers=inits)
     blob = P.model(gb, doc=producer_doc)
     with open(path, "wb") as f:
@@ -540,9 +579,107 @@ def export_model(net, example_input, path, input_name="data",
     return path
 
 
+def export_model(net, example_input, path, input_name="data",
+                 output_name="output", producer_doc=""):
+    """Export a Gluon block (or raw jax fn) to an ONNX (opset 13) file.
+
+    ≙ mx.onnx.export_model (python/mxnet/onnx/__init__.py): the inference
+    graph with baked parameters. `lax.scan` exports as a true ONNX Loop
+    (dynamic, no unroll). Multi-output nets name outputs
+    output, output1, output2, ... Returns `path`.
+    """
+    from ..ndarray import NDArray
+
+    x_raw = example_input._arr if isinstance(example_input, NDArray) \
+        else example_input
+    closed = _trace(net, x_raw)
+    n_out = len(closed.jaxpr.outvars)
+    names = [output_name] + [f"{output_name}{i}" for i in range(1, n_out)]
+    g, out_names, out_infos = _build_graph(net, x_raw, input_name, names,
+                                           closed=closed)
+    return _serialize(g, x_raw, input_name, out_names, out_infos, path,
+                      producer_doc)
+
+
+def export_detection_model(net, example_input, path, input_name="data",
+                           nms_threshold=0.45, score_threshold=0.01,
+                           max_output_boxes_per_class=400,
+                           variances=(0.1, 0.1, 0.2, 0.2),
+                           clip=True, producer_doc=""):
+    """Export a detection net (SSD-preset contract: forward(x) ->
+    (anchors, cls_preds, loc_preds)) as decode + ONNX NonMaxSuppression
+    (VERDICT-r4 Next #7: multibox ops -> ONNX NMS ops; ≙ the reference's
+    multibox_detection translators in _op_translations_opset13.py).
+
+    Graph outputs:
+      boxes   (B, A, 4)  decoded corner boxes
+      scores  (B, C, A)  per-foreground-class probabilities
+      selected (N, 3) int64 [batch, class, box] rows from NMS
+    Consumers assemble detections by gathering `selected` into
+    boxes/scores (exactly what ONNX detection runtimes do).
+
+    `max_output_boxes_per_class` is ONNX NMS's post-suppression cap per
+    class; the reference's `nms_topk` (a PRE-suppression candidate cap)
+    has no ONNX equivalent — use `score_threshold` for that cut."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ndarray import NDArray
+
+    x_raw = example_input._arr if isinstance(example_input, NDArray) \
+        else example_input
+
+    def decode(x):
+        from .. import autograd
+        from ..ndarray import _wrap
+        with autograd._Scope(recording=False, training=False):
+            anchors, cls_preds, loc_preds = net(_wrap(x))
+        anc = anchors._arr.reshape(-1, 4)
+        cp = cls_preds._arr
+        lp = loc_preds._arr.reshape(cp.shape[0], -1, 4)
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        ax = (anc[:, 0] + anc[:, 2]) * 0.5
+        ay = (anc[:, 1] + anc[:, 3]) * 0.5
+        ox = lp[..., 0] * variances[0] * aw + ax
+        oy = lp[..., 1] * variances[1] * ah + ay
+        ow = jnp.exp(lp[..., 2] * variances[2]) * aw / 2
+        oh = jnp.exp(lp[..., 3] * variances[3]) * ah / 2
+        boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        probs = jnp.exp(cp - jnp.max(cp, axis=-1, keepdims=True))
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+        fg = probs[..., 1:]                                # drop background
+        # multibox_detection semantics: each anchor belongs only to its
+        # best foreground class — mask the rest so ONNX NMS (which scores
+        # every anchor in every class row) selects the same set
+        mask = jax.nn.one_hot(jnp.argmax(fg, axis=-1), fg.shape[-1],
+                              dtype=fg.dtype)
+        scores = jnp.transpose(fg * mask, (0, 2, 1))       # (B, C, A)
+        return boxes, scores
+
+    g, out_names, out_infos = _build_graph(decode, x_raw, input_name,
+                                           ["boxes", "scores"])
+    sel = "selected"
+    g.add("NonMaxSuppression",
+          ["boxes", "scores",
+           g.const(_np.asarray(max_output_boxes_per_class, _np.int64),
+                   "max_per_class"),
+           g.const(_np.asarray(nms_threshold, _np.float32), "iou_thr"),
+           g.const(_np.asarray(score_threshold, _np.float32), "score_thr")],
+          [sel])
+    out_names.append(sel)
+    out_infos.append(((None, 3), _np.dtype(_np.int64)))  # dim_param rows
+    return _serialize(g, x_raw, input_name, out_names, out_infos, path,
+                      producer_doc)
+
+
 def get_model_metadata(path):
-    """Input/output summary of an exported file (cheap structural parse)."""
+    """Input/output summary of an exported file (cheap structural parse).
+    Lists EVERY input/output (multi-output graphs included)."""
     from ._runtime import load_graph
     gr = load_graph(path)
-    return {"input_tensor_data": [(gr.input_name, gr.input_shape)],
-            "output_tensor_data": [(gr.output_name, gr.output_shape)]}
+    return {"input_tensor_data": list(zip(gr.input_names, gr.input_shapes)),
+            "output_tensor_data": list(zip(gr.output_names,
+                                           gr.output_shapes))}

@@ -37,8 +37,13 @@ DT = {
 }
 
 # AttributeProto.AttributeType
-AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR = 1, 2, 3, 4
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR, AT_GRAPH = 1, 2, 3, 4, 5
 AT_FLOATS, AT_INTS, AT_STRINGS = 6, 7, 8
+
+
+class SubGraph(bytes):
+    """Marker: attribute value that is an encoded GraphProto (Loop/If
+    bodies). AttributeProto field g=6, type AT_GRAPH."""
 
 
 def _varint(n):
@@ -78,11 +83,12 @@ def f_msg(field, msg_bytes):
 
 def tensor(name, arr):
     """TensorProto with raw_data."""
-    arr = np.ascontiguousarray(arr)
-    if arr.dtype not in DT:
-        raise TypeError(f"unsupported ONNX dtype {arr.dtype}")
+    shape = np.shape(arr)          # BEFORE ascontiguousarray: it promotes
+    arr = np.ascontiguousarray(arr)  # 0-d to 1-d, which would corrupt
+    if arr.dtype not in DT:          # scalar tensors (Loop trip counts,
+        raise TypeError(f"unsupported ONNX dtype {arr.dtype}")  # Gather idx)
     b = b""
-    for d in arr.shape:
+    for d in shape:
         b += f_int(1, d)
     b += f_int(2, DT[arr.dtype])
     b += f_bytes(8, name)
@@ -93,7 +99,9 @@ def tensor(name, arr):
 def attr(name, value):
     """AttributeProto from a python value (int/float/str/list/ndarray)."""
     b = f_bytes(1, name)
-    if isinstance(value, bool):
+    if isinstance(value, SubGraph):
+        b += f_msg(6, bytes(value)) + f_int(20, AT_GRAPH)
+    elif isinstance(value, bool):
         b += f_int(3, int(value)) + f_int(20, AT_INT)
     elif isinstance(value, int):
         b += f_int(3, value) + f_int(20, AT_INT)
@@ -134,9 +142,14 @@ def node(op_type, inputs, outputs, name="", **attrs):
 
 
 def value_info(name, dtype, shape):
+    """A None (or string) dim becomes dim_param — an ONNX symbolic
+    dimension (e.g. NonMaxSuppression's dynamic row count)."""
     dims = b""
-    for d in shape:
-        dims += f_msg(1, f_int(1, int(d)))
+    for k, d in enumerate(shape):
+        if d is None or isinstance(d, str):
+            dims += f_msg(1, f_bytes(2, d or f"dyn_{k}"))
+        else:
+            dims += f_msg(1, f_int(1, int(d)))
     tt = f_int(1, DT[np.dtype(dtype)]) + f_msg(2, dims)
     tp = f_msg(1, tt)
     return f_bytes(1, name) + f_msg(2, tp)

@@ -87,6 +87,8 @@ def _parse_attr(buf):
             val = v.decode()
         elif f == 5:
             val = _parse_tensor(v)[1]
+        elif f == 6:                 # g: nested GraphProto (Loop/If body)
+            val = _parse_graph(v)
         elif f == 7:
             floats.append(float(v))
         elif f == 8:
@@ -119,7 +121,7 @@ def _parse_node(buf):
 
 
 def _parse_value_info(buf):
-    name, shape = "", []
+    name, shape, dtype = "", [], None
     for f, w, v in _fields(buf):
         if f == 1:
             name = v.decode()
@@ -127,17 +129,53 @@ def _parse_value_info(buf):
             for f2, _, v2 in _fields(v):
                 if f2 == 1:                      # tensor_type
                     for f3, _, v3 in _fields(v2):
-                        if f3 == 2:              # shape
+                        if f3 == 1:              # elem_type
+                            dtype = _NP_OF_DT.get(v3)
+                        elif f3 == 2:            # shape
                             for f4, _, v4 in _fields(v3):
                                 if f4 == 1:      # dim
+                                    got = None
                                     for f5, _, v5 in _fields(v4):
-                                        if f5 == 1:
-                                            shape.append(v5)
-    return name, shape
+                                        if f5 == 1:          # dim_value
+                                            got = v5
+                                        elif f5 == 2:        # dim_param
+                                            got = None
+                                    shape.append(got)
+    return name, shape, dtype
 
 
 class Graph:
     pass
+
+
+def _parse_graph(graph_buf):
+    g = Graph()
+    g.nodes, g.inits = [], {}
+    g.input_names, g.output_names = [], []
+    g.input_shapes, g.output_shapes = [], []
+    g.input_dtypes, g.output_dtypes = [], []
+    for f_, w, v in _fields(graph_buf):
+        if f_ == 1:
+            g.nodes.append(_parse_node(v))
+        elif f_ == 5:
+            name, arr = _parse_tensor(v)
+            g.inits[name] = arr
+        elif f_ == 11:
+            nm, shp, dt = _parse_value_info(v)
+            g.input_names.append(nm)
+            g.input_shapes.append(shp)
+            g.input_dtypes.append(dt)
+        elif f_ == 12:
+            nm, shp, dt = _parse_value_info(v)
+            g.output_names.append(nm)
+            g.output_shapes.append(shp)
+            g.output_dtypes.append(dt)
+    # single-input/-output convenience views (the historical API)
+    g.input_name = g.input_names[0] if g.input_names else None
+    g.input_shape = g.input_shapes[0] if g.input_shapes else None
+    g.output_name = g.output_names[0] if g.output_names else None
+    g.output_shape = g.output_shapes[0] if g.output_shapes else None
+    return g
 
 
 def load_graph(path):
@@ -149,21 +187,7 @@ def load_graph(path):
             graph_buf = v
     if graph_buf is None:
         raise MXNetError("no GraphProto in file")
-    g = Graph()
-    g.nodes, g.inits = [], {}
-    g.input_name = g.output_name = None
-    g.input_shape = g.output_shape = None
-    for f_, w, v in _fields(graph_buf):
-        if f_ == 1:
-            g.nodes.append(_parse_node(v))
-        elif f_ == 5:
-            name, arr = _parse_tensor(v)
-            g.inits[name] = arr
-        elif f_ == 11:
-            g.input_name, g.input_shape = _parse_value_info(v)
-        elif f_ == 12:
-            g.output_name, g.output_shape = _parse_value_info(v)
-    return g
+    return _parse_graph(graph_buf)
 
 
 # ---------------------------------------------------------------------------
@@ -228,15 +252,48 @@ def _pool(x, attrs, kind):
 _erf = _np.vectorize(math.erf, otypes=[_np.float32])
 
 
-def run(path_or_graph, inputs):
-    """Execute the graph on a dict {input_name: ndarray}; returns outputs."""
-    g = (path_or_graph if isinstance(path_or_graph, Graph)
-         else load_graph(path_or_graph))
-    env = dict(g.inits)
-    env.update(inputs)
+def _nms_numpy(boxes, scores, max_per_class, iou_thr, score_thr):
+    """ONNX NonMaxSuppression (center_point_box=0): returns selected
+    (num, 3) int64 rows [batch, class, box]."""
+    sel = []
+    B, C, A = scores.shape
+    for b in range(B):
+        for c in range(C):
+            s = scores[b, c]
+            order = [int(i) for i in _np.argsort(-s, kind="stable")
+                     if s[i] > score_thr]
+            kept = []
+            for i in order:
+                if max_per_class >= 0 and len(kept) >= max_per_class:
+                    break
+                y1, x1, y2, x2 = boxes[b, i]
+                # ONNX boxes are [y1, x1, y2, x2] with either corner order
+                yy1, yy2 = min(y1, y2), max(y1, y2)
+                xx1, xx2 = min(x1, x2), max(x1, x2)
+                ok = True
+                for j in kept:
+                    by1, bx1, by2, bx2 = boxes[b, j]
+                    byy1, byy2 = min(by1, by2), max(by1, by2)
+                    bxx1, bxx2 = min(bx1, bx2), max(bx1, bx2)
+                    iw = min(yy2, byy2) - max(yy1, byy1)
+                    ih = min(xx2, bxx2) - max(xx1, bxx1)
+                    inter = max(iw, 0.0) * max(ih, 0.0)
+                    union = ((yy2 - yy1) * (xx2 - xx1)
+                             + (byy2 - byy1) * (bxx2 - bxx1) - inter)
+                    if union > 0 and inter / union > iou_thr:
+                        ok = False
+                        break
+                if ok:
+                    kept.append(i)
+            sel.extend([b, c, k] for k in kept)
+    return _np.asarray(sel, _np.int64).reshape(-1, 3)
 
+
+def _run_nodes(g, env):
+    """Interpret a node list in `env` (mutated). Loop bodies recurse with
+    an inner scope that can read outer names (ONNX scoping)."""
     for nd in g.nodes:
-        i = [env[k] for k in nd.inputs]
+        i = [env[k] if k else None for k in nd.inputs]   # "" = absent opt
         a = nd.attrs
         op = nd.op
         if op == "Add":
@@ -353,8 +410,94 @@ def run(path_or_graph, inputs):
             o = _np.logical_and(i[0], i[1])
         elif op == "Not":
             o = _np.logical_not(i[0])
+        elif op == "Clip":
+            lo = i[1] if len(i) > 1 else -_np.inf
+            hi = i[2] if len(i) > 2 else _np.inf
+            o = _np.clip(i[0], lo, hi)
+        elif op == "Softmax":
+            ax = int(a.get("axis", -1))
+            e = _np.exp(i[0] - i[0].max(axis=ax, keepdims=True))
+            o = e / e.sum(axis=ax, keepdims=True)
+        elif op == "Unsqueeze":
+            axes = [int(x) for x in _np.atleast_1d(i[1])]
+            o = i[0]
+            for ax in sorted(axes):
+                o = _np.expand_dims(o, ax)
+        elif op == "Squeeze":
+            axes = tuple(int(x) for x in _np.atleast_1d(i[1])) \
+                if len(i) > 1 else None
+            o = _np.squeeze(i[0], axis=axes)
+        elif op == "TopK":
+            k = int(_np.atleast_1d(i[1])[0])
+            ax = int(a.get("axis", -1))
+            largest = int(a.get("largest", 1))
+            idx = _np.argsort(-i[0] if largest else i[0], axis=ax,
+                              kind="stable")
+            idx = _np.take(idx, range(k), axis=ax)
+            vals = _np.take_along_axis(i[0], idx, axis=ax)
+            env[nd.outputs[0]] = vals
+            env[nd.outputs[1]] = idx.astype(_np.int64)
+            continue
+        elif op == "NonMaxSuppression":
+            max_pc = int(_np.atleast_1d(i[2])[0]) if len(i) > 2 else -1
+            iou_thr = float(_np.atleast_1d(i[3])[0]) if len(i) > 3 else 0.0
+            sc_thr = float(_np.atleast_1d(i[4])[0]) if len(i) > 4 \
+                else -_np.inf
+            o = _nms_numpy(_np.asarray(i[0], _np.float32),
+                           _np.asarray(i[1], _np.float32),
+                           max_pc, iou_thr, sc_thr)
+        elif op == "Loop":
+            trip = int(_np.atleast_1d(i[0])[0])
+            cond = bool(_np.atleast_1d(i[1])[0]) if nd.inputs[1] else True
+            carries = list(i[2:])
+            body = a["body"]
+            n_carry = len(carries)
+            n_scan = len(body.output_names) - 1 - n_carry
+            ys = [[] for _ in range(n_scan)]
+            for t in range(trip):
+                if not cond:
+                    break
+                benv = dict(env)      # outer names visible (ONNX scoping)
+                benv.update(body.inits)
+                benv[body.input_names[0]] = _np.asarray(t, _np.int64)
+                benv[body.input_names[1]] = _np.asarray(cond, _np.bool_)
+                for nm, val in zip(body.input_names[2:], carries):
+                    benv[nm] = val
+                _run_nodes(body, benv)
+                cond = bool(_np.atleast_1d(benv[body.output_names[0]])[0])
+                carries = [benv[nm] for nm in body.output_names[
+                    1:1 + n_carry]]
+                for s, nm in enumerate(body.output_names[1 + n_carry:]):
+                    ys[s].append(benv[nm])
+            stacked = []
+            for s, y in enumerate(ys):
+                if y:
+                    stacked.append(_np.stack(y, axis=0))
+                else:
+                    # zero-trip Loop: empty scan output with the body's
+                    # declared per-step shape/dtype
+                    shp = body.output_shapes[1 + n_carry + s] or []
+                    dt = body.output_dtypes[1 + n_carry + s] or _np.float32
+                    stacked.append(_np.zeros(
+                        (0,) + tuple(int(d or 0) for d in shp), dt))
+            outs = carries + stacked
+            for out_name, val in zip(nd.outputs, outs):
+                env[out_name] = val
+            continue
         else:
             raise MXNetError(f"evaluator: unsupported op {op}")
         for out_name in nd.outputs:
             env[out_name] = o
-    return env[g.output_name]
+    return env
+
+
+def run(path_or_graph, inputs):
+    """Execute the graph on a dict {input_name: ndarray}; returns the
+    single output (historical API) or a tuple for multi-output graphs."""
+    g = (path_or_graph if isinstance(path_or_graph, Graph)
+         else load_graph(path_or_graph))
+    env = dict(g.inits)
+    env.update(inputs)
+    _run_nodes(g, env)
+    outs = tuple(env[nm] for nm in g.output_names)
+    return outs[0] if len(outs) == 1 else outs

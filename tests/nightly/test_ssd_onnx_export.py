@@ -1,0 +1,28 @@
+"""Nightly: the REAL SSD-300/VGG16 preset exports through
+export_detection_model — full backbone trace, decode arithmetic, and an
+ONNX NonMaxSuppression node — and the file is structurally valid
+(loadable, one NMS node, three outputs). Numeric round-trip runs on the
+tiny-SSD graph in tests/test_onnx_export.py; evaluating VGG16 at 300x300
+through the numpy conv is too slow for CI."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import onnx as mxonnx
+from incubator_mxnet_tpu.gluon.model_zoo import detection
+from incubator_mxnet_tpu.onnx import _runtime
+
+
+def test_ssd300_exports_with_nms(tmp_path):
+    net = detection.ssd_300_vgg16(classes=20)
+    net.initialize()
+    x = mx.np.array(np.random.RandomState(0).rand(1, 3, 300, 300)
+                    .astype(np.float32))
+    net(x)   # resolve shapes
+    path = str(tmp_path / "ssd300.onnx")
+    mxonnx.export_detection_model(net, x, path)
+    g = _runtime.load_graph(path)
+    assert sum(1 for n in g.nodes if n.op == "NonMaxSuppression") == 1
+    assert g.output_names == ["boxes", "scores", "selected"]
+    assert any(n.op == "Conv" for n in g.nodes)
+    # 8732 anchors is the SSD-300 signature; boxes output carries it
+    assert tuple(g.output_shapes[0]) == (1, 8732, 4)
