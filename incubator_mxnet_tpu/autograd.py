@@ -188,6 +188,15 @@ class Node:
                               self._primals(create_graph) + tuple(cts),
                               name=f"backward_{self.name}", multi_out=True,
                               key=kk)
+        if self.vjp_fn is None:
+            # fn AND vjp_fn gone: this node was severed by a previous
+            # backward (_sever_nodes). Surface the cause instead of a
+            # cryptic NoneType crash deep in the engine.
+            raise MXNetError(
+                f"array produced by {self.name!r} belongs to a computation "
+                "graph already consumed by an earlier backward(); recompute "
+                "it inside the current record block or detach() it before "
+                "reuse")
         with _Scope(recording=False):
             # residual-capturing vjp closures are one-shot: keep them out of
             # the bulking caches (key=False) — identity-keying them would

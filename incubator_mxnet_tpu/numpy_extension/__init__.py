@@ -91,6 +91,77 @@ pick = _make_nn("pick")
 topk = _make_nn("topk")
 sequence_mask = _make_nn("sequence_mask")
 embedding = _make_nn("embedding")
+
+
+def rnn(data, parameters, state, state_cell=None, mode="lstm",
+        state_size=None, num_layers=1, bidirectional=False, p=0.0,
+        state_outputs=False):
+    """Fused multi-layer (bi)RNN on a FLAT parameter vector (≙ the
+    reference's `_npx.rnn` fused op, src/operator/rnn.cc:1 /
+    python/mxnet/numpy_extension/_op.py:847 — VERDICT-r4 Next #10).
+
+    data (T, N, C) time-major; `parameters` is the reference layout:
+    all W_i2h/W_h2h gate blocks layer-major with direction inner, then
+    all b_i2h/b_h2h pairs in the same order. Gate order LSTM [i,f,g,o],
+    GRU [r,z,n] (reference/cuDNN convention). `state` (L*D, N, H) and,
+    for LSTM, `state_cell` likewise. Returns `out`, or
+    (out, h_n[, c_n]) when state_outputs=True."""
+    if state_size is None:
+        raise MXNetError("state_size is required")
+    gates = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}
+    if mode not in gates:
+        raise MXNetError(f"unknown rnn mode {mode!r}")
+    G = gates[mode]
+    H, L = int(state_size), int(num_layers)
+    D = 2 if bidirectional else 1
+    C = int(data.shape[-1])
+    training = _autograd.is_training()
+    key = _grandom.next_key() if (p > 0 and training) else None
+
+    arrs = [_as_nd(data), _as_nd(parameters), _as_nd(state)]
+    if mode == "lstm":
+        if state_cell is None:
+            raise MXNetError("lstm needs state_cell")
+        arrs.append(_as_nd(state_cell))
+
+    def run(x, flat, h0, *maybe_c):
+        off = 0
+
+        def take(n, shape):
+            nonlocal off
+            w = flat[off:off + n].reshape(shape)
+            off += n
+            return w
+
+        params = {}
+        for layer in range(L):
+            insz = C if layer == 0 else H * D
+            for d in range(D):
+                params[(layer, d)] = {
+                    "wx": take(G * H * insz, (G * H, insz)),
+                    "wh": take(G * H * H, (G * H, H))}
+        for layer in range(L):
+            for d in range(D):
+                params[(layer, d)]["bx"] = take(G * H, (G * H,))
+                params[(layer, d)]["bh"] = take(G * H, (G * H,))
+        if off != flat.shape[0]:
+            # ≙ the reference op's parameter-size CHECK (rnn.cc): a
+            # mismatched layout must not silently misalign every block
+            raise MXNetError(
+                f"parameters has {flat.shape[0]} elements; the "
+                f"{mode} L={L} D={D} H={H} C={C} layout needs {off}")
+        st = (h0,) + tuple(maybe_c)
+        out, new_state = _nn.rnn(x, params, st, mode=mode, num_layers=L,
+                                 bidirectional=(D == 2), dropout_rate=p,
+                                 key=key, training=training)
+        return (out,) + tuple(new_state)
+
+    res = invoke(run, tuple(arrs), name="rnn_fused", multi_out=True)
+    return tuple(res) if state_outputs else res[0]
+
+
+register_op("npx.rnn", rnn)
+__all__.append("rnn")
 scaled_dot_product_attention = _make_nn("scaled_dot_product_attention")
 
 
@@ -237,12 +308,18 @@ def bilinear_resize2d(data, height, width, layout="NCHW"):
 
 def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
                    steps=(-1.0, -1.0), offsets=(0.5, 0.5), layout="NCHW"):
-    """≙ _npx_multibox_prior (src/operator/contrib/multibox_prior.cc)."""
+    """≙ _npx_multibox_prior (src/operator/contrib/multibox_prior.cc).
+
+    Anchors depend only on the feature map's SHAPE, and the reference op
+    has no backward — so `data` is detached before dispatch. Taping it
+    (pre-r5 behavior) left anchors holding a tape node that a later
+    backward severed: the usual compute-anchors-once-reuse-every-step
+    pattern then crashed on the second iteration."""
     from ..ops import contrib as _contrib
     return invoke(functools.partial(
         _contrib.multibox_prior, sizes=tuple(sizes), ratios=tuple(ratios),
         clip=clip, steps=tuple(steps), offsets=tuple(offsets),
-        layout=layout), (_as_nd(data),), name="multibox_prior")
+        layout=layout), (_as_nd(data).detach(),), name="multibox_prior")
 
 
 def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,

@@ -1,0 +1,87 @@
+"""npx.rnn — the fused flat-parameter RNN op (≙ _npx.rnn,
+src/operator/rnn.cc), verified weight-for-weight against torch.nn.LSTM /
+GRU / RNN, which share the reference's gate orders (LSTM [i,f,g,o],
+GRU [r,z,n]) and flat-layout conventions."""
+import numpy as np
+import pytest
+import torch
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import npx
+
+T, N, C, H = 5, 3, 6, 4
+
+
+def _flat_params(tmod, num_layers, bidirectional):
+    """Pack a torch RNN module's weights into the reference flat layout:
+    all W_i2h/W_h2h blocks layer-major (direction inner), then all
+    b_i2h/b_h2h pairs."""
+    D = 2 if bidirectional else 1
+    ws, bs = [], []
+    for layer in range(num_layers):
+        for d in range(D):
+            sfx = f"_l{layer}" + ("_reverse" if d else "")
+            ws.append(getattr(tmod, f"weight_ih{sfx}").detach().numpy()
+                      .ravel())
+            ws.append(getattr(tmod, f"weight_hh{sfx}").detach().numpy()
+                      .ravel())
+    for layer in range(num_layers):
+        for d in range(D):
+            sfx = f"_l{layer}" + ("_reverse" if d else "")
+            bs.append(getattr(tmod, f"bias_ih{sfx}").detach().numpy())
+            bs.append(getattr(tmod, f"bias_hh{sfx}").detach().numpy())
+    return np.concatenate(ws + bs).astype(np.float32)
+
+
+@pytest.mark.parametrize("mode,L,bi", [
+    ("lstm", 1, False), ("lstm", 2, False), ("lstm", 2, True),
+    ("gru", 2, True), ("rnn_tanh", 1, False), ("rnn_relu", 2, False),
+])
+def test_npx_rnn_matches_torch(mode, L, bi):
+    torch.manual_seed(3)
+    D = 2 if bi else 1
+    kind = {"lstm": torch.nn.LSTM, "gru": torch.nn.GRU,
+            "rnn_tanh": lambda *a, **k: torch.nn.RNN(
+                *a, nonlinearity="tanh", **k),
+            "rnn_relu": lambda *a, **k: torch.nn.RNN(
+                *a, nonlinearity="relu", **k)}[mode]
+    tmod = kind(C, H, num_layers=L, bidirectional=bi)
+    x = np.random.RandomState(0).randn(T, N, C).astype(np.float32)
+    h0 = np.random.RandomState(1).randn(L * D, N, H).astype(np.float32)
+    c0 = np.random.RandomState(2).randn(L * D, N, H).astype(np.float32)
+
+    with torch.no_grad():
+        if mode == "lstm":
+            want, (hn, cn) = tmod(torch.tensor(x),
+                                  (torch.tensor(h0), torch.tensor(c0)))
+        else:
+            want, hn = tmod(torch.tensor(x), torch.tensor(h0))
+
+    flat = _flat_params(tmod, L, bi)
+    out = npx.rnn(mx.np.array(x), mx.np.array(flat), mx.np.array(h0),
+                  state_cell=mx.np.array(c0) if mode == "lstm" else None,
+                  mode=mode, state_size=H, num_layers=L, bidirectional=bi,
+                  state_outputs=True)
+    got, got_h = out[0].asnumpy(), out[1].asnumpy()
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got_h, hn.numpy(), rtol=1e-4, atol=1e-5)
+    if mode == "lstm":
+        np.testing.assert_allclose(out[2].asnumpy(), cn.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_npx_rnn_differentiable():
+    rng = np.random.RandomState(7)
+    tmod = torch.nn.LSTM(C, H)
+    flat = mx.np.array(_flat_params(tmod, 1, False))
+    flat.attach_grad()
+    x = mx.np.array(rng.randn(T, N, C).astype(np.float32))
+    h0 = mx.np.array(np.zeros((1, N, H), np.float32))
+    c0 = mx.np.array(np.zeros((1, N, H), np.float32))
+    with mx.autograd.record():
+        out = npx.rnn(x, flat, h0, state_cell=c0, mode="lstm",
+                      state_size=H, num_layers=1)
+        L = (out ** 2).sum()
+    L.backward()
+    g = flat.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).max() > 0

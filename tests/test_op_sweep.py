@@ -402,6 +402,8 @@ spec("npx.stop_gradient", lambda: [F()], ref=lambda x: x)
 EXEMPT = {
     "np.asarray": "identity on NDArray input; constructor covered by "
                   "test_numpy_ops creation tests",
+    "npx.rnn": "fused multi-layer RNN — verified against torch.nn.LSTM/"
+               "GRU weight-for-weight in test_npx_rnn.py",
 }
 
 
