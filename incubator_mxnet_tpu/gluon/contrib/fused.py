@@ -133,7 +133,7 @@ class FusedTrainStep:
     trace constant, like the reference's update_on_kvstore batching)."""
 
     def __init__(self, net, fn, optimizer, clip_global_norm=None,
-                 steps_per_call=1):
+                 steps_per_call=1, remat=None):
         from ... import optimizer as opt_mod
         optimizer = opt_mod.create(optimizer)
         # same eligibility rules as the multi-tensor fused path
@@ -161,6 +161,14 @@ class FusedTrainStep:
         self._fn = fn
         self._opt = optimizer
         self._clip = clip_global_norm
+        # remat: trade FLOPs for HBM traffic on the backward's saved
+        # residuals — None (XLA default), "full" (recompute the whole
+        # forward; near-zero residual traffic), "dots" (save matmul
+        # outputs, recompute elementwise/conv chains). Which wins is
+        # hardware-bound: bench.py A/Bs them on the attached chip.
+        if remat not in (None, "full", "dots"):
+            raise MXNetError(f"unknown remat policy {remat!r}")
+        self._remat = remat
         self._K = int(steps_per_call)
         if self._K < 1:
             raise MXNetError("steps_per_call must be >= 1")
@@ -247,6 +255,17 @@ class FusedTrainStep:
                         p.data()._data = old
                 return loss_raw, (extras_raw, aux_bufs)
 
+            # prevent_cse=False: we are always under jit (and under scan
+            # for K>1), where the CSE-prevention barriers are unnecessary
+            # and would slow the remat'd program (jax.checkpoint docs)
+            if self._remat == "full":
+                loss_of = jax.checkpoint(
+                    loss_of, policy=jax.checkpoint_policies.nothing_saveable,
+                    prevent_cse=False)
+            elif self._remat == "dots":
+                loss_of = jax.checkpoint(
+                    loss_of, policy=jax.checkpoint_policies.dots_saveable,
+                    prevent_cse=False)
             (loss, (extras, aux_bufs)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(list(train_bufs))
 

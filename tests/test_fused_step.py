@@ -144,3 +144,48 @@ def test_fused_step_honors_param_multipliers():
             np.testing.assert_allclose(pb.data().asnumpy(),
                                        init.data().asnumpy(), rtol=0,
                                        atol=0, err_msg=name)
+
+
+def test_remat_policies_numerically_identical():
+    """remat trades FLOPs for residual HBM traffic — it must never change
+    the math. All three policies produce identical losses and weights;
+    bench.py A/Bs their THROUGHPUT on the attached chip."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu import optimizer as opt_mod
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.gluon.contrib import FusedTrainStep
+
+    def make():
+        mx.seed(5)
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, 3, padding=1, layout="NHWC"),
+                nn.BatchNorm(axis=3), nn.Activation("relu"),
+                nn.Flatten(), nn.Dense(10))
+        net.initialize()
+        net.hybridize()
+        return net
+
+    x = mx.np.array(np.random.RandomState(0).rand(4, 8, 8, 3)
+                    .astype(np.float32))
+    y = mx.np.array(np.random.RandomState(1).randint(0, 10, (4,)))
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    outs = {}
+    for remat in (None, "full", "dots"):
+        net = make()
+        net(x)
+        step = FusedTrainStep(net, lambda n, a, b: L(n(a), b).sum(),
+                              opt_mod.create("sgd", learning_rate=0.1),
+                              remat=remat)
+        for _ in range(3):
+            loss = step(x, y)
+        outs[remat] = (float(loss.asnumpy()),
+                       list(net.collect_params().values())[0]
+                       .data().asnumpy())
+    for k in ("full", "dots"):
+        np.testing.assert_allclose(outs[k][0], outs[None][0], rtol=1e-5)
+        np.testing.assert_allclose(outs[k][1], outs[None][1],
+                                   rtol=1e-5, atol=1e-6)
+    with pytest.raises(mx.MXNetError):
+        FusedTrainStep(make(), lambda n, a, b: L(n(a), b).sum(),
+                       opt_mod.create("sgd"), remat="bogus")
