@@ -102,6 +102,9 @@ int MXKVStorePull(KVStoreHandle handle, int num, const int *keys,
 int MXKVStorePullRowSparse(KVStoreHandle handle, int num, const int *keys,
                            NDArrayHandle *outs, NDArrayHandle *row_ids,
                            int priority);
+int MXKVStorePullRowSparseEx(KVStoreHandle handle, uint32_t num,
+                             const char **keys, NDArrayHandle *outs,
+                             NDArrayHandle *row_ids, int priority);
 int MXKVStoreGetRank(KVStoreHandle handle, int *out);
 int MXKVStoreGetGroupSize(KVStoreHandle handle, int *out);
 
@@ -171,6 +174,12 @@ int MXNDArrayGetAuxNDArray(NDArrayHandle handle, int i, NDArrayHandle *out);
 int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out);
 int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
                                  NDArrayHandle handle_src, int i);
+int MXNDArrayCreateSparseEx64(int storage_type, const int64_t *shape,
+                              int ndim, int dtype, NDArrayHandle *out);
+int MXNDArrayGetAuxType64(NDArrayHandle handle, int64_t i, int *out_type);
+int MXNDArrayGetAuxNDArray64(NDArrayHandle handle, int64_t i,
+                             NDArrayHandle *out);
+int MXNDArraySyncCheckFormat(NDArrayHandle handle, int full_check);
 int MXNDArraySave(const char *fname, uint32_t num_args, NDArrayHandle *args,
                   const char **keys);
 int MXNDArrayLoad(const char *fname, uint32_t *out_size,
@@ -286,6 +295,13 @@ int MXSymbolInferType(SymbolHandle sym, uint32_t num_args, const char **keys,
                       const int **in_type_data, uint32_t *out_type_size,
                       const int **out_type_data, uint32_t *aux_type_size,
                       const int **aux_type_data, int *complete);
+int MXSymbolInferTypePartial(SymbolHandle sym, uint32_t num_args,
+                             const char **keys, const int *arg_type_data,
+                             uint32_t *in_type_size, const int **in_type_data,
+                             uint32_t *out_type_size,
+                             const int **out_type_data,
+                             uint32_t *aux_type_size,
+                             const int **aux_type_data, int *complete);
 
 /* ---- data iterators / datasets / batchify ----------------------------- */
 int MXListDataIters(uint32_t *out_size, DataIterHandle **out_array);
@@ -422,10 +438,21 @@ int MXKVStorePushEx(KVStoreHandle handle, uint32_t num, const char **keys,
                     NDArrayHandle *vals, int priority);
 int MXKVStorePullEx(KVStoreHandle handle, uint32_t num, const char **keys,
                     NDArrayHandle *outs, int priority);
+int MXKVStorePushPullEx(KVStoreHandle handle, uint32_t num,
+                        const char **keys, NDArrayHandle *vals,
+                        NDArrayHandle *outs, int priority);
+int MXKVStoreBroadcastEx(KVStoreHandle handle, uint32_t num,
+                         const char **keys, NDArrayHandle *vals,
+                         NDArrayHandle *outs, int priority);
 /* updater runs synchronously during push; recv/local handles are borrowed
  * and valid only for the duration of the callback */
 int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
                         void *updater_handle);
+typedef void (*MXKVStoreStrUpdater)(const char *key, NDArrayHandle recv,
+                                    NDArrayHandle local, void *handle);
+int MXKVStoreSetUpdaterEx(KVStoreHandle handle, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void *updater_handle);
 int MXKVStoreIsWorkerNode(int *ret);
 int MXKVStoreIsServerNode(int *ret);
 int MXKVStoreIsSchedulerNode(int *ret);

@@ -524,9 +524,17 @@ def _capi_ndarray_sync_copy_from_ndarray(dst, src, i):
 
 
 def _capi_kv_pull_row_sparse(kv, keys, outs, row_ids, priority):
-    """≙ MXKVStorePullRowSparse (c_api.h:2569)."""
+    """≙ MXKVStorePullRowSparse (c_api.h:2569); keys may be int or str."""
     for k, out, rid in zip(keys, outs, row_ids):
         kv.row_sparse_pull(k, out=out, row_ids=rid, priority=priority)
+    return True
+
+
+def _capi_ndarray_check_format(nd, full_check):
+    """≙ MXNDArraySyncCheckFormat: sparse handles validate their aux
+    invariants; dense handles are trivially valid."""
+    if hasattr(nd, "check_format"):
+        nd.check_format(full_check=bool(full_check))
     return True
 
 
@@ -1182,14 +1190,16 @@ def _capi_kv_barrier(kv):
 
 
 def _capi_kv_pushpull(kv, keys, invals, outvals, priority):
+    # keys arrive as ints from the int-keyed entry points and as strs
+    # from the Ex variants; the store keeps each key space verbatim
     for k, vin, vout in zip(keys, invals, outvals):
-        kv.pushpull(int(k), vin, out=vout, priority=priority)
+        kv.pushpull(k, vin, out=vout, priority=priority)
     return True
 
 
 def _capi_kv_broadcast(kv, keys, invals, outvals, priority):
     for k, vin, vout in zip(keys, invals, outvals):
-        kv.broadcast(int(k), vin, out=vout, priority=priority)
+        kv.broadcast(k, vin, out=vout, priority=priority)
     return True
 
 
@@ -1231,6 +1241,34 @@ def _capi_kv_set_updater(kv, fn_addr, handle_addr):
     def updater(key, recv, local):
         cb(int(key), id(recv), id(local),
            ctypes.c_void_p(handle_addr or 0))
+
+    kv.set_updater(updater)
+    return True
+
+
+def _capi_kv_set_updater_ex(kv, int_addr, str_addr, handle_addr):
+    """≙ MXKVStoreSetUpdaterEx: int keys dispatch to the int callback,
+    string keys to the string callback (const char* first arg)."""
+    import ctypes
+    ICB = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                           ctypes.c_void_p, ctypes.c_void_p)
+    SCB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_void_p, ctypes.c_void_p)
+    icb = ICB(int_addr) if int_addr else None
+    scb = SCB(str_addr) if str_addr else None
+
+    def updater(key, recv, local):
+        h = ctypes.c_void_p(handle_addr or 0)
+        if isinstance(key, str):
+            if scb is None:
+                raise MXNetError(
+                    "string-keyed update but no string updater registered")
+            scb(key.encode(), id(recv), id(local), h)
+        else:
+            if icb is None:
+                raise MXNetError(
+                    "int-keyed update but no int updater registered")
+            icb(int(key), id(recv), id(local), h)
 
     kv.set_updater(updater)
     return True

@@ -764,6 +764,7 @@ int MXNDArrayCreate64(const void *data, const int64_t *shape, int ndim,
   return MXNDArrayCreate(data, shape, ndim, dtype, out);
 }
 
+
 int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
                              size_t nbytes) {
   if (!ensure_runtime()) return -1;
@@ -917,6 +918,31 @@ int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
   return ret_none(call_deploy(
       "_capi_ndarray_sync_copy_from_ndarray",
       tup({incref(handle_dst), incref(handle_src), PyLong_FromLong(i)})));
+}
+
+// 64-bit aliases of the sparse group (≙ reference c_api.h:685/1046/1068 —
+// this ABI's shape words are already int64)
+int MXNDArrayCreateSparseEx64(int storage_type, const int64_t *shape,
+                              int ndim, int dtype, NDArrayHandle *out) {
+  return MXNDArrayCreateSparseEx(storage_type, shape, ndim, dtype, out);
+}
+
+int MXNDArrayGetAuxType64(NDArrayHandle handle, int64_t i, int *out_type) {
+  return MXNDArrayGetAuxType(handle, static_cast<int>(i), out_type);
+}
+
+int MXNDArrayGetAuxNDArray64(NDArrayHandle handle, int64_t i,
+                             NDArrayHandle *out) {
+  return MXNDArrayGetAuxNDArray(handle, static_cast<int>(i), out);
+}
+
+int MXNDArraySyncCheckFormat(NDArrayHandle handle, int full_check) {
+  // ≙ c_api.h MXNDArraySyncCheckFormat: validate sparse aux invariants
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(
+      "_capi_ndarray_check_format",
+      tup({incref(handle), PyBool_FromLong(full_check ? 1 : 0)})));
 }
 
 int MXNDArraySave(const char *fname, uint32_t num_args,
@@ -1521,6 +1547,26 @@ int MXSymbolInferType(SymbolHandle sym, uint32_t num_args, const char **keys,
                       const int *arg_type_data, uint32_t *in_type_size,
                       const int **in_type_data, uint32_t *out_type_size,
                       const int **out_type_data, uint32_t *aux_type_size,
+                      const int **aux_type_data, int *complete);
+
+int MXSymbolInferTypePartial(SymbolHandle sym, uint32_t num_args,
+                             const char **keys, const int *arg_type_data,
+                             uint32_t *in_type_size, const int **in_type_data,
+                             uint32_t *out_type_size,
+                             const int **out_type_data,
+                             uint32_t *aux_type_size,
+                             const int **aux_type_data, int *complete) {
+  // partial variant (≙ c_api.h MXSymbolInferTypePartial): this runtime's
+  // inference always completes or errors, so partial == full
+  return MXSymbolInferType(sym, num_args, keys, arg_type_data, in_type_size,
+                           in_type_data, out_type_size, out_type_data,
+                           aux_type_size, aux_type_data, complete);
+}
+
+int MXSymbolInferType(SymbolHandle sym, uint32_t num_args, const char **keys,
+                      const int *arg_type_data, uint32_t *in_type_size,
+                      const int **in_type_data, uint32_t *out_type_size,
+                      const int **out_type_data, uint32_t *aux_type_size,
                       const int **aux_type_data, int *complete) {
   if (!ensure_runtime()) return -1;
   Gil gil;
@@ -2109,6 +2155,19 @@ int kv_two_val_call(const char *fn, KVStoreHandle handle, int num,
                handles_to_list(num, ins), handles_to_list(num, outs),
                PyLong_FromLong(priority)})));
 }
+
+// string-keyed analog (the *Ex entry points); same deploy fns — they keep
+// each key space verbatim
+int kv_two_val_call_str(const char *fn, KVStoreHandle handle, uint32_t num,
+                        const char **keys, NDArrayHandle *ins,
+                        NDArrayHandle *outs, int priority) {
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(
+      fn, tup({incref(handle), str_list(num, keys),
+               handles_to_list(num, ins), handles_to_list(num, outs),
+               PyLong_FromLong(priority)})));
+}
 }  // namespace
 
 int MXKVStorePushPull(KVStoreHandle handle, int num, const int *keys,
@@ -2176,8 +2235,31 @@ int MXKVStorePullEx(KVStoreHandle handle, uint32_t num, const char **keys,
                                    PyLong_FromLong(priority)})));
 }
 
+int MXKVStorePushPullEx(KVStoreHandle handle, uint32_t num,
+                        const char **keys, NDArrayHandle *vals,
+                        NDArrayHandle *outs, int priority) {
+  return kv_two_val_call_str("_capi_kv_pushpull", handle, num, keys, vals,
+                             outs, priority);
+}
+
+int MXKVStoreBroadcastEx(KVStoreHandle handle, uint32_t num,
+                         const char **keys, NDArrayHandle *vals,
+                         NDArrayHandle *outs, int priority) {
+  return kv_two_val_call_str("_capi_kv_broadcast", handle, num, keys, vals,
+                             outs, priority);
+}
+
+int MXKVStorePullRowSparseEx(KVStoreHandle handle, uint32_t num,
+                             const char **keys, NDArrayHandle *outs,
+                             NDArrayHandle *row_ids, int priority) {
+  return kv_two_val_call_str("_capi_kv_pull_row_sparse", handle, num, keys,
+                             outs, row_ids, priority);
+}
+
 typedef void (*MXKVStoreUpdater)(int key, NDArrayHandle recv,
                                  NDArrayHandle local, void *handle);
+typedef void (*MXKVStoreStrUpdater)(const char *key, NDArrayHandle recv,
+                                    NDArrayHandle local, void *handle);
 
 int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
                         void *updater_handle) {
@@ -2187,6 +2269,21 @@ int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
       "_capi_kv_set_updater",
       tup({incref(handle),
            PyLong_FromVoidPtr(reinterpret_cast<void *>(updater)),
+           PyLong_FromVoidPtr(updater_handle)})));
+}
+
+int MXKVStoreSetUpdaterEx(KVStoreHandle handle, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void *updater_handle) {
+  // ≙ c_api.h MXKVStoreSetUpdaterEx: int keys dispatch to `updater`,
+  // string keys (the *Ex pushes) to `str_updater`
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  return ret_none(call_deploy(
+      "_capi_kv_set_updater_ex",
+      tup({incref(handle),
+           PyLong_FromVoidPtr(reinterpret_cast<void *>(updater)),
+           PyLong_FromVoidPtr(reinterpret_cast<void *>(str_updater)),
            PyLong_FromVoidPtr(updater_handle)})));
 }
 

@@ -267,6 +267,18 @@ class RowSparseNDArray(BaseSparseNDArray):
     def indices(self):
         return _dense_array(self._indices_np)
 
+    def check_format(self, full_check=True):
+        """≙ CheckFormatRSPImpl: indices strictly increasing, in range,
+        one data slice per index."""
+        if self._data_np.shape[0] != self._indices_np.size:
+            raise MXNetError("data must have one slice per index")
+        if full_check and self._indices_np.size:
+            if self._indices_np.min() < 0 \
+                    or self._indices_np.max() >= self._shape[0]:
+                raise MXNetError("row index out of bounds")
+            if (_np.diff(self._indices_np) <= 0).any():
+                raise MXNetError("indices must be strictly increasing")
+
     def asnumpy(self):
         out = _np.zeros(self._shape, self._dtype)
         if self._indices_np.size:

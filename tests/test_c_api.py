@@ -368,6 +368,26 @@ def test_c_api_sparse_group():
         lib.MXNDArrayFree(h)
     lib.MXNDArrayFree(csr)
 
+    # check_format through the ABI: a valid CSR passes, a corrupted one
+    # surfaces the typed error
+    csr2 = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateSparseEx(2, shape, 2, 0,
+                                       ctypes.byref(csr2)) == 0
+    ip = make_dense([0, 1, 2, 3], (4,), 6)
+    ix = make_dense([1, 3, 0], (3,), 6)
+    dv = make_dense([5.0, 6.0, 7.0], (3,), 0)
+    assert lib.MXNDArraySyncCopyFromNDArray(csr2, ix, 1) == 0
+    assert lib.MXNDArraySyncCopyFromNDArray(csr2, ip, 0) == 0
+    assert lib.MXNDArraySyncCopyFromNDArray(csr2, dv, -1) == 0
+    assert lib.MXNDArraySyncCheckFormat(csr2, 1) == 0, lib.MXGetLastError()
+    bad_ix = make_dense([9, 9, 9], (3,), 6)   # col 9 out of range for n=4
+    assert lib.MXNDArraySyncCopyFromNDArray(csr2, bad_ix, 1) == 0
+    assert lib.MXNDArraySyncCheckFormat(csr2, 1) == -1
+    assert b"out of bounds" in lib.MXGetLastError()
+    for h in (ip, ix, dv, bad_ix):
+        lib.MXNDArrayFree(h)
+    lib.MXNDArrayFree(csr2)
+
     # row-sparse pull through the ABI
     kv = ctypes.c_void_p()
     assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
