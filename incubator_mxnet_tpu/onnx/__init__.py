@@ -432,6 +432,19 @@ def _build_graph(net, x_raw, input_name, output_names, closed=None):
 
     tr = _Translator(g)
 
+    def wrap_outputs(names, vars_):
+        """Identity-wrap subgraph outputs to fresh names (distinct,
+        produced-in-body) and build their value_infos — the shared tail
+        of every control-flow body emitter."""
+        outs, infos = [], []
+        for nm, v in zip(names, vars_):
+            w = g.fresh("body_out")
+            g.add("Identity", [nm], [w])
+            shape, dt = _aval_of(v)
+            outs.append(w)
+            infos.append(P.value_info(w, dt, shape))
+        return outs, infos
+
     def emit_loop(eqn, env):
         """lax.scan -> ONNX Loop (VERDICT-r4 Next #7: a real dynamic loop,
         not a static unroll). Body subgraph: (iter, cond, carry...) ->
@@ -447,43 +460,32 @@ def _build_graph(net, x_raw, input_name, output_names, closed=None):
         const_names = [name_of(env, v) for v in eqn.invars[:n_const]]
         carry_in = [name_of(env, v)
                     for v in eqn.invars[n_const:n_const + n_carry]]
-        xs_vars = eqn.invars[n_const + n_carry:]
-        xs_names = [name_of(env, v) for v in xs_vars]
+        xs_names = [name_of(env, v) for v in eqn.invars[n_const + n_carry:]]
 
         g.begin_subgraph()
         iter_name = g.fresh("iter")
         cond_in = g.fresh("cond_in")
-        env_b = {}
-        for cv, cval in zip(bj.constvars, body_closed.consts):
-            env_b[cv] = cached_const(cval, "scan_c")
-        for bv, nm in zip(bj.invars[:n_const], const_names):
-            env_b[bv] = nm            # outer-scope name, visible in body
-        carry_formals = []
-        for bv in bj.invars[n_const:n_const + n_carry]:
-            nm = g.fresh("carry")
-            carry_formals.append(nm)
-            env_b[bv] = nm
+        carry_formals = [g.fresh("carry")
+                         for _ in range(n_carry)]
         idx_name = iter_name
         if reverse:
             idx_name = g.fresh("rev_iter")
             g.add("Sub",
                   [g.const(_np.asarray(length - 1, _np.int64), "revN"),
                    iter_name], [idx_name])
-        for bv, nm in zip(bj.invars[n_const + n_carry:], xs_names):
+        xs_rows = []
+        for nm in xs_names:               # outer names, visible in body
             row = g.fresh("x_t")
             g.add("Gather", [nm, idx_name], [row], axis=0)
-            env_b[bv] = row
-        walk(bj, env_b)
+            xs_rows.append(row)
+        body_names = inline_closed(
+            body_closed, const_names + carry_formals + xs_rows, "scan_c")
         cond_out = g.fresh("cond_out")
         g.add("Identity", [cond_in], [cond_out])
-        body_outs, body_out_infos = [cond_out], [
-            P.value_info(cond_out, _np.bool_, ())]
-        for bv in bj.outvars:
-            nm = g.fresh("body_out")
-            g.add("Identity", [name_of(env_b, bv)], [nm])
-            shape, dt = _aval_of(bv)
-            body_outs.append(nm)
-            body_out_infos.append(P.value_info(nm, dt, shape))
+        wrapped, wrapped_infos = wrap_outputs(body_names, bj.outvars)
+        body_outs = [cond_out] + wrapped
+        body_out_infos = [P.value_info(cond_out, _np.bool_, ())] \
+            + wrapped_infos
         body_nodes = g.end_subgraph()
 
         body_in_infos = [P.value_info(iter_name, _np.int64, ()),
@@ -518,6 +520,94 @@ def _build_graph(net, x_raw, input_name, output_names, closed=None):
                       [flipped])
                 env[ov] = flipped
 
+    def inline_closed(closed, arg_names, env_hint="sub"):
+        """Inline a ClosedJaxpr's equations into the CURRENT node list
+        (outer graph or an open subgraph), mapping its invars to existing
+        names. Returns the output names."""
+        jx_ = closed.jaxpr
+        envc = {}
+        for cv, cval in zip(jx_.constvars, closed.consts):
+            envc[cv] = cached_const(cval, env_hint)
+        for bv, nm in zip(jx_.invars, arg_names):
+            envc[bv] = nm
+        walk(jx_, envc)
+        return [name_of(envc, ov) for ov in jx_.outvars]
+
+    def emit_if(eqn, env):
+        """lax.cond -> ONNX If: two branch subgraphs capturing the
+        operands from outer scope (≙ reference control-flow export)."""
+        branches = eqn.params["branches"]
+        if len(branches) != 2:
+            raise MXNetError(
+                f"lax.switch with {len(branches)} branches is not "
+                "exportable (ONNX If is binary)")
+        idx = name_of(env, eqn.invars[0])
+        operands = [name_of(env, v) for v in eqn.invars[1:]]
+        pred = g.fresh("if_pred")
+        g.add("Cast", [idx], [pred], to=int(P.DT[_np.dtype(_np.bool_)]))
+
+        def build_branch(closed):
+            g.begin_subgraph()
+            names_out = inline_closed(closed, operands, "br_c")
+            _, infos = wrap_outputs(names_out, closed.jaxpr.outvars)
+            nodes = g.end_subgraph()
+            return P.graph(nodes, "branch", inputs=[], outputs=infos,
+                           initializers=[])
+
+        else_graph = build_branch(branches[0])   # index 0 = false branch
+        then_graph = build_branch(branches[1])
+        outs = []
+        for ov in eqn.outvars:
+            nm = g.fresh("if_out")
+            env[ov] = nm
+            outs.append(nm)
+        g.add("If", [pred], outs, then_branch=P.SubGraph(then_graph),
+              else_branch=P.SubGraph(else_graph))
+
+    def emit_while(eqn, env):
+        """lax.while_loop -> ONNX Loop with no trip limit: the body
+        subgraph computes the new carry then re-evaluates the cond jaxpr
+        on it; the initial cond evaluates in the outer graph (ONNX Loop
+        checks cond before the first iteration, like lax)."""
+        pr = eqn.params
+        cj, bj = pr["cond_jaxpr"], pr["body_jaxpr"]
+        cn, bn = pr["cond_nconsts"], pr["body_nconsts"]
+        cond_consts = [name_of(env, v) for v in eqn.invars[:cn]]
+        body_consts = [name_of(env, v) for v in eqn.invars[cn:cn + bn]]
+        carry_in = [name_of(env, v) for v in eqn.invars[cn + bn:]]
+        carry_vars = eqn.invars[cn + bn:]
+
+        cond0 = inline_closed(cj, cond_consts + carry_in, "while_c")[0]
+
+        g.begin_subgraph()
+        iter_name = g.fresh("iter")
+        cond_in = g.fresh("cond_in")
+        carry_formals = [g.fresh("carry") for _ in carry_vars]
+        new_carry = inline_closed(bj, body_consts + carry_formals,
+                                  "while_b")
+        cond_next = inline_closed(cj, cond_consts + new_carry, "while_c")[0]
+        cond_out = g.fresh("cond_out")
+        g.add("Identity", [cond_next], [cond_out])
+        wrapped, wrapped_infos = wrap_outputs(new_carry, carry_vars)
+        body_outs = [cond_out] + wrapped
+        body_infos = [P.value_info(cond_out, _np.bool_, ())] + wrapped_infos
+        body_nodes = g.end_subgraph()
+        body_ins = [P.value_info(iter_name, _np.int64, ()),
+                    P.value_info(cond_in, _np.bool_, ())]
+        for nm, bv in zip(carry_formals, carry_vars):
+            shape, dt = _aval_of(bv)
+            body_ins.append(P.value_info(nm, dt, shape))
+        body_graph = P.graph(body_nodes, "while_body", inputs=body_ins,
+                             outputs=body_infos, initializers=[])
+        outs = []
+        for ov in eqn.outvars:
+            nm = g.fresh("while_out")
+            env[ov] = nm
+            outs.append(nm)
+        # "" = absent optional trip-count input: cond alone drives exit
+        g.add("Loop", ["", cond0] + carry_in, outs,
+              body=P.SubGraph(body_graph))
+
     def walk(jx, env):
         for eqn in jx.eqns:
             if eqn.primitive.name in ("pjit", "jit", "closed_call",
@@ -540,6 +630,12 @@ def _build_graph(net, x_raw, input_name, output_names, closed=None):
                 continue
             if eqn.primitive.name == "scan":
                 emit_loop(eqn, env)
+                continue
+            if eqn.primitive.name == "cond":
+                emit_if(eqn, env)
+                continue
+            if eqn.primitive.name == "while":
+                emit_while(eqn, env)
                 continue
             ins = [name_of(env, v) for v in eqn.invars]
             outs = []

@@ -446,9 +446,21 @@ def _run_nodes(g, env):
             o = _nms_numpy(_np.asarray(i[0], _np.float32),
                            _np.asarray(i[1], _np.float32),
                            max_pc, iou_thr, sc_thr)
+        elif op == "If":
+            body = a["then_branch"] if bool(_np.atleast_1d(i[0])[0]) \
+                else a["else_branch"]
+            benv = dict(env)          # branches capture outer scope
+            benv.update(body.inits)
+            _run_nodes(body, benv)
+            for out_name, nm in zip(nd.outputs, body.output_names):
+                env[out_name] = benv[nm]
+            continue
         elif op == "Loop":
-            trip = int(_np.atleast_1d(i[0])[0])
-            cond = bool(_np.atleast_1d(i[1])[0]) if nd.inputs[1] else True
+            # absent M input ("" name) = no trip limit: cond drives exit
+            trip = int(_np.atleast_1d(i[0])[0]) if i[0] is not None \
+                else (1 << 31)
+            cond = bool(_np.atleast_1d(i[1])[0]) if i[1] is not None \
+                else True
             carries = list(i[2:])
             body = a["body"]
             n_carry = len(carries)

@@ -224,6 +224,68 @@ def test_detection_metadata_lists_all_outputs(tmp_path):
     np.testing.assert_allclose(b, x * 2)
 
 
+def test_export_cond_as_if(tmp_path):
+    """lax.cond exports as ONNX If with branch subgraphs capturing the
+    operands from outer scope; both branch outcomes evaluate correctly."""
+    import jax
+
+    def fn(x):
+        return jax.lax.cond(x.sum() > 0, lambda o: o * 2.0,
+                            lambda o: o - 1.0, x)
+
+    path = str(tmp_path / "if.onnx")
+    mxonnx.export_model(fn, np.ones((3,), np.float32), path)
+    g = _runtime.load_graph(path)
+    assert sum(1 for n in g.nodes if n.op == "If") == 1
+    for x in (np.ones((3,), np.float32), -np.ones((3,), np.float32)):
+        got = _runtime.run(path, {"data": x})
+        np.testing.assert_allclose(got, np.asarray(fn(x)), rtol=1e-6)
+
+
+def test_export_while_loop(tmp_path):
+    """lax.while_loop exports as a cond-driven ONNX Loop (no trip
+    limit); the iteration count is data-dependent at runtime."""
+    import jax
+
+    def fn(x):
+        c = jax.lax.while_loop(lambda c: c[0] < 10.0,
+                               lambda c: (c[0] + 1.0, c[1] * 1.5),
+                               (x.sum(), x))
+        return c[1]
+
+    path = str(tmp_path / "while.onnx")
+    mxonnx.export_model(fn, np.full((3,), 0.5, np.float32), path)
+    g = _runtime.load_graph(path)
+    loops = [n for n in g.nodes if n.op == "Loop"]
+    assert len(loops) == 1 and loops[0].inputs[0] == ""  # no trip limit
+    for fill in (0.5, -2.0, 20.0):   # 9, 12, and 0 iterations
+        x = np.full((3,), fill, np.float32)
+        got = _runtime.run(path, {"data": x})
+        np.testing.assert_allclose(got, np.asarray(fn(x)), rtol=1e-5)
+
+
+def test_export_npx_control_flow(tmp_path):
+    """The npx control-flow surface (while_loop here) rides the same
+    export path when traced through a gluon block."""
+    from incubator_mxnet_tpu import npx
+
+    class Pow(gluon.HybridBlock):
+        def forward(self, x):
+            _, states = npx.while_loop(
+                lambda i, acc: i < 4,
+                lambda i, acc: (i + 1, acc * x),
+                (mx.np.array(0), mx.np.ones((2,))))
+            return states[1]
+
+    net = Pow()
+    x = mx.np.array(np.array([1.1, 0.9], np.float32))
+    ref = net(x).asnumpy()
+    path = str(tmp_path / "npxwhile.onnx")
+    mxonnx.export_model(net, x, path)
+    got = _runtime.run(path, {"data": x.asnumpy()})
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
 def test_export_reverse_scan_as_loop(tmp_path):
     import jax
 
