@@ -85,6 +85,14 @@ int MXAutogradBackward(int num_heads, NDArrayHandle *heads,
                        NDArrayHandle *head_grads, int retain_graph);
 /* borrowed-style: *out is a NEW handle to the grad buffer (free it). */
 int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+/* ≙ reference c_api.h:1308: with num_variables>0 returns NEW grad handles
+ * (malloc'd array — MXFreeHandleArray) + dense stype codes; with 0 it is
+ * MXAutogradBackward with create_graph/is_train knobs. */
+int MXAutogradBackwardEx(uint32_t num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles,
+                         uint32_t num_variables, NDArrayHandle *var_handles,
+                         int retain_graph, int create_graph, int is_train,
+                         NDArrayHandle **grad_handles, int **grad_stypes);
 
 /* ---- kvstore (≙ reference MXKVStore*, include/mxnet/c_api.h:2347) ----- */
 typedef void *KVStoreHandle;

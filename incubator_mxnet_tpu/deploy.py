@@ -261,6 +261,26 @@ def _capi_autograd_backward(heads, head_grads, retain_graph):
     return True
 
 
+def _capi_autograd_backward_ex(heads, head_grads, variables, retain_graph,
+                               create_graph, is_train):
+    """≙ MXAutogradBackwardEx (c_api.h:1308): with `variables`, the
+    autograd.grad path — returns new grad arrays; without, plain
+    backward (grads land on marked variables)."""
+    from . import autograd
+    hg = list(head_grads) if head_grads is not None else None
+    if hg is not None and all(g is None for g in hg):
+        hg = None          # all-default ograds = the plain ones-like seed
+    if not variables:
+        autograd.backward(list(heads), hg, retain_graph=bool(retain_graph),
+                          create_graph=bool(create_graph),
+                          train_mode=bool(is_train))
+        return []
+    return list(autograd.grad(list(heads), list(variables), head_grads=hg,
+                              retain_graph=bool(retain_graph),
+                              create_graph=bool(create_graph),
+                              train_mode=bool(is_train)))
+
+
 def _capi_ndarray_get_grad(nd):
     g = nd.grad
     if g is None:

@@ -945,6 +945,56 @@ int MXNDArraySyncCheckFormat(NDArrayHandle handle, int full_check) {
       tup({incref(handle), PyBool_FromLong(full_check ? 1 : 0)})));
 }
 
+int MXAutogradBackwardEx(uint32_t num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles,
+                         uint32_t num_variables, NDArrayHandle *var_handles,
+                         int retain_graph, int create_graph, int is_train,
+                         NDArrayHandle **grad_handles, int **grad_stypes) {
+  // ≙ c_api.h:1308: with variables given, returns NEW grad handles (the
+  // autograd.grad path); without, behaves like MXAutogradBackward.
+  if (!ensure_runtime()) return -1;
+  Gil gil;
+  PyObject *ogl;
+  if (ograd_handles) {
+    // NULL entries are legal (reference frontends encode per-head
+    // default ones-gradients as NDArrayHandle(0)) -> None elements
+    ogl = PyList_New(num_output);
+    for (uint32_t i = 0; i < num_output; ++i) {
+      PyObject *e = ograd_handles[i]
+                        ? reinterpret_cast<PyObject *>(ograd_handles[i])
+                        : Py_None;
+      Py_INCREF(e);
+      PyList_SET_ITEM(ogl, i, e);
+    }
+  } else {
+    Py_INCREF(Py_None);
+    ogl = Py_None;
+  }
+  PyObject *r = call_deploy(
+      "_capi_autograd_backward_ex",
+      tup({handles_to_list(num_output, output_handles), ogl,
+           handles_to_list(num_variables, var_handles),
+           PyBool_FromLong(retain_graph), PyBool_FromLong(create_graph),
+           PyBool_FromLong(is_train)}));
+  if (!r) return -1;
+  if (num_variables == 0 || grad_handles == nullptr) {
+    Py_DECREF(r);
+    if (grad_handles) *grad_handles = nullptr;
+    if (grad_stypes) *grad_stypes = nullptr;
+    return 0;
+  }
+  int n = 0;
+  if (ret_handle_list(r, &n, reinterpret_cast<void ***>(grad_handles)) != 0)
+    return -1;
+  if (grad_stypes) {
+    thread_local std::vector<int> tl_stypes;
+    tl_stypes.assign(n, 0);   // dense storage for every grad
+    *grad_stypes = tl_stypes.data();
+  }
+  return 0;
+}
+
+
 int MXNDArraySave(const char *fname, uint32_t num_args,
                   NDArrayHandle *args, const char **keys) {
   if (!ensure_runtime()) return -1;

@@ -405,3 +405,53 @@ def test_c_api_sparse_group():
     for h in (init_val, out, rows):
         lib.MXNDArrayFree(h)
     lib.MXKVStoreFree(kv)
+
+
+def test_c_api_autograd_backward_ex():
+    """MXAutogradBackwardEx returns new grad handles for the variables
+    (the autograd.grad path through the ABI)."""
+    lib = ctypes.CDLL(build_capi())
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    assert lib.MXTPUInit() == 0
+
+    data = (ctypes.c_float * 3)(1.0, 2.0, 3.0)
+    shape = (ctypes.c_int64 * 1)(3)
+    x = ctypes.c_void_p()
+    assert lib.MXNDArrayCreate(data, shape, 1, 0, ctypes.byref(x)) == 0
+    req = (ctypes.c_int * 1)(1)   # write
+    xs = (ctypes.c_void_p * 1)(x)
+    assert lib.MXAutogradMarkVariables(1, xs, req) == 0
+    prev = ctypes.c_int()
+    assert lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+    nout = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    ins = (ctypes.c_void_p * 2)(x, x)
+    assert lib.MXImperativeInvoke(b"multiply", 2, ins, b"",
+                                  ctypes.byref(nout),
+                                  ctypes.byref(outs)) == 0
+    assert lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+
+    grads = ctypes.POINTER(ctypes.c_void_p)()
+    stypes = ctypes.POINTER(ctypes.c_int)()
+    heads = (ctypes.c_void_p * 1)(outs[0])
+    # NULL entries inside a non-NULL ograd array are legal (reference
+    # frontends encode per-head default ones-gradients that way)
+    null_ogs = (ctypes.c_void_p * 1)(None)
+    assert lib.MXAutogradBackwardEx(
+        1, heads, null_ogs, 1, xs, 0, 0, 1,
+        ctypes.byref(grads), ctypes.byref(stypes)) == 0, lib.MXGetLastError()
+    buf = (ctypes.c_float * 3)()
+    # bare ints from POINTER(c_void_p) indexing must be re-wrapped or
+    # ctypes truncates them to 32 bits (segfault)
+    g0 = ctypes.c_void_p(grads[0])
+    lib.MXNDArraySyncCopyToCPU.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    assert lib.MXNDArraySyncCopyToCPU(g0, buf, 12) == 0
+    assert list(buf) == [2.0, 4.0, 6.0]   # d(x*x)/dx = 2x
+    assert stypes[0] == 0                  # dense
+    lib.MXNDArrayFree.argtypes = [ctypes.c_void_p]
+    lib.MXNDArrayFree(g0)
+    lib.MXFreeHandleArray(grads)
+    lib.MXNDArrayFree(outs[0])
+    lib.MXFreeHandleArray(outs)
+    lib.MXNDArrayFree(x)
