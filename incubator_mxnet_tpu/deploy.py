@@ -267,9 +267,9 @@ def _capi_autograd_backward_ex(heads, head_grads, variables, retain_graph,
     autograd.grad path — returns new grad arrays; without, plain
     backward (grads land on marked variables)."""
     from . import autograd
+    # per-element None entries mean default ones-like seeds; the backward
+    # impl handles them directly
     hg = list(head_grads) if head_grads is not None else None
-    if hg is not None and all(g is None for g in hg):
-        hg = None          # all-default ograds = the plain ones-like seed
     if not variables:
         autograd.backward(list(heads), hg, retain_graph=bool(retain_graph),
                           create_graph=bool(create_graph),
