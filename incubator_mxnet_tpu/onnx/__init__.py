@@ -135,6 +135,13 @@ class _Translator:
             t = g.fresh()
             g.add("Sqrt", ins, [t])
             g.add("Reciprocal", [t], outs)
+        elif p == "clamp":
+            # lax.clamp(min, x, max): Max then Min — ONNX Clip requires
+            # SCALAR bounds, but lax permits array bounds; Max/Min
+            # broadcast and cover both
+            t = g.fresh("clamp_lo")
+            g.add("Max", [ins[1], ins[0]], [t])
+            g.add("Min", [t, ins[2]], outs)
         elif p == "integer_pow":
             e = g.const(_np.asarray(float(params["y"]), _np.float32))
             g.add("Pow", [ins[0], e], outs)
@@ -534,17 +541,13 @@ def _build_graph(net, x_raw, input_name, output_names, closed=None):
         return [name_of(envc, ov) for ov in jx_.outvars]
 
     def emit_if(eqn, env):
-        """lax.cond -> ONNX If: two branch subgraphs capturing the
-        operands from outer scope (≙ reference control-flow export)."""
+        """lax.cond -> ONNX If (index 0 = false/else, matching the cond
+        primitive); lax.switch with N>2 branches becomes a nested-If
+        chain (If(i==0, b0, If(i==1, b1, ...))). Branch subgraphs capture
+        the operands from outer scope."""
         branches = eqn.params["branches"]
-        if len(branches) != 2:
-            raise MXNetError(
-                f"lax.switch with {len(branches)} branches is not "
-                "exportable (ONNX If is binary)")
         idx = name_of(env, eqn.invars[0])
         operands = [name_of(env, v) for v in eqn.invars[1:]]
-        pred = g.fresh("if_pred")
-        g.add("Cast", [idx], [pred], to=int(P.DT[_np.dtype(_np.bool_)]))
 
         def build_branch(closed):
             g.begin_subgraph()
@@ -554,15 +557,49 @@ def _build_graph(net, x_raw, input_name, output_names, closed=None):
             return P.graph(nodes, "branch", inputs=[], outputs=infos,
                            initializers=[])
 
-        else_graph = build_branch(branches[0])   # index 0 = false branch
-        then_graph = build_branch(branches[1])
+        def emit_arm(k, arm_outs):
+            """If(index == k, branches[k], chain(k+1)) into the CURRENT
+            node list, writing to arm_outs — shared by the top level and
+            every nested arm."""
+            pred = g.fresh("sw_pred")
+            g.add("Equal",
+                  [idx, g.const(_np.asarray(k, _np.int32), "sw_k")],
+                  [pred])
+            g.add("If", [pred], arm_outs,
+                  then_branch=P.SubGraph(build_branch(branches[k])),
+                  else_branch=P.SubGraph(chain(k + 1)))
+
+        def chain(k):
+            """Subgraph selecting among branches[k:] (lax clamps the
+            index to [0, N-1], so the last branch is the final else)."""
+            if k == len(branches) - 1:
+                return build_branch(branches[k])
+            g.begin_subgraph()
+            outs_k, infos = [], []
+            for ov in eqn.outvars:
+                nm = g.fresh("sw_out")
+                shape, dt = _aval_of(ov)
+                outs_k.append(nm)
+                infos.append(P.value_info(nm, dt, shape))
+            emit_arm(k, outs_k)
+            nodes = g.end_subgraph()
+            return P.graph(nodes, "switch_arm", inputs=[], outputs=infos,
+                           initializers=[])
+
         outs = []
         for ov in eqn.outvars:
             nm = g.fresh("if_out")
             env[ov] = nm
             outs.append(nm)
-        g.add("If", [pred], outs, then_branch=P.SubGraph(then_graph),
-              else_branch=P.SubGraph(else_graph))
+        if len(branches) == 2:
+            pred = g.fresh("if_pred")
+            g.add("Cast", [idx], [pred],
+                  to=int(P.DT[_np.dtype(_np.bool_)]))
+            g.add("If", [pred], outs,
+                  then_branch=P.SubGraph(build_branch(branches[1])),
+                  else_branch=P.SubGraph(build_branch(branches[0])))
+        else:
+            emit_arm(0, outs)
 
     def emit_while(eqn, env):
         """lax.while_loop -> ONNX Loop with no trip limit: the body

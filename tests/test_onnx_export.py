@@ -372,3 +372,22 @@ def test_export_detection_model_roundtrip(tmp_path):
     np.testing.assert_allclose(got[:, 2:], ref_kept[:, 2:], rtol=1e-3,
                                atol=1e-4)
     np.testing.assert_array_equal(got[:, 0], ref_kept[:, 0])
+
+
+def test_export_switch_as_nested_if(tmp_path):
+    """lax.switch (N=3 branches) exports as a nested-If chain; every
+    branch and the clamp-at-bounds behavior round-trip."""
+    import jax
+
+    def fn(x):
+        idx = jax.numpy.clip(x[0].astype(jax.numpy.int32), 0, 2)
+        return jax.lax.switch(idx, [lambda o: o + 1.0,
+                                    lambda o: o * 3.0,
+                                    lambda o: -o], x)
+
+    path = str(tmp_path / "switch.onnx")
+    mxonnx.export_model(fn, np.zeros((3,), np.float32), path)
+    for lead in (0.0, 1.0, 2.0, 7.0):   # 7 clamps to branch 2
+        x = np.array([lead, 4.0, 5.0], np.float32)
+        got = _runtime.run(path, {"data": x})
+        np.testing.assert_allclose(got, np.asarray(fn(x)), rtol=1e-6)
