@@ -39,7 +39,8 @@ _LAZY = ("gluon", "optimizer", "kvstore", "parallel", "amp", "profiler",
          "initializer", "lr_scheduler", "metric", "test_utils", "util",
          "runtime", "io", "image", "engine", "context", "recordio",
          "checkpoint", "visualization", "models", "native", "deploy",
-         "symbol", "onnx", "contrib", "operator", "library")
+         "symbol", "onnx", "contrib", "operator", "library", "name",
+         "attribute")
 
 
 def __getattr__(name):

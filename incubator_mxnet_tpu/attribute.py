@@ -1,0 +1,52 @@
+"""Attribute scoping for the symbolic API (≙ python/mxnet/attribute.py:1).
+
+`AttrScope` attaches string attributes to every symbol created inside the
+scope (the reference uses it for group markers, ctx hints, and
+__wd_mult__-style per-symbol knobs). Scopes nest and merge."""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = ["AttrScope", "current"]
+
+_state = threading.local()
+
+
+def current():
+    stack = getattr(_state, "stack", None)
+    if not stack:
+        _state.stack = [AttrScope()]
+        stack = _state.stack
+    return stack[-1]
+
+
+class AttrScope:
+    """≙ attribute.py AttrScope: attributes must be strings; nested scopes
+    merge (inner wins on conflicts)."""
+
+    def __init__(self, **kwargs):
+        for k, v in kwargs.items():
+            if not isinstance(v, str):
+                raise MXNetError(
+                    f"attribute {k!r} must be a string, got {type(v).__name__}")
+        self._attrs = dict(kwargs)
+
+    def get(self, attrs=None):
+        """Merge scope attributes with explicitly-given ones (explicit
+        wins), returning a plain dict."""
+        out = dict(self._attrs)
+        if attrs:
+            out.update(attrs)
+        return out
+
+    def __enter__(self):
+        merged = AttrScope()
+        merged._attrs = {**current()._attrs, **self._attrs}
+        _state.stack.append(merged)
+        return merged
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+        return False

@@ -1,0 +1,57 @@
+"""Automatic naming for the symbolic API (≙ python/mxnet/name.py:1).
+
+`NameManager` generates hint-based names for anonymous symbols;
+`Prefix` prepends a scope prefix. Managers nest via `with`, and
+`mx.sym` consults the active manager when no explicit name is given."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+_state = threading.local()
+
+
+def current():
+    """The active NameManager (a default instance when none is entered)."""
+    stack = getattr(_state, "stack", None)
+    if not stack:
+        _state.stack = [NameManager()]
+        stack = _state.stack
+    return stack[-1]
+
+
+class NameManager:
+    """Hint-counter naming (≙ name.py NameManager): a user-given name wins;
+    otherwise `hint%d` with a per-hint counter."""
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name:
+            return name
+        n = self._counter.get(hint, 0)
+        self._counter[hint] = n + 1
+        return f"{hint}{n}"
+
+    def __enter__(self):
+        current()                    # ensure the stack exists
+        _state.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+        return False
+
+
+class Prefix(NameManager):
+    """≙ name.py Prefix: prepend `prefix` to every generated name."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name

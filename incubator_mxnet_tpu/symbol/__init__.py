@@ -429,7 +429,18 @@ def _reduce_shape(attrs, x):
 _name_counter = {}
 
 
-def _auto_name(op):
+def _auto_name(op, explicit=None):
+    """Resolve a symbol name through the active NameManager/Prefix scope
+    (≙ name.py auto-naming — Prefix applies to EXPLICIT names too, like
+    the reference); falls back to the module counter when only the
+    default manager is active (keeps historical names stable)."""
+    from .. import name as _name_mod
+    mgr = _name_mod.current()
+    user_scope = len(getattr(_name_mod._state, "stack", [])) > 1
+    if user_scope or type(mgr) is not _name_mod.NameManager:
+        return mgr.get(explicit, op.lower())
+    if explicit:
+        return explicit
     k = op.lower()
     n = _name_counter.get(k, 0)
     _name_counter[k] = n + 1
@@ -744,8 +755,10 @@ class Symbol:
 def Variable(name, **attrs):
     if not isinstance(name, str):
         raise TypeError("variable name must be a string")
-    node = _Node("null", name,
-                 {k: _fmt_attr(v) for k, v in attrs.items()})
+    from .. import attribute as _attr_mod
+    merged = _attr_mod.current().get(
+        {k: _fmt_attr(v) for k, v in attrs.items()})
+    node = _Node("null", name, merged)
     return Symbol([(node, 0)])
 
 
@@ -797,7 +810,7 @@ def _make_op(op_name):
                 data_kw.append((k, v))
             else:
                 attrs[k] = _fmt_attr(v)
-        name = name or _auto_name(op_name)
+        name = _auto_name(op_name, explicit=name)
         inputs = []
         for s in sym_args:
             if not isinstance(s, Symbol):
@@ -817,10 +830,19 @@ def _make_op(op_name):
         slots += list(spec.aux_slots)
         want = (spec.num_inputs if not spec.variadic else len(inputs))
         have_extra = len(inputs) - want
+        from .. import attribute as _attr_mod
+        scope_attrs = _attr_mod.current().get()
         for s in slots[max(have_extra, 0):]:
-            v = _Node("null", f"{name}_{s}")
+            # auto-created param slots carry the scope attrs too (the
+            # reference's lr_mult/wd_mult-on-parameters use case)
+            v = _Node("null", f"{name}_{s}", dict(scope_attrs))
             inputs.append((v, 0))
         node = _Node(op_name, name, attrs, inputs)
+        # scope attrs attach to the NODE attr dict only, AFTER op-param
+        # extraction — a scope key colliding with an op parameter (e.g.
+        # no_bias) must stay metadata, never rewrite the op
+        for k, v in scope_attrs.items():
+            node.attrs.setdefault(k, v)
         return Symbol([(node, 0)])
 
     maker.__name__ = op_name

@@ -13,6 +13,9 @@ import incubator_mxnet_tpu as mx
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+from capi_utils import subprocess_env as _cpu_env   # shared CPU-pinned env
+
+
 def _load_tool(name):
     spec = importlib.util.spec_from_file_location(
         f"tool_{name}", os.path.join(REPO, "tools", f"{name}.py"))
@@ -75,3 +78,70 @@ def test_convert_model_cli_auto_map(tmp_path):
     assert "auto-map" in r.stdout
     with np.load(out) as f:
         assert len(f.files) == len(foreign)
+
+
+def test_parse_log_extracts_metrics(tmp_path):
+    """≙ reference tools/parse_log.py: epoch metrics + speed out of mixed
+    log styles."""
+    import runpy
+    mod = runpy.run_path(os.path.join(REPO, "tools", "parse_log.py"))
+    assert mod["_self_test"]()
+    f = tmp_path / "t.log"
+    f.write_text("Epoch[0] Speed: 100.0 samples/sec accuracy=0.25\n"
+                 "Epoch[1] Speed: 120.0 samples/sec accuracy=0.75\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "parse_log.py"),
+         str(f), "--format", "csv"],
+        capture_output=True, text=True, env=_cpu_env(), timeout=120)
+    assert out.returncode == 0
+    assert "0,0.25,100" in out.stdout.replace(" ", "")
+
+
+def test_diagnose_runs(tmp_path):
+    """tools/diagnose.py prints env + package + device sections without
+    crashing, even when the accelerator is unreachable."""
+    env = _cpu_env()
+    env["DIAGNOSE_FORCE_CPU"] = "1"   # keep the probe off the real chip
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py")],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr
+    for section in ("Python Info", "Package Versions", "Framework",
+                    "Devices"):
+        assert section in r.stdout
+
+
+def test_name_and_attr_scopes():
+    """mx.name.Prefix / NameManager and mx.attribute.AttrScope drive
+    symbol naming + attributes (≙ name.py / attribute.py)."""
+    import incubator_mxnet_tpu as mx
+    with mx.name.Prefix("enc_"):
+        s = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4)
+        assert s.name.startswith("enc_fullyconnected")
+    with mx.name.NameManager():
+        a = mx.sym.Activation(mx.sym.Variable("x"), act_type="relu")
+        b = mx.sym.Activation(mx.sym.Variable("y"), act_type="relu")
+        assert a.name == "activation0" and b.name == "activation1"
+    # reference Prefix semantics: the prefix applies to EXPLICIT names too
+    with mx.name.Prefix("zzz_"):
+        s = mx.sym.Activation(mx.sym.Variable("x"), act_type="relu",
+                              name="mine")
+        assert s.name == "zzz_mine"
+    with mx.attribute.AttrScope(__group__="backbone"):
+        with mx.attribute.AttrScope(lr_mult="0.1"):
+            s = mx.sym.Activation(mx.sym.Variable("x"), act_type="relu")
+    attrs = s.list_attr()
+    assert attrs.get("__group__") == "backbone"
+    assert attrs.get("lr_mult") == "0.1"
+    # scope attrs reach Variables and auto-created param slots, and a
+    # scope key colliding with an op PARAM stays metadata (no_bias must
+    # not drop the bias slot)
+    with mx.attribute.AttrScope(lr_mult="0.5", no_bias="True"):
+        v = mx.sym.Variable("w")
+        fc = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=4)
+    assert v.list_attr().get("lr_mult") == "0.5"
+    assert any(n.endswith("_bias") for n in fc.list_arguments()), \
+        fc.list_arguments()
+    import pytest as _pytest
+    with _pytest.raises(mx.MXNetError):
+        mx.attribute.AttrScope(bad=3)

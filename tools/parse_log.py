@@ -1,0 +1,99 @@
+"""Training-log parser (≙ reference tools/parse_log.py): extract
+per-epoch train/validation metrics and speed from textual training logs
+and print a markdown or CSV table.
+
+Accepts the reference's log style and this repo's examples:
+
+    Epoch[3] Batch [100]  Speed: 2590.1 samples/sec  accuracy=0.912
+    Epoch[3] Validation-accuracy=0.887
+    epoch 3: loss=0.123 acc=0.91
+
+    python tools/parse_log.py train.log [--format md|csv]
+"""
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+EPOCH_PATTERNS = [
+    re.compile(r"Epoch\s*\[?(\d+)\]?"),
+    re.compile(r"epoch\s+(\d+)", re.I),
+]
+METRIC_PATTERN = re.compile(
+    r"\b([\w\-]*(?:accuracy|acc|loss|mse|rmse|f1|mAP|perplexity"
+    r"|ppl)[\w\-]*)\s*[=:]\s*([0-9.eE+-]+)", re.I)
+SPEED_PATTERN = re.compile(
+    r"Speed[:=]\s*([0-9.]+)\s*(?:samples|img)/sec", re.I)
+
+
+def parse(lines):
+    """-> {epoch: {metric: last value}} (later lines win, like the
+    reference's end-of-epoch summaries)."""
+    table = defaultdict(dict)
+    for line in lines:
+        epoch = None
+        for pat in EPOCH_PATTERNS:
+            m = pat.search(line)
+            if m:
+                epoch = int(m.group(1))
+                break
+        if epoch is None:
+            continue
+        for name, val in METRIC_PATTERN.findall(line):
+            try:
+                table[epoch][name] = float(val)
+            except ValueError:
+                pass
+        m = SPEED_PATTERN.search(line)
+        if m:
+            table[epoch]["speed"] = float(m.group(1))
+    return dict(table)
+
+
+def render(table, fmt="md"):
+    if not table:
+        return "(no epochs found)"
+    cols = sorted({k for row in table.values() for k in row})
+    out = []
+    if fmt == "md":
+        out.append("| epoch | " + " | ".join(cols) + " |")
+        out.append("|" + "---|" * (len(cols) + 1))
+        for e in sorted(table):
+            row = [f"{table[e].get(c, ''):g}" if c in table[e] else ""
+                   for c in cols]
+            out.append(f"| {e} | " + " | ".join(row) + " |")
+    else:
+        out.append("epoch," + ",".join(cols))
+        for e in sorted(table):
+            out.append(f"{e}," + ",".join(
+                f"{table[e][c]:g}" if c in table[e] else "" for c in cols))
+    return "\n".join(out)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logfile")
+    ap.add_argument("--format", choices=("md", "csv"), default="md")
+    args = ap.parse_args()
+    with open(args.logfile) as f:
+        table = parse(f)
+    print(render(table, args.format))
+
+
+if __name__ == "__main__":
+    main()
+
+
+def _self_test():
+    lines = [
+        "Epoch[0] Batch [50] Speed: 2500.0 samples/sec accuracy=0.5",
+        "Epoch[0] Validation-accuracy=0.61",
+        "Epoch[1] Batch [50] Speed: 2600.0 samples/sec accuracy=0.8",
+        "epoch 1: loss=0.25",
+        "noise line",
+    ]
+    t = parse(lines)
+    assert t[0]["accuracy"] == 0.5 and t[0]["Validation-accuracy"] == 0.61
+    assert t[1]["speed"] == 2600.0 and t[1]["loss"] == 0.25
+    assert "epoch" in render(t) and "0.61" in render(t, "csv")
+    return True
