@@ -179,3 +179,15 @@ _register_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 19,
               "Arrays above this many elements use flat-bucket allreduce")
 _register_env("MXNET_DEFAULT_DEVICE", str, None,
               "Override default device, e.g. 'tpu(0)' or 'cpu(0)'")
+_register_env("MXNET_FAULT_SPEC", str, None,
+              "Arm fault injection: 'point:hit:kind[:arg],...' "
+              "(see mx.fault and docs/RESILIENCE.md)")
+_register_env("MXNET_PREFETCH_RESTARTS", int, 3,
+              "Bounded in-place retries for transient PrefetchingIter "
+              "worker errors")
+_register_env("MXNET_DATALOADER_RETRIES", int, 3,
+              "Max attempts for a gluon DataLoader batch fetch on "
+              "transient I/O errors")
+_register_env("MXNET_KV_BARRIER_TIMEOUT", float, None,
+              "Seconds before a dist kvstore barrier aborts with "
+              "WatchdogTimeout instead of hanging on a dead peer")

@@ -8,17 +8,27 @@ live on ndarray/Block/Trainer) and ADDS the capability the reference lacks:
 mesh-sharded checkpoints where each host writes only its shards, restored
 with any (possibly different) sharding — backed by orbax (the TPU-ecosystem
 checkpoint library), with a plain-npz fallback for host-local state.
+
+All saves are crash-consistent: data is written to a temp path, fsync'd,
+then committed with an atomic os.replace; sharded directories additionally
+record committed steps in a MANIFEST.json, and `latest_step` only trusts
+committed entries — a SIGKILL (or injected IOError, see mx.fault) at any
+point during a save can never lose the previous checkpoint.
 """
 from __future__ import annotations
 
+import json
 import os
+import shutil
 
 import numpy as _np
 
 from .base import MXNetError
+from . import fault as _fault
 
 __all__ = ["save_checkpoint", "load_checkpoint", "save_sharded",
-           "load_sharded", "rescale_sharded", "latest_step"]
+           "load_sharded", "rescale_sharded", "latest_step", "latest_entry",
+           "commit_step", "MANIFEST_NAME"]
 
 
 def _flatten(tree, prefix=""):
@@ -54,7 +64,11 @@ def _decode_key(k):
 
 def save_checkpoint(path, params, step=None, trainer=None):
     """Host-local checkpoint: params (dict of NDArray/array, or a Block) +
-    optional trainer state (≙ the reference's save pattern, one file)."""
+    optional trainer state (≙ the reference's save pattern, one file).
+
+    Crash-consistent: the npz (and the `.trainer` sidecar) are written to a
+    temp file and committed with an atomic rename, so a partially-written
+    checkpoint can never shadow a good one."""
     from .ndarray import NDArray
     if hasattr(params, "collect_params"):  # a Block
         params = {k: p.data() for k, p in params.collect_params().items()
@@ -65,27 +79,44 @@ def save_checkpoint(path, params, step=None, trainer=None):
             v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v))
     path = _norm_npz_path(path)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    _np.savez(path, __step__=_np.asarray(step if step is not None else -1),
-              __fmt__=_np.asarray(2),  # v2: escape-safe key encoding
-              **payload)
+    with _fault.atomic_output(path) as f:
+        _np.savez(f, __step__=_np.asarray(step if step is not None else -1),
+                  __fmt__=_np.asarray(2),  # v2: escape-safe key encoding
+                  **payload)
+        # after the temp write, before the rename commit — the real
+        # crash window the atomic protocol must survive
+        _fault.inject("checkpoint.save")
     if trainer is not None:
         trainer.save_states(path + ".trainer")
     return path
 
 
-def load_checkpoint(path, net=None, trainer=None, device=None):
-    """Load a host-local checkpoint; returns (params_dict, step)."""
+def load_checkpoint(path, net=None, trainer=None, device=None,
+                    as_numpy=False):
+    """Load a host-local checkpoint; returns (params_dict, step).
+
+    as_numpy=True returns raw numpy arrays instead of NDArrays — bit-exact
+    for every dtype (NDArray creation would truncate float64 to the
+    device-native float32), which crash-resume parity depends on."""
     from .ndarray import array
-    raw_path = path
-    path = _norm_npz_path(path)
-    with _np.load(path, allow_pickle=False) as f:
+    _fault.inject("checkpoint.load")
+    # try the npz-normalized name first (what save_checkpoint writes), then
+    # the raw name (extension-less files from other tooling)
+    candidates = [path] if path.endswith(".npz") \
+        else [_norm_npz_path(path), path]
+    found = next((c for c in candidates if os.path.exists(c)), None)
+    if found is None:
+        raise MXNetError(f"no checkpoint at {path!r}; tried "
+                         + ", ".join(repr(c) for c in candidates))
+    with _np.load(found, allow_pickle=False) as f:
         step = int(f["__step__"])
         # v1 files (no __fmt__) used a lossy '/'->'__' mapping; decode them
         # with the legacy rule so their keys aren't silently corrupted
         fmt = int(f["__fmt__"]) if "__fmt__" in f.files else 1
         decode = _decode_key if fmt >= 2 else (lambda k: k.replace("__", "/"))
         meta = ("__step__", "__fmt__")
-        params = {decode(k): array(f[k], device=device)
+        params = {decode(k): (f[k].copy() if as_numpy
+                              else array(f[k], device=device))
                   for k in f.files if k not in meta}
     if net is not None:
         flat = {k.replace("/", "."): v for k, v in params.items()}
@@ -96,7 +127,7 @@ def load_checkpoint(path, net=None, trainer=None, device=None):
                 p.set_data(flat[name])
     if trainer is not None:
         # v1 saves wrote trainer state next to the un-normalized path
-        for tp in (path + ".trainer", raw_path + ".trainer"):
+        for tp in (found + ".trainer", path + ".trainer"):
             if os.path.exists(tp):
                 trainer.load_states(tp)
                 break
@@ -114,9 +145,100 @@ def _ocp():
         return None
 
 
-def save_sharded(directory, tree, step=0):
+MANIFEST_NAME = "MANIFEST.json"
+
+
+def _read_manifest(directory):
+    """The committed-step manifest, or None when the directory predates the
+    commit protocol (legacy layout: bare step-numbered subdirs)."""
+    mpath = os.path.join(directory, MANIFEST_NAME)
+    try:
+        with open(mpath) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _write_manifest(directory, manifest):
+    with _fault.atomic_output(os.path.join(directory, MANIFEST_NAME),
+                              mode="w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+
+
+def _remove_entry_payload(directory, entry):
+    target = os.path.join(directory, entry.get("path") or str(entry["step"]))
+    try:
+        if os.path.isdir(target):
+            shutil.rmtree(target)
+        elif os.path.exists(target):
+            os.remove(target)
+        sidecar = target + ".trainer"
+        if os.path.exists(sidecar):
+            os.remove(sidecar)
+    except OSError:
+        pass  # retention GC is best-effort; the manifest entry is gone
+
+
+def commit_step(directory, step, kind="sharded", path=None, keep_last=None):
+    """Record `step` as COMMITTED in the directory manifest (atomically),
+    then apply the `keep_last` retention policy: entries beyond the newest
+    N are dropped from the manifest first and their payloads deleted after,
+    so a crash mid-GC can only leave orphans, never a manifest pointing at
+    deleted data. Returns the manifest."""
+    directory = os.path.abspath(directory)
+    _gc_partials(directory)  # orphans from saves that died pre-commit
+    manifest = _read_manifest(directory) or {"version": 1, "committed": []}
+    entries = [e for e in manifest["committed"] if e["step"] != step]
+    entries.append({"step": int(step), "kind": kind,
+                    "path": path or str(step)})
+    entries.sort(key=lambda e: e["step"])
+    evicted = []
+    if keep_last is not None and keep_last > 0 and len(entries) > keep_last:
+        evicted = entries[:-keep_last]
+        entries = entries[-keep_last:]
+    manifest["committed"] = entries
+    _write_manifest(directory, manifest)
+    for e in evicted:
+        _remove_entry_payload(directory, e)
+    return manifest
+
+
+def _gc_partials(directory):
+    """Remove orphaned partial saves a crashed writer left: `.tmp-*` scratch
+    trees (sharded saves) and `.<name>*.tmp` files (atomic_output temps)."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return
+    for name in names:
+        if name.startswith(".tmp-") or (name.startswith(".")
+                                        and name.endswith(".tmp")):
+            target = os.path.join(directory, name)
+            try:
+                if os.path.isdir(target):
+                    shutil.rmtree(target)
+                else:
+                    os.remove(target)
+            except OSError:
+                pass
+
+
+def _is_proc0():
+    try:
+        import jax
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def save_sharded(directory, tree, step=0, keep_last=None):
     """Save a pytree of (possibly mesh-sharded) jax arrays; each host writes
-    its own shards (orbax). Use for pjit/SPMD training state."""
+    its own shards (orbax). Use for pjit/SPMD training state.
+
+    Crash-consistent commit protocol: shards stream into a `.tmp-` scratch
+    dir, which is atomically renamed to the step dir and only then recorded
+    in MANIFEST.json — `latest_step` never sees a partial save. `keep_last=N`
+    retains only the newest N committed steps."""
     ocp = _ocp()
     if ocp is None:
         raise MXNetError("orbax is unavailable; use save_checkpoint for "
@@ -126,9 +248,25 @@ def save_sharded(directory, tree, step=0):
     tree = jtu.tree_map(
         lambda v: v._arr if isinstance(v, NDArray) else v, tree,
         is_leaf=lambda v: isinstance(v, NDArray))
-    path = os.path.join(os.path.abspath(directory), str(step))
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    proc0 = _is_proc0()
+    if proc0:
+        _gc_partials(directory)
+    path = os.path.join(directory, str(step))
+    tmp = os.path.join(directory, f".tmp-{step}")
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, tree, force=True)
+    ckptr.save(tmp, tree, force=True)
+    _fault.inject("checkpoint.save_sharded")
+    if proc0:
+        # commit: rename the finished scratch dir over the step dir, fsync
+        # the parent, then record the step in the manifest — in that order,
+        # so every manifest entry always points at complete data
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        os.replace(tmp, path)
+        _fault.fsync_dir(directory)
+        commit_step(directory, step, kind="sharded", keep_last=keep_last)
     return path
 
 
@@ -146,6 +284,7 @@ def load_sharded(directory, step=None, target=None):
     ocp = _ocp()
     if ocp is None:
         raise MXNetError("orbax is unavailable")
+    _fault.inject("checkpoint.load")
     step, path = _resolve_step(directory, step)
     ckptr = ocp.PyTreeCheckpointer()
     if target is not None:
@@ -158,11 +297,33 @@ def load_sharded(directory, step=None, target=None):
     return ckptr.restore(path), step
 
 
-def latest_step(directory):
+def latest_entry(directory):
+    """The newest COMMITTED manifest entry ({step, kind, path}) whose
+    payload still exists, or None. Directories without a manifest (legacy
+    layout) fall back to scanning step-numbered subdirs."""
     if not os.path.isdir(directory):
         return None
+    manifest = _read_manifest(directory)
+    if manifest is not None:
+        for e in sorted(manifest.get("committed", []),
+                        key=lambda e: e["step"], reverse=True):
+            if os.path.exists(os.path.join(
+                    directory, e.get("path") or str(e["step"]))):
+                return e
+        return None
     steps = [int(d) for d in os.listdir(directory) if d.isdigit()]
-    return max(steps) if steps else None
+    if not steps:
+        return None
+    s = max(steps)
+    return {"step": s, "kind": "sharded", "path": str(s)}
+
+
+def latest_step(directory):
+    """Newest committed step in a checkpoint directory, or None. Only
+    trusts manifest-committed entries — a save that crashed before its
+    commit is invisible here."""
+    entry = latest_entry(directory)
+    return None if entry is None else entry["step"]
 
 
 def rescale_sharded(directory, mesh, specs, step=None):

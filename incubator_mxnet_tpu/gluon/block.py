@@ -232,7 +232,11 @@ class Block:
                 continue
             seen[id(p)] = name
             payload[name] = arr
-        _np.savez(filename, **payload)
+        # temp write + atomic rename: a crash mid-save never truncates a
+        # previously-good params file (see mx.fault)
+        from .. import fault as _fault
+        with _fault.atomic_output(filename) as f:
+            _np.savez(f, **payload)
 
     def load_parameters(self, filename, device=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False, ctx=None):

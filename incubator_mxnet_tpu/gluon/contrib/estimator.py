@@ -69,7 +69,11 @@ class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
 
     def train_begin(self, estimator, *args, **kwargs):
         self.current_batch = 0
-        self.current_epoch = 0
+        # a CheckpointHandler resume fast-forwards the epoch budget so a
+        # 10-epoch fit interrupted after 7 runs 3 more, not 10
+        self.current_epoch = getattr(estimator, "_resume_epoch", 0)
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            estimator.stop_training = True
 
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1
@@ -191,9 +195,39 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
                 "loss" in monitor.get()[0] else "max"
         self.mode = mode
         self.best = _np.inf if mode == "min" else -_np.inf
+        self.resume_from_checkpoint = resume_from_checkpoint
 
     def train_begin(self, estimator, *args, **kwargs):
         os.makedirs(self.model_dir, exist_ok=True)
+        if self.resume_from_checkpoint:
+            self._resume(estimator)
+
+    def _resume(self, estimator):
+        """Load the newest epoch checkpoint in model_dir (params + trainer
+        states) so an interrupted fit continues instead of restarting."""
+        import re
+        pat = re.compile(
+            re.escape(self.model_prefix) + r"-epoch(\d+)\.params\.npz$")
+        found = [(int(m.group(1)), m.group(0))
+                 for m in map(pat.match, sorted(os.listdir(self.model_dir)))
+                 if m]
+        if not found:
+            return
+        epoch, name = max(found)
+        path = os.path.join(self.model_dir, name)
+        estimator.net.load_parameters(path)
+        if estimator.trainer is not None and os.path.exists(path + ".states"):
+            estimator.trainer.load_states(path + ".states")
+        self.current_epoch = epoch
+        estimator._resume_epoch = epoch  # StoppingHandler shortens the run
+        best_meta = os.path.join(self.model_dir,
+                                 f"{self.model_prefix}-best.json")
+        if self.save_best and os.path.exists(best_meta):
+            import json
+            with open(best_meta) as f:
+                self.best = json.load(f)["value"]
+        estimator.logger.info("resumed from checkpoint %s (epoch %d)",
+                              path, epoch)
 
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1
@@ -206,19 +240,39 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
             self._save(estimator, f"epoch{self.current_epoch}")
 
     def _save(self, estimator, tag):
-        if self.save_best and self.monitor is not None:
-            _, value = self.monitor.get()
-            improved = (value < self.best if self.mode == "min"
-                        else value > self.best)
-            if improved:
-                self.best = value
-                estimator.net.save_parameters(os.path.join(
-                    self.model_dir, f"{self.model_prefix}-best.params.npz"))
-        path = os.path.join(self.model_dir,
-                            f"{self.model_prefix}-{tag}.params.npz")
-        estimator.net.save_parameters(path)
-        if estimator.trainer is not None:
-            estimator.trainer.save_states(path + ".states")
+        # retried: a transient I/O failure must not kill a long fit, and the
+        # atomic writes underneath guarantee no torn checkpoint either way
+        from ... import fault as _fault
+
+        @_fault.retrying(max_attempts=3, name="estimator.checkpoint")
+        def _write():
+            _fault.inject("estimator.checkpoint")
+            if self.save_best and self.monitor is not None:
+                _, value = self.monitor.get()
+                improved = (value < self.best if self.mode == "min"
+                            else value > self.best)
+                if improved:
+                    estimator.net.save_parameters(os.path.join(
+                        self.model_dir,
+                        f"{self.model_prefix}-best.params.npz"))
+                    # persist the best value so a resumed fit does not
+                    # clobber the best file with a worse model
+                    import json
+                    with _fault.atomic_output(
+                            os.path.join(self.model_dir,
+                                         f"{self.model_prefix}-best.json"),
+                            mode="w") as f:
+                        json.dump({"value": float(value),
+                                   "mode": self.mode}, f)
+                    # only after the write lands: a failed save must retry
+                    # as still-improved, not silently skip the best file
+                    self.best = value
+            path = os.path.join(self.model_dir,
+                                f"{self.model_prefix}-{tag}.params.npz")
+            estimator.net.save_parameters(path)
+            if estimator.trainer is not None:
+                estimator.trainer.save_states(path + ".states")
+        _write()
 
 
 class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
@@ -275,6 +329,8 @@ class Estimator:
                                           {"learning_rate": 1e-3})
         self.evaluation_loss = evaluation_loss or loss
         self.stop_training = False
+        self.logger = logging.getLogger("mxnet.estimator")
+        self._resume_epoch = 0
 
     # ------------------------------------------------------------------
     def evaluate(self, val_data):
@@ -302,6 +358,9 @@ class Estimator:
                 val_data, self.evaluate))
         handlers.sort(key=lambda h: getattr(h, "priority", 0))
         self.stop_training = False
+        # stale resume state from a previous fit() must not shorten this
+        # one; a CheckpointHandler resume re-sets it during train_begin
+        self._resume_epoch = 0
 
         def emit(kind, **kw):
             for h in handlers:

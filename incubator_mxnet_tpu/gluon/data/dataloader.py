@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import itertools
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 
 import numpy as _np
 
@@ -73,8 +74,19 @@ class DataLoader:
                              else 2 * self._num_workers)
         self._pin_memory = pin_memory
         self._timeout = timeout
+        # transient fetch errors (flaky storage, network FS) retry with
+        # backoff instead of killing the epoch; bound via
+        # MXNET_DATALOADER_RETRIES (default 3 attempts). Wrapped once here,
+        # not per batch — _make_batch is the hot path.
+        from ... import fault as _fault
+        from ...base import get_env
+        self._make_batch = _fault.retrying(
+            max_attempts=get_env("MXNET_DATALOADER_RETRIES", 3, typ=int),
+            name="dataloader.fetch")(self._fetch_batch)
 
-    def _make_batch(self, indices):
+    def _fetch_batch(self, indices):
+        from ... import fault as _fault
+        _fault.inject("dataloader.fetch")
         samples = [self._dataset[i] for i in indices]
         return self._batchify_fn(samples)
 
@@ -86,7 +98,9 @@ class DataLoader:
         if self._use_processes:
             yield from self._iter_processes()
             return
-        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+        pool = ThreadPoolExecutor(max_workers=self._num_workers)
+        stalled = False
+        try:
             it = iter(self._batch_sampler)
             pending = []
             for indices in itertools.islice(it, self._prefetch + 1):
@@ -96,7 +110,20 @@ class DataLoader:
                 nxt = next(it, None)
                 if nxt is not None:
                     pending.append(pool.submit(self._make_batch, nxt))
-                yield fut.result()
+                try:
+                    yield fut.result(timeout=self._timeout)
+                except FuturesTimeoutError:
+                    stalled = True
+                    raise MXNetError(
+                        f"DataLoader batch fetch exceeded {self._timeout}s "
+                        "(worker stalled; raise timeout= or check the "
+                        "dataset's I/O)") from None
+        finally:
+            # on stall, skip the join so the timeout error surfaces to the
+            # caller now instead of hanging here; a truly wedged worker is
+            # non-daemon and may still delay interpreter exit — the caller
+            # gets the chance to report and abort cleanly
+            pool.shutdown(wait=not stalled, cancel_futures=True)
 
     def _iter_processes(self):
         """Spawned process workers + shared-memory batch rebuild

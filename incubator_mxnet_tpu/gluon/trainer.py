@@ -248,8 +248,10 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def save_states(self, fname):
-        """≙ trainer.py:468."""
+        """≙ trainer.py:468. Crash-consistent: temp write + atomic rename,
+        so a crash mid-save never corrupts the previous state file."""
         import pickle
+        from .. import fault as _fault
         if self._update_on_kvstore and self._kvstore is not None:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
             return
@@ -260,7 +262,7 @@ class Trainer:
                        for i, s in enumerate(self._states)
                        if self._states_created[i]},
         }
-        with open(fname, "wb") as f:
+        with _fault.atomic_output(fname) as f:
             pickle.dump(payload, f)
 
     def load_states(self, fname):

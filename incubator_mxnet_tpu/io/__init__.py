@@ -202,30 +202,71 @@ class ResizeIter(DataIter):
         return self.current_batch.pad or 0
 
 
-class PrefetchingIter(DataIter):
-    """≙ mx.io.PrefetchingIter — background thread prefetch wrapper."""
+class _WorkerFailure:
+    """Terminal sentinel: the prefetch worker died; holds its exception."""
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    __slots__ = ("error",)
+
+    def __init__(self, error):
+        self.error = error
+
+
+class PrefetchingIter(DataIter):
+    """≙ mx.io.PrefetchingIter — background thread prefetch wrapper.
+
+    Worker failures are never silent: an exception in the prefetch thread is
+    captured and re-raised in the consumer's `__next__` (the reference's
+    thread would die and the epoch would just end short). Transient I/O
+    errors (IOError/OSError/TimeoutError) are retried in place up to
+    `max_restarts` times (default MXNET_PREFETCH_RESTARTS=3) with a
+    structured log per retry — the retry re-fetches, so nothing is lost
+    unless the source itself advanced before raising (the source's own
+    contract)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 max_restarts=None):
         import queue
-        import threading
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
         if len(iters) != 1:
             raise MXNetError("multi-iter prefetching is not supported; "
                              "compose datasets instead")
         super().__init__(iters[0].batch_size)
+        from ..base import get_env
         self.iter = iters[0]
         self._queue = queue.Queue(maxsize=2)
         self._started = False
         self._thread = None
         self.current_batch = None
+        self._max_restarts = (get_env("MXNET_PREFETCH_RESTARTS", 3, typ=int)
+                              if max_restarts is None else max_restarts)
+        self._terminated = False  # terminal sentinel already consumed
 
     def _worker(self):
-        try:
-            for batch in self.iter:
-                self._queue.put(batch)
-        finally:
-            self._queue.put(None)
+        from .. import fault as _fault
+        restarts = 0
+        it = iter(self.iter)
+        while True:
+            try:
+                # inject BEFORE the fetch: a transient injected fault must
+                # not consume a batch from the source
+                _fault.inject("io.prefetch")
+                batch = next(it)
+            except StopIteration:
+                self._queue.put(None)
+                return
+            except (IOError, OSError, TimeoutError) as e:
+                if restarts < self._max_restarts:
+                    restarts += 1
+                    _fault._log_event("io.prefetch_restart",
+                                      attempt=restarts, error=repr(e))
+                    continue
+                self._queue.put(_WorkerFailure(e))
+                return
+            except BaseException as e:  # re-raised in the consumer
+                self._queue.put(_WorkerFailure(e))
+                return
+            self._queue.put(batch)
 
     def _ensure_started(self):
         import threading
@@ -236,17 +277,27 @@ class PrefetchingIter(DataIter):
 
     def reset(self):
         if self._thread is not None:
-            while self._queue.get() is not None:
+            # drain until the worker's terminal sentinel (None on epoch end,
+            # _WorkerFailure on death) so join() cannot deadlock on a full
+            # queue; skip when the sentinel was already consumed
+            while not self._terminated and not isinstance(
+                    self._queue.get(), (type(None), _WorkerFailure)):
                 pass
             self._thread.join()
+            self._thread = None
         self.iter.reset()
         self._started = False
+        self._terminated = False
 
     def iter_next(self):
         self._ensure_started()
         batch = self._queue.get()
         if batch is None:
+            self._terminated = True
             return False
+        if isinstance(batch, _WorkerFailure):
+            self._terminated = True
+            raise batch.error
         self.current_batch = batch
         return True
 

@@ -29,7 +29,8 @@ import pickle
 
 import numpy as _np
 
-from ..base import MXNetError
+from ..base import MXNetError, get_env
+from .. import fault as _fault
 
 __all__ = ["KVStore", "KVStoreBase", "create"]
 
@@ -132,9 +133,16 @@ class KVStore(KVStoreBase):
 
     @staticmethod
     def _cross_process_sum(agg):
-        """Sum ONE value across processes (small-key / fallback path)."""
+        """Sum ONE value across processes (small-key / fallback path).
+
+        Deliberately NOT retried per-process: one participant re-entering a
+        collective while its peers have moved on pairs the retry with the
+        peers' NEXT collective — a hang or silently wrong sums. Collective
+        failures fail fast here; recovery is whole-job restart via
+        fault.run_resilient (and the barrier's watchdog bounds the hang)."""
         from jax.experimental import multihost_utils
         from ..ndarray import NDArray, array
+        _fault.inject("kvstore.collective")
         raw = agg._arr if isinstance(agg, NDArray) else agg
         gathered = multihost_utils.process_allgather(raw)  # (P, *shape)
         return array(_np.asarray(gathered).sum(axis=0))
@@ -257,6 +265,7 @@ class KVStore(KVStoreBase):
         return out
 
     def push(self, key, value, priority=0):
+        _fault.inject("kvstore.push")
         keys, values = _pairs(key, value)
         dist = self._dist_active()
         if self._compression is not None and dist:
@@ -326,6 +335,7 @@ class KVStore(KVStoreBase):
                 self._store[k] = agg
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        _fault.inject("kvstore.pull")
         keys, outs = _pairs(key, out)
         for k, o in zip(keys, outs):
             if k not in self._store:
@@ -403,7 +413,7 @@ class KVStore(KVStoreBase):
     def save_optimizer_states(self, fname, dump_optimizer=False):
         states = {k: _to_np_state(s) for k, s in self._opt_states.items()}
         payload = (states, self._optimizer) if dump_optimizer else states
-        with open(fname, "wb") as f:
+        with _fault.atomic_output(fname) as f:
             pickle.dump(payload, f)
 
     def load_optimizer_states(self, fname):
@@ -415,12 +425,18 @@ class KVStore(KVStoreBase):
 
     def barrier(self):
         """≙ KVStore::Barrier: local completion + (in dist mode) a real
-        cross-process rendezvous."""
+        cross-process rendezvous. A dead peer would hang the rendezvous
+        forever; set MXNET_KV_BARRIER_TIMEOUT (seconds) to abort with
+        WatchdogTimeout instead (preemptive on the main thread only — a
+        non-main-thread barrier cannot be interrupted mid-call)."""
         from ..ndarray import waitall
         waitall()
         if self._dist_active():
             from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("mx_kvstore_barrier")
+            timeout = get_env("MXNET_KV_BARRIER_TIMEOUT", typ=float)
+            with _fault.watchdog(timeout, "kvstore barrier timed out "
+                                          "(peer process likely dead)"):
+                multihost_utils.sync_global_devices("mx_kvstore_barrier")
 
     def _send_command_to_servers(self, head, body):
         pass  # no server processes in the SPMD runtime

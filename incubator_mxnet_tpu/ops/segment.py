@@ -315,6 +315,8 @@ class Segment:
         keeps = [c for c, d in zip(consts, donate) if not d]
         _tls.suspended = getattr(_tls, "suspended", 0) + 1
         try:
+            from ..fault import inject as _fault_inject
+            _fault_inject("engine.flush")
             results = entry(dons, keeps)
         except Exception as e:  # deferred-error semantics (SURVEY §5.3):
             self.error = e      # the error surfaces at the wait point

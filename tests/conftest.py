@@ -23,6 +23,13 @@ import numpy as _np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: nightly-scale tests (crashtest SIGKILL parity, convergence "
+        "runs) excluded from the tier-1 '-m \"not slow\"' pass")
+
+
 @pytest.fixture(autouse=True)
 def _seed_all(request):
     """Per-test deterministic seeding, reproducible via MXNET_TEST_SEED

@@ -1,0 +1,481 @@
+"""mx.fault: fault injection, crash-consistent checkpoint commits, retry /
+watchdog, and the auto-resume training driver (ISSUE 1 acceptance: an
+injected IOError or SIGKILL at any point during a save never loses the
+previous committed checkpoint, and a restarted run_resilient reproduces the
+uninterrupted run's final parameters — same mesh and halved mesh)."""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import checkpoint as ckpt
+from incubator_mxnet_tpu import fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+# ---------------------------------------------------------------------------
+# spec / registry
+# ---------------------------------------------------------------------------
+def test_spec_parsing():
+    rules = fault.parse_spec(
+        "checkpoint.save:2:ioerror, a.b:3+:stall:0.5 ,x:*:nan")
+    assert [(r.point, r.at, r.persistent, r.kind) for r in rules] == [
+        ("checkpoint.save", 2, False, "ioerror"),
+        ("a.b", 3, True, "stall"),
+        ("x", 1, True, "nan")]
+    assert rules[1].arg == "0.5"
+    with pytest.raises(mx.MXNetError):
+        fault.parse_spec("missing.kind:1")
+    with pytest.raises(mx.MXNetError):
+        fault.parse_spec("p:1:frobnicate")
+
+
+def test_inject_nth_hit_only():
+    fault.install("demo.point", "ioerror", at=2)
+    fault.inject("demo.point")  # hit 1: no fire
+    with pytest.raises(IOError):
+        fault.inject("demo.point")  # hit 2
+    fault.inject("demo.point")  # hit 3: non-persistent rule is done
+    assert fault.hits("demo.point") == 3
+
+
+def test_scope_restores_rules():
+    with fault.scope("p:1:error"):
+        assert len(fault.active_rules()) == 1
+        with pytest.raises(fault.InjectedFault):
+            fault.inject("p")
+    assert fault.active_rules() == []
+    fault.inject("p")  # disarmed
+
+
+# ---------------------------------------------------------------------------
+# crash-consistent checkpoints
+# ---------------------------------------------------------------------------
+def test_atomic_save_checkpoint_preserves_previous(tmp_path):
+    p = ckpt.save_checkpoint(str(tmp_path / "c"), {"w": np.arange(4.)},
+                             step=5)
+    with fault.scope("checkpoint.save:1:ioerror"):
+        with pytest.raises(IOError):
+            ckpt.save_checkpoint(p, {"w": np.zeros(4)}, step=9)
+    params, step = ckpt.load_checkpoint(p)
+    assert step == 5
+    np.testing.assert_array_equal(params["w"].asnumpy(), np.arange(4.))
+
+
+def test_load_checkpoint_missing_raises_clear_error(tmp_path):
+    missing = str(tmp_path / "nope")
+    with pytest.raises(mx.MXNetError, match="nope.npz"):
+        ckpt.load_checkpoint(missing)
+    # the raw path must be listed too
+    with pytest.raises(mx.MXNetError, match="tried"):
+        ckpt.load_checkpoint(missing)
+
+
+def test_ioerror_mid_save_sharded_preserves_latest_step(tmp_path):
+    import jax.numpy as jnp
+    d = str(tmp_path / "sh")
+    ckpt.save_sharded(d, {"w": jnp.arange(8.)}, step=1)
+    assert ckpt.latest_step(d) == 1
+    with fault.scope("checkpoint.save_sharded:1:ioerror"):
+        with pytest.raises(IOError):
+            ckpt.save_sharded(d, {"w": jnp.zeros(8)}, step=2)
+    # the crashed save is invisible: manifest still points at step 1 ...
+    assert ckpt.latest_step(d) == 2 - 1
+    tree, step = ckpt.load_sharded(d)
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(tree["w"]), np.arange(8.))
+    # ... and the next save garbage-collects the orphaned partial
+    ckpt.save_sharded(d, {"w": jnp.full(8, 3.0)}, step=3)
+    assert not [n for n in os.listdir(d) if n.startswith(".tmp-")]
+    assert ckpt.latest_step(d) == 3
+
+
+def test_sharded_retention_keep_last(tmp_path):
+    import jax.numpy as jnp
+    d = str(tmp_path / "sh")
+    for s in (1, 2, 3, 4):
+        ckpt.save_sharded(d, {"w": jnp.full(4, float(s))}, step=s,
+                          keep_last=2)
+    assert ckpt.latest_step(d) == 4
+    kept = sorted(n for n in os.listdir(d) if n.isdigit())
+    assert kept == ["3", "4"]
+    # evicted steps are gone from the manifest, not just the filesystem
+    tree, step = ckpt.load_sharded(d)
+    assert step == 4
+
+
+def test_commit_gc_removes_atomic_output_orphans(tmp_path):
+    # a SIGKILL between mkstemp and os.replace leaves a '.<name>*.tmp'
+    # file; the next commit must garbage-collect it
+    d = tmp_path / "npz"
+    d.mkdir()
+    orphan = d / ".ckpt-2.npzab12cd.tmp"
+    orphan.write_bytes(b"partial")
+    ckpt.save_checkpoint(str(d / "ckpt-1"), {"w": np.ones(2)}, step=1)
+    ckpt.commit_step(str(d), 1, kind="npz", path="ckpt-1.npz")
+    assert not orphan.exists()
+    assert ckpt.latest_step(str(d)) == 1
+
+
+def test_latest_step_legacy_dir_without_manifest(tmp_path):
+    d = tmp_path / "legacy"
+    (d / "7").mkdir(parents=True)
+    (d / "12").mkdir()
+    assert ckpt.latest_step(str(d)) == 12
+
+
+# ---------------------------------------------------------------------------
+# retry / watchdog
+# ---------------------------------------------------------------------------
+def test_retrying_recovers_then_exhausts():
+    calls = []
+
+    @fault.retrying(max_attempts=3, backoff=0.001)
+    def flaky(fail_times):
+        calls.append(1)
+        if len(calls) <= fail_times:
+            raise IOError("transient")
+        return "ok"
+
+    assert flaky(2) == "ok"
+    assert len(calls) == 3
+    calls.clear()
+    with pytest.raises(IOError):
+        flaky(99)
+    assert len(calls) == 3  # bounded
+
+
+def test_watchdog_aborts_stalled_region():
+    t0 = time.time()
+    with pytest.raises(fault.WatchdogTimeout):
+        with fault.watchdog(0.2):
+            time.sleep(5)
+    assert time.time() - t0 < 2.0
+
+
+def test_watchdog_noop_when_fast():
+    with fault.watchdog(5.0):
+        pass
+
+
+def test_watchdog_nesting_restores_outer_timer():
+    # an inner watchdog must not disarm the outer one (run_resilient's
+    # per-step watchdog nests around the kvstore barrier's)
+    t0 = time.time()
+    with pytest.raises(fault.WatchdogTimeout, match="outer"):
+        with fault.watchdog(0.4, "outer"):
+            with fault.watchdog(0.2):
+                pass  # fast inner region
+            time.sleep(5)  # outer deadline must still fire
+    assert time.time() - t0 < 2.0
+
+
+# ---------------------------------------------------------------------------
+# wired injection points
+# ---------------------------------------------------------------------------
+def test_engine_flush_injection_surfaces_at_wait_point():
+    a = mx.nd.array(np.ones(4))
+    b = a + 1
+    with fault.scope("engine.flush:1:ioerror"):
+        from incubator_mxnet_tpu.ops import segment
+        if segment.current_size() == 0:
+            pytest.skip("bulking disabled; nothing pending to flush")
+        with pytest.raises(IOError):
+            b.asnumpy()
+
+
+def test_kvstore_push_pull_injection():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.array(np.ones(4)))
+    with fault.scope("kvstore.push:1:ioerror"):
+        with pytest.raises(IOError):
+            kv.push("w", mx.nd.array(np.ones(4)))
+    out = mx.nd.array(np.zeros(4))
+    with fault.scope("kvstore.pull:1:timeout"):
+        with pytest.raises(TimeoutError):
+            kv.pull("w", out=out)
+
+
+# ---------------------------------------------------------------------------
+# PrefetchingIter worker failures
+# ---------------------------------------------------------------------------
+class _FlakyIter(mx.io.DataIter):
+    """Yields `n` batches; raises `exc` when the cursor reaches `fail_at`
+    (once per epoch unless `always`)."""
+
+    def __init__(self, n=6, fail_at=None, exc=IOError, always=False):
+        super().__init__(batch_size=2)
+        self.n, self.fail_at, self.exc, self.always = n, fail_at, exc, always
+        self.i = 0
+        self.fired = False
+
+    def reset(self):
+        self.i, self.fired = 0, False
+
+    def next(self):
+        if (self.fail_at is not None and self.i == self.fail_at
+                and (self.always or not self.fired)):
+            self.fired = True
+            raise self.exc(f"boom at {self.i}")
+        if self.i >= self.n:
+            raise StopIteration
+        self.i += 1
+        return mx.io.DataBatch(
+            data=[mx.nd.array(np.full((2, 3), self.i))], label=None)
+
+
+def test_prefetching_iter_reraises_worker_exception():
+    # a non-transient worker death must raise in the consumer, not end the
+    # epoch silently (the reference's thread just died)
+    it = mx.io.PrefetchingIter(_FlakyIter(fail_at=2, exc=ValueError,
+                                          always=True))
+    got = []
+    with pytest.raises(ValueError, match="boom"):
+        for batch in it:
+            got.append(batch)
+    assert len(got) == 2
+
+
+def test_prefetching_iter_restarts_on_transient_error():
+    # one transient IOError mid-epoch: bounded in-place restart delivers
+    # every remaining batch
+    it = mx.io.PrefetchingIter(_FlakyIter(n=6, fail_at=3, exc=IOError))
+    assert len(list(it)) == 6
+
+
+def test_prefetching_iter_transient_budget_exhausts():
+    it = mx.io.PrefetchingIter(_FlakyIter(n=6, fail_at=3, exc=IOError,
+                                          always=True), max_restarts=2)
+    with pytest.raises(IOError):
+        list(it)
+
+
+def test_prefetching_iter_normal_epoch_and_reset():
+    src = _FlakyIter(n=4)
+    it = mx.io.PrefetchingIter(src)
+    assert len(list(it)) == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_dataloader_fetch_retries_transient_error():
+    from incubator_mxnet_tpu.gluon.data import DataLoader, ArrayDataset
+    ds = ArrayDataset(np.arange(12, dtype=np.float32).reshape(6, 2))
+    loader = DataLoader(ds, batch_size=2)
+    with fault.scope("dataloader.fetch:2:ioerror"):  # transient: one hit
+        batches = list(loader)
+    assert len(batches) == 3
+
+
+def test_dataloader_stalled_worker_surfaces_timeout():
+    from incubator_mxnet_tpu.gluon.data import DataLoader
+
+    class _StallDataset:
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            if i == 2:
+                time.sleep(3)
+            return np.float32(i)
+
+    loader = DataLoader(_StallDataset(), batch_size=2, num_workers=1,
+                        timeout=0.5)
+    t0 = time.time()
+    with pytest.raises(mx.MXNetError, match="stalled"):
+        list(loader)
+    assert time.time() - t0 < 2.5  # surfaced, not hung on the worker join
+
+
+def test_estimator_resume_shortens_epoch_budget(tmp_path):
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.gluon.contrib.estimator import (
+        CheckpointHandler, Estimator)
+
+    def make():
+        net = nn.Dense(1, in_units=3)
+        net.initialize()
+        est = Estimator(net, gluon.loss.L2Loss())
+        return net, est
+
+    x = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 1).astype(np.float32)
+    data = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(mx.nd.array(x), mx.nd.array(y)),
+        batch_size=4)
+    d = str(tmp_path / "est")
+    _, est = make()
+    h = CheckpointHandler(d, epoch_period=1)
+    est.fit(data, epochs=2, event_handlers=[h])
+    assert os.path.exists(os.path.join(d, "model-epoch2.params.npz"))
+
+    # resume: 3-epoch budget minus the 2 already done = exactly 1 more
+    _, est2 = make()
+    h2 = CheckpointHandler(d, epoch_period=1, resume_from_checkpoint=True)
+    est2.fit(data, epochs=3, event_handlers=[h2])
+    assert est2._resume_epoch == 2
+    assert os.path.exists(os.path.join(d, "model-epoch3.params.npz"))
+    assert not os.path.exists(os.path.join(d, "model-epoch4.params.npz"))
+
+    # a later fit on the same estimator WITHOUT a resume handler must not
+    # inherit the stale resume offset (would silently train 0 epochs)
+    from incubator_mxnet_tpu.gluon.contrib.estimator import EpochEnd
+
+    class _Count(EpochEnd):
+        epochs = 0
+
+        def epoch_end(self, estimator, *args, **kwargs):
+            self.epochs += 1
+
+    counter = _Count()
+    est2.fit(data, epochs=1, event_handlers=[counter])
+    assert counter.epochs == 1
+
+
+# ---------------------------------------------------------------------------
+# run_resilient
+# ---------------------------------------------------------------------------
+def _mesh(devs, dp, tp):
+    from jax.sharding import Mesh
+    return Mesh(np.array(devs[:dp * tp]).reshape(dp, tp), ("dp", "tp"))
+
+
+def _sharded_state(mesh):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    w = (np.arange(32, dtype=np.float32).reshape(8, 4) + 1.0) / 10.0
+    return {"w": jax.device_put(w, NamedSharding(mesh, P("tp", None)))}
+
+
+def _step_fn(state, step):
+    import jax.numpy as jnp
+    w = state["w"]
+    loss = jnp.mean(w * w)
+    return {"w": w * 0.9 + 0.01}, loss
+
+
+def test_run_resilient_kill_resume_parity_same_and_halved_mesh(tmp_path):
+    import jax
+    from jax.sharding import PartitionSpec as P
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the forced 8-device mesh")
+    mesh8 = _mesh(devs, 4, 2)
+    state = _sharded_state(mesh8)
+
+    ref = fault.run_resilient(_step_fn, state, str(tmp_path / "ref"), 10,
+                              ckpt_every=3)
+    ref_w = np.asarray(ref.state["w"])
+
+    # crash (injected, deterministic) at the 6th step, then resume on the
+    # SAME mesh: final params must match the uninterrupted run exactly
+    d = str(tmp_path / "crash")
+    fault.install("resilient.step", "error", at=6)
+    with pytest.raises(fault.InjectedFault):
+        fault.run_resilient(_step_fn, state, d, 10, ckpt_every=3,
+                            max_step_retries=0)
+    fault.clear()
+    assert ckpt.latest_step(d) == 3  # last committed before the crash
+    resumed = fault.run_resilient(_step_fn, state, d, 10, ckpt_every=3)
+    assert resumed.resumed_from == 3
+    np.testing.assert_array_equal(np.asarray(resumed.state["w"]), ref_w)
+
+    # crash again, resume onto a HALVED mesh via rescale_sharded
+    d2 = str(tmp_path / "crash2")
+    fault.install("resilient.step", "error", at=6)
+    with pytest.raises(fault.InjectedFault):
+        fault.run_resilient(_step_fn, state, d2, 10, ckpt_every=3,
+                            max_step_retries=0)
+    fault.clear()
+    mesh4 = _mesh(devs, 2, 2)
+    resumed4 = fault.run_resilient(_step_fn, state, d2, 10, ckpt_every=3,
+                                   mesh=mesh4, specs={"w": P("tp", None)})
+    assert resumed4.resumed_from == 3
+    got = resumed4.state["w"]
+    assert got.sharding.mesh.devices.size == 4
+    np.testing.assert_array_equal(np.asarray(got), ref_w)
+
+
+def test_run_resilient_skips_nonfinite_loss(tmp_path):
+    import jax
+    state = _sharded_state(_mesh(jax.devices(), 1, 1))
+    fault.install("resilient.loss", "nan", at=2)
+    run = fault.run_resilient(_step_fn, state, str(tmp_path / "n"), 5,
+                              ckpt_every=100)
+    assert run.skipped_nonfinite == 1
+    # the poisoned step advanced the index but not the state: 4 real updates
+    w = np.asarray(state["w"])
+    for _ in range(4):
+        w = w * 0.9 + 0.01
+    np.testing.assert_allclose(np.asarray(run.state["w"]), w, rtol=1e-6)
+
+
+def test_run_resilient_watchdog_fires_on_stalled_step(tmp_path):
+    import jax
+    state = _sharded_state(_mesh(jax.devices(), 1, 1))
+    fault.install("resilient.step", "stall", at=2, arg=10)
+    t0 = time.time()
+    with pytest.raises(fault.WatchdogTimeout):
+        fault.run_resilient(_step_fn, state, str(tmp_path / "w"), 5,
+                            watchdog_seconds=0.3, max_step_retries=0)
+    assert time.time() - t0 < 5.0
+
+
+def test_run_resilient_step_retry_recovers(tmp_path):
+    import jax
+    state = _sharded_state(_mesh(jax.devices(), 1, 1))
+    fault.install("resilient.step", "ioerror", at=2)  # transient: one hit
+    run = fault.run_resilient(_step_fn, state, str(tmp_path / "r"), 4,
+                              ckpt_every=100, max_step_retries=2,
+                              retry_backoff=0.001)
+    assert run.step == 4
+    assert run.step_retries == 1
+
+
+def test_run_resilient_npz_mode_resume(tmp_path):
+    # host-local (non-orbax) state goes through the same manifest protocol
+    def step_fn(state, step):
+        w = np.asarray(state["w"].asnumpy()
+                       if hasattr(state["w"], "asnumpy") else state["w"])
+        return {"w": w * 0.5}, float(w.sum())
+
+    init = {"w": np.arange(6, dtype=np.float64)}
+    d = str(tmp_path / "npz")
+    fault.install("resilient.step", "error", at=4)
+    with pytest.raises(fault.InjectedFault):
+        fault.run_resilient(step_fn, init, d, 6, ckpt_every=2,
+                            sharded=False, max_step_retries=0)
+    fault.clear()
+    run = fault.run_resilient(step_fn, init, d, 6, ckpt_every=2,
+                              sharded=False)
+    assert run.resumed_from == 2
+    np.testing.assert_array_equal(run.state["w"],
+                                  np.arange(6, dtype=np.float64) * 0.5 ** 6)
+
+
+# ---------------------------------------------------------------------------
+# nightly: real SIGKILL via tools/crashtest.py
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_crashtest_sigkill_parity(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "crashtest.py"),
+         "--steps", "14", "--ckpt-every", "3", "--kill-at", "8",
+         "--dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=570,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "parity OK" in proc.stdout
