@@ -413,9 +413,15 @@ def _sweep_remat(prefix, variants, **bench_kwargs):
     if not results:
         raise RuntimeError(f"all {prefix} remat variants failed")
     best = max(results, key=results.get)
-    return {f"{prefix}_images_per_sec": results[best],
-            f"{prefix}_remat_choice": best,
-            f"{prefix}_by_remat": results}
+    out = {f"{prefix}_images_per_sec": results[best],
+           f"{prefix}_remat_choice": best,
+           f"{prefix}_by_remat": results}
+    # default-policy (remat=None) throughput at top level: the sweep max
+    # moves with whichever policy wins on the attached chip, so this row is
+    # the apples-to-apples number for round-over-round trend tracking
+    if "none" in results:
+        out[f"{prefix}_images_per_sec_default"] = results["none"]
+    return out
 
 
 def _phase_train32():

@@ -27,10 +27,22 @@ from .lists import BF16_FUNCS, FP32_FUNCS, WIDEST_TYPE_CASTS
 
 __all__ = ["init", "uninit", "is_active", "scale_loss", "unscale",
            "init_trainer", "convert_hybrid_block", "all_finite", "LossScaler",
-           "autocast", "amp_dtype_for"]
+           "autocast", "amp_dtype_for", "lists_version", "target_dtype"]
 
-_state = {"active": False, "target_dtype": "bfloat16"}
+_state = {"active": False, "target_dtype": "bfloat16", "version": 0}
 _tls = threading.local()
+
+
+def lists_version():
+    """Monotonic counter bumped whenever the AMP policy could change
+    (init/uninit, custom op lists, target dtype). The dispatch layer's
+    per-op-name policy cache (ops/registry.py) keys on it."""
+    return _state["version"]
+
+
+def target_dtype():
+    """The active autocast low-precision dtype name ('bfloat16'/'float16')."""
+    return _state["target_dtype"]
 
 
 def init(target_dtype="bfloat16", target_precision_ops=None,
@@ -41,6 +53,7 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
                          "is the TPU-native choice")
     _state["active"] = True
     _state["target_dtype"] = target_dtype
+    _state["version"] += 1
     if target_precision_ops:
         BF16_FUNCS.update(target_precision_ops)
     if fp32_ops:
@@ -49,6 +62,7 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
 
 def uninit():
     _state["active"] = False
+    _state["version"] += 1
 
 
 def is_active():

@@ -122,10 +122,13 @@ class Node:
     op as a function of its primals (vjp closures capture residuals as
     constants, so higher-order grads need a fresh jax.vjp through the tape).
 
-    Bulked (deferred) ops tape with `vjp_fn=None` plus the forward's stable
-    `key`: backward re-linearizes from the (still pending) primal inputs so
-    the vjp lands in the same bulked segment — recompute-based, XLA CSEs the
-    duplicated forward — one compiled program for the whole fwd+bwd chain.
+    Keyed ops — bulked (deferred) AND the immediate fast path (PR2) — tape
+    with `vjp_fn=None` plus the forward's stable `key`: backward
+    re-linearizes from the primal inputs via invoke under a derived
+    ("vjp", key, ...) identity, so the vjp lands in the same bulked segment
+    (recompute-based, XLA CSEs the duplicated forward — one compiled program
+    for the whole fwd+bwd chain) or, immediate, in a cached compiled VJP
+    kernel: repeat (key, avals) backwards never retrace in Python.
     """
 
     __slots__ = ("vjp_fn", "parents", "out_avals", "name", "fn", "inputs",
@@ -174,9 +177,15 @@ class Node:
                               key=kk)
         if self.fn is not None and (create_graph or self.vjp_fn is None):
             import jax
+            from .ops.segment import DISPATCH_STATS
             fn, n_in, single = self.fn, len(self.inputs), self.single_out
 
             def relinearized(*args):
+                # body runs when python actually (re)traces: once per
+                # (key, avals) through the compiled-kernel/replay caches,
+                # every call on the unkeyed fallback — the counter the
+                # no-retrace test watches
+                DISPATCH_STATS["vjp_trace"] += 1
                 primals, cs = args[:n_in], args[n_in:]
                 _, vjp = jax.vjp(fn, *primals)
                 return vjp(cs[0] if single else tuple(cs))

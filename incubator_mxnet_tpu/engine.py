@@ -26,7 +26,7 @@ from contextlib import contextmanager
 from .base import get_env
 
 __all__ = ["bulk", "set_bulk_size", "current_bulk_size", "effective_bulk_size",
-           "is_naive", "set_naive", "wait_for_all"]
+           "is_naive", "set_naive", "wait_for_all", "stats"]
 
 _bulk_size = [None]  # None = follow MXNET_ENGINE_BULK_SIZE
 
@@ -92,3 +92,12 @@ def set_naive(value):
 def wait_for_all():
     from .ndarray import waitall
     waitall()
+
+
+def stats(reset=False):
+    """Dispatch/bulking counters (PR2 observability): total invokes, bulked
+    vs immediate split, fast-path (compiled-kernel) hits, key/jit/vjp cache
+    hit rates, segment flushes and replay-cache reuse. Same dict as
+    `profiler.dispatch_stats()`; see docs/PERF.md for field meanings."""
+    from .ops.registry import dispatch_stats
+    return dispatch_stats(reset=reset)

@@ -294,18 +294,23 @@ def retrying(max_attempts=3, backoff=0.05, max_backoff=2.0,
 
     Every retry emits a structured log record on the `mxnet.fault` logger
     (event, point, attempt, error, sleep) and invokes
-    `on_retry(attempt, error)` when given. The final failure re-raises."""
+    `on_retry(attempt, error)` when given. The final failure re-raises.
+    `max_attempts` is clamped to ≥1 (attempts COUNT CALLS, not retries —
+    0 would silently return None without ever calling fn; call sites wire
+    user env vars like MXNET_DATALOADER_RETRIES straight in)."""
+    attempts = max(1, int(max_attempts))
+
     def deco(fn):
         label = name or getattr(fn, "__qualname__", repr(fn))
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             delay = backoff
-            for attempt in range(1, max_attempts + 1):
+            for attempt in range(1, attempts + 1):
                 try:
                     return fn(*args, **kwargs)
                 except retry_on as e:
-                    if attempt >= max_attempts:
+                    if attempt >= attempts:
                         _log_event("fault.retry_exhausted", point=label,
                                    attempts=attempt, error=repr(e))
                         raise

@@ -266,6 +266,7 @@ class PrefetchingIter(DataIter):
             except BaseException as e:  # re-raised in the consumer
                 self._queue.put(_WorkerFailure(e))
                 return
+            restarts = 0   # budget bounds CONSECUTIVE errors, not lifetime
             self._queue.put(batch)
 
     def _ensure_started(self):

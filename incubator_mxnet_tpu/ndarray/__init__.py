@@ -36,8 +36,30 @@ def _jnp():
     return jnp
 
 
+_jax_Array = None
+
+
 def _wrap(data, device=None):
-    """Wrap a raw jax/numpy array into an NDArray without copying."""
+    """Wrap a raw jax/numpy array into an NDArray without copying.
+
+    Fast constructor for the per-op dispatch path: every eager op output
+    comes through here, so the common case (a jax.Array staying on its
+    device) skips __init__'s isinstance/placement logic entirely and fills
+    the slots directly (≙ the reference's NDArray(handle) C-side ctor)."""
+    global _jax_Array
+    if _jax_Array is None:
+        import jax
+        _jax_Array = jax.Array
+    if device is None and isinstance(data, _jax_Array):
+        nd = NDArray.__new__(NDArray)
+        nd._entry = None
+        nd._var = None
+        nd._base = None
+        nd._base_index = None
+        nd._base_version = 0
+        nd._version = 0
+        nd._data = data
+        return nd
     return NDArray(data, device=device, _raw=True)
 
 
@@ -477,9 +499,14 @@ class NDArray:
         jfn = getattr(_jnp(), fname)
         if isinstance(other, NDArray) or isinstance(other, numeric_types) \
                 or isinstance(other, _np.ndarray):
+            # python scalars / numpy values pass through RAW: invoke handles
+            # them (segment const slots; jit traces them weak-typed exactly
+            # like the eager jnp call), and skipping the NDArray ctor saves
+            # a per-op host device_put — the single biggest cost of eager
+            # scalar arithmetic (PR2 dispatch bench). Weak typing also
+            # matches the reference's dtype-preserving scalar ops
+            # (bf16 array * 2.0 stays bf16).
             a, b = (other, self) if reflect else (self, other)
-            a = _as_nd(a)
-            b = _as_nd(b)
             return invoke(lambda x, y: jfn(x, y), (a, b), name=fname)
         return NotImplemented
 

@@ -297,12 +297,15 @@ class RowSparseNDArray(BaseSparseNDArray):
         raise MXNetError("row_sparse supports [:] read only (≙ reference)")
 
     def retain(self, indices):
-        """≙ sparse_retain: keep only the requested rows."""
+        """≙ sparse_retain: keep only the requested rows. The result's row
+        ids are sorted (and deduped) so it satisfies the strictly-increasing
+        indices invariant `check_format` enforces, whatever order the caller
+        requested them in."""
         want = _np.asarray(
             indices.asnumpy() if hasattr(indices, "asnumpy") else indices,
             _np.int64).ravel()
         pos = {r: i for i, r in enumerate(self._indices_np)}
-        keep = [r for r in want if r in pos]
+        keep = sorted({int(r) for r in want if r in pos})
         data = (self._data_np[[pos[r] for r in keep]] if keep
                 else _np.zeros((0,) + self._shape[1:], self._dtype))
         return RowSparseNDArray(data, _np.asarray(keep, _np.int64),

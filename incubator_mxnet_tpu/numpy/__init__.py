@@ -211,14 +211,32 @@ _pala_functional = _make_wrapper("put_along_axis")
 
 
 def put_along_axis(arr, indices, values, axis):
+    """TPU-native equivalent of np.put_along_axis — with a documented
+    divergence from numpy: it RETURNS the updated array instead of
+    returning None.
+
+    numpy mutates `arr` in place. jax buffers are immutable, so this
+    computes functionally (`jnp.put_along_axis(..., inplace=False)`) and
+    then writes the result back into `arr` ONLY when `arr` is an NDArray
+    (whose `[:] =` swaps the wrapped buffer). A raw numpy/jax array first
+    argument is NOT mutated — code ported from numpy that relies on the
+    in-place effect must use the returned array (a warning flags this
+    case).
+    """
+    if not isinstance(arr, NDArray):
+        import warnings
+        warnings.warn(
+            "mx.np.put_along_axis cannot mutate a non-NDArray first "
+            "argument in place (jax buffers are immutable); the input is "
+            "unchanged — use the RETURNED array (numpy's put_along_axis "
+            "returns None and mutates, so ported code silently diverges "
+            "here)", UserWarning, stacklevel=2)
     out = _pala_functional(arr, indices, values, axis, inplace=False)
     if isinstance(arr, NDArray):
         arr[:] = out
     return out
 
 
-put_along_axis.__doc__ = ("TPU-native equivalent of np.put_along_axis "
-                          "(functional core + in-place write-back).")
 register_op("np.put_along_axis", put_along_axis)
 
 

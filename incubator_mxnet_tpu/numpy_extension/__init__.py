@@ -15,8 +15,9 @@ import numpy as _onp
 
 from ..base import MXNetError, name_to_dtype
 from ..ndarray import NDArray, _as_nd, _wrap
-from ..ops.registry import invoke, register_op
+from ..ops.registry import invoke, register_op, get_op, record_key
 from ..ops import nn as _nn
+from ..ops import segment as _segment
 from .. import random as _grandom
 from .. import autograd as _autograd
 
@@ -34,17 +35,22 @@ __all__ = [
 ]
 
 
-def _unary(jfn, name):
+def _unary(jfn, name, amp="neutral"):
+    base_key = _segment.derive_key_cached(jfn)
+
     def fn(x, **kwargs):
         return invoke(functools.partial(jfn, **kwargs) if kwargs else jfn,
-                      (_as_nd(x),), name=name)
+                      (_as_nd(x),), name=name, op=info,
+                      key=record_key(base_key, kwargs))
     fn.__name__ = name
-    register_op("npx." + name, fn)
+    register_op("npx." + name, fn, amp=amp)
+    info = get_op("npx." + name)
     return fn
 
 
 def _make_nn(fname, name=None):
     f = getattr(_nn, fname)
+    base_key = _segment.derive_key_cached(f)
 
     def fn(*arrays, **kwargs):
         arrs = tuple(_as_nd(a) if not isinstance(a, NDArray) else a
@@ -54,9 +60,12 @@ def _make_nn(fname, name=None):
         kwargs = {k: (v._arr if isinstance(v, NDArray) else v)
                   for k, v in kwargs.items()}
         return invoke(functools.partial(f, **kwargs) if kwargs else f,
-                      arrs, name=name or fname)
+                      arrs, name=name or fname, op=info,
+                      key=record_key(base_key, kwargs))
     fn.__name__ = name or fname
-    register_op("npx." + (name or fname), fn)
+    register_op("npx." + (name or fname), fn,
+                amp=getattr(f, "_amp_class", "neutral"))
+    info = get_op("npx." + (name or fname))
     return fn
 
 
@@ -629,3 +638,24 @@ __all__ += ["sequence_last", "sequence_reverse", "box_iou", "box_nms",
             "roi_align", "bilinear_resize2d", "multibox_prior",
             "multibox_target", "multibox_detection", "proposal",
             "deformable_convolution", "psroi_pooling"]
+
+
+# Register the contrib/detection surface so the records exist for
+# introspection + apply_op dispatch, carrying the AMP classes tagged in
+# ops/contrib.py (PR2 dispatch-record metadata). The RAW kernels register —
+# they are pure jax functions, so apply_op dispatch tapes/bulks/jits
+# correctly; the python wrappers above (reference argument names,
+# detach/multi_out handling) stay the mx.npx call surface. Registering a
+# wrapper instead would re-enter invoke with tracer args at backward time
+# (`_as_nd(tracer)` device_put → TracerArrayConversionError).
+def _register_contrib_records():
+    from ..ops import contrib as _contrib
+    for _n in ("box_iou", "box_nms", "roi_align", "bilinear_resize2d",
+               "multibox_prior", "multibox_target", "multibox_detection",
+               "proposal", "deformable_convolution", "psroi_pooling"):
+        kern = getattr(_contrib, _n)
+        register_op("npx." + _n, kern,
+                    amp=getattr(kern, "_amp_class", "neutral"))
+
+
+_register_contrib_records()

@@ -260,8 +260,10 @@ def _nms_numpy(boxes, scores, max_per_class, iou_thr, score_thr):
     for b in range(B):
         for c in range(C):
             s = scores[b, c]
+            # >= : ONNX NonMaxSuppression keeps boxes AT the threshold
+            # (onnxruntime parity at the boundary; absent input = -inf)
             order = [int(i) for i in _np.argsort(-s, kind="stable")
-                     if s[i] > score_thr]
+                     if s[i] >= score_thr]
             kept = []
             for i in order:
                 if max_per_class >= 0 and len(kept) >= max_per_class:

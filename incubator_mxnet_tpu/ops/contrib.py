@@ -781,3 +781,19 @@ def _psroi_body(data, rois, spatial_scale, output_dim, pooled_size,
         return val.mean(axis=(2, 4))                   # (O,P,P)
 
     return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch-record metadata (PR2, same contract as ops/nn.py): AMP classes
+# for the contrib surface. Box/anchor/proposal coordinate math and pooled
+# sampling accumulate — pin fp32 under autocast; deformable conv is
+# MXU-bound like regular conv.
+# ---------------------------------------------------------------------------
+for _f, _cls in ((deformable_convolution, "safe"),
+                 (box_iou, "unsafe"), (box_nms, "unsafe"),
+                 (multibox_prior, "unsafe"), (multibox_target, "unsafe"),
+                 (multibox_detection, "unsafe"), (proposal, "unsafe"),
+                 (roi_align, "unsafe"), (psroi_pooling, "unsafe"),
+                 (bilinear_resize2d, "unsafe")):
+    _f._amp_class = _cls
+del _f, _cls

@@ -643,3 +643,25 @@ def scaled_dot_product_attention(q, k, v, mask=None, scale=None, causal=False):
         logits = jnp.where(mask, logits, jnp.full((), -1e30, logits.dtype))
     w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("...qk,...kd->...qd", w, v)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch-record metadata (PR2). `_amp_class` rides into the OpInfo record
+# at register_op time (ops/registry.py): the registering wrapper passes
+# amp=<class>, and invoke's policy lookup uses it for op names the
+# amp/lists.py name lists don't cover (the lists, including user overrides
+# via amp.init(...), always win when they know the name). 'safe' = run in
+# the autocast low-precision dtype (MXU-bound FLOPs), 'unsafe' = pin fp32
+# (accumulations / precision cliffs), untagged = 'neutral' (widest-type).
+# ---------------------------------------------------------------------------
+for _f, _cls in ((dense, "safe"), (conv, "safe"), (conv_transpose, "safe"),
+                 (scaled_dot_product_attention, "safe"),
+                 (lstm_cell, "safe"), (gru_cell, "safe"),
+                 (rnn_relu_cell, "safe"), (pooling, "safe"),
+                 (softmax, "unsafe"), (log_softmax, "unsafe"),
+                 (softmin, "unsafe"), (masked_softmax, "unsafe"),
+                 (batch_norm, "unsafe"), (layer_norm, "unsafe"),
+                 (group_norm, "unsafe"), (instance_norm, "unsafe"),
+                 (rms_norm, "unsafe"), (l2_normalize, "unsafe")):
+    _f._amp_class = _cls
+del _f, _cls

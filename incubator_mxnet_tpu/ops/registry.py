@@ -11,35 +11,114 @@ NDArrays and either
     the next materialization point, amortizing per-dispatch latency the way
     the reference's engine bulking does; or
   * runs the jax function immediately (NaiveEngine, bulking disabled, or the
-    op is not deferrable), where PJRT dispatch is already async.
+    op is not deferrable). PR2 fast path: keyed immediate dispatches go
+    through a per-key cache of `jax.jit`-compiled kernels — the eager analog
+    of the reference's CachedOp (cached_op.cc:665), so a bulking-disabled
+    loop pays one compiled-dispatch per op instead of an op-by-op jax eager
+    walk through fn's python body. Unkeyable or unjittable callables fall
+    back to the plain eager call (semantics preserved; the key is
+    blacklisted so the probe happens once).
 
-When autograd is recording, the tape node for a bulked op stores the forward
-callable + inputs and re-linearizes at backward time (`jax.vjp` inside the
-backward segment — recompute-based, XLA CSEs the duplicated forward); the
-immediate path captures a `jax.vjp` closure as before (≙ Imperative::RecordOp,
-imperative.cc:210).
+When autograd is recording, keyed ops (bulked OR immediate) tape the forward
+callable + inputs and re-linearize at backward time: the `jax.vjp` runs
+inside a cached compiled kernel keyed by (op key, single, n_in), so repeat
+(key, avals) backwards never retrace in Python (≙ CachedOp's cached backward
+graph). Unkeyed immediate ops capture a per-call `jax.vjp` closure as before
+(≙ Imperative::RecordOp, imperative.cc:210).
+
+Dispatch-stats counters live in segment.DISPATCH_STATS; read them via
+`dispatch_stats()` here, `profiler.dispatch_stats()`, or `engine.stats()`.
 """
 from __future__ import annotations
+
+import threading
+import types as _types
+from collections import OrderedDict
 
 import numpy as _np
 
 from .. import autograd
-from ..base import MXNetError
+from ..base import MXNetError, get_env
 from . import segment as _seg
 
 _OP_REGISTRY = {}
+_STATS = _seg.DISPATCH_STATS
+
+# Compiled immediate kernels: op key -> (jax.jit(fn), fn). The strong fn ref
+# pins identity-keyed callables so their ids cannot recycle (same contract as
+# the segment replay cache). Keys whose fn proves jit-hostile (trace errors)
+# land in _JIT_BAD and dispatch eagerly from then on. All three LRU caches
+# share one lock (get/move_to_end/popitem sequences are not atomic, and
+# DataLoader/prefetch worker threads dispatch concurrently with training);
+# kernel EXECUTION happens outside the lock.
+_cache_lock = threading.Lock()
+_JIT_CACHE_CAP = 1024
+_JIT_CACHE = OrderedDict()
+_JIT_BAD_CAP = 4096
+_JIT_BAD = OrderedDict()            # key -> True (LRU-capped set)
+# AMP-wrapped forward variants: (key, dtype, cast_pos) -> wrapped fn, so the
+# per-call closure allocation happens once per (op, autocast shape) instead
+# of every dispatch.
+_AMP_WRAP_CAP = 2048
+_AMP_WRAP_CACHE = OrderedDict()
+
+_jit_enabled_override = [None]      # None = follow MXNET_DISPATCH_JIT
+
+
+def _jit_enabled():
+    if _jit_enabled_override[0] is not None:
+        return _jit_enabled_override[0]
+    on = get_env("MXNET_DISPATCH_JIT", "1") not in ("0", "false")
+    _jit_enabled_override[0] = on    # snapshot; set_dispatch_jit() overrides
+    return on
+
+
+def set_dispatch_jit(flag):
+    """Toggle the compiled-kernel immediate fast path at runtime (knob for
+    debugging / A-B measurement; env: MXNET_DISPATCH_JIT). Returns previous
+    effective setting; pass None to re-read the env var."""
+    prev = _jit_enabled()
+    _jit_enabled_override[0] = None if flag is None else bool(flag)
+    return prev
+
+
+def dispatch_stats(reset=False):
+    """Snapshot of the dispatch counters (dispatch count, fast-path hits,
+    key/jit/vjp-cache hits, bulking-cache hits, flush count). Observable via
+    profiler.dispatch_stats() and engine.stats()."""
+    snap = dict(_STATS)
+    if reset:
+        for k in _STATS:
+            _STATS[k] = 0
+    return snap
 
 
 class OpInfo:
-    """Registry entry: name, callable, AMP behavior, docs (≙ nnvm::Op attrs)."""
+    """Registry entry ≙ nnvm::Op attrs — PR2: a slotted dispatch record.
 
-    __slots__ = ("name", "fn", "amp", "doc")
+    Built once at register_op time so call-time dispatch does no per-call
+    policy work: `key` is the stable bulking/jit-cache identity derived from
+    `fn`, and `amp` is the registration-declared AMP class ('safe' = run in
+    the autocast low-precision dtype, 'unsafe' = pin fp32, 'neutral' = no
+    class of its own — note the amp/lists.py name lists always take
+    precedence when they know the op name, whatever the class here).
+
+    `key` is only precomputed for callables whose key cannot drift
+    (closures/bound methods may rebind cells, so freezing their key at
+    registration would serve stale kernels — they derive per call instead,
+    same as the derive_key_cached memo policy)."""
+
+    __slots__ = ("name", "fn", "amp", "doc", "key")
 
     def __init__(self, name, fn, amp="neutral", doc=""):
         self.name = name
         self.fn = fn
-        self.amp = amp  # 'safe' (run bf16) | 'unsafe' (keep f32) | 'neutral'
+        self.amp = amp
         self.doc = doc
+        drift_free = not (
+            (isinstance(fn, _types.FunctionType) and fn.__closure__)
+            or isinstance(fn, _types.MethodType))
+        self.key = _seg.derive_key_cached(fn) if drift_free else None
 
 
 def register_op(name, fn=None, amp="neutral", doc=""):
@@ -63,41 +142,133 @@ def list_ops():
     return sorted(_OP_REGISTRY)
 
 
+def record_key(base_key, kwargs):
+    """Dispatch key for a record's precomputed base key + call kwargs —
+    exactly derive_key's `functools.partial` form (same tokens, so wrapper
+    call sites and apply_op share one kernel per (op, kwargs))."""
+    if base_key is None:
+        return None
+    if not kwargs:
+        return base_key
+    try:
+        return ("p", base_key, ("tuple", ()), _seg.canon(kwargs))
+    except _seg.Reject:
+        return None
+
+
 def apply_op(name, *args, **kwargs):
-    """Invoke a registered op by name on NDArray/array args."""
+    """Invoke a registered op by name on NDArray/array args. Uses the
+    record's precomputed key so keyword variants derive only the kwargs
+    part."""
     import functools
     info = get_op(name)
     fn = functools.partial(info.fn, **kwargs) if kwargs else info.fn
-    return invoke(fn, args, name=name)
+    return invoke(fn, args, name=name, key=record_key(info.key, kwargs),
+                  op=info)
 
 
-def _amp_dtype(name):
-    """AMP policy lookup (lazy import so amp stays optional)."""
-    import sys
-    amp_mod = sys.modules.get("incubator_mxnet_tpu.amp")
-    if amp_mod is None or not amp_mod.is_active():
+# ---------------------------------------------------------------------------
+# AMP resolution — name lists first (user overrides win), the record's
+# declared class only for names the lists don't know; both memoized
+# ---------------------------------------------------------------------------
+_amp_mod = [None]
+_amp_name_cache = {}                # name -> (lists_version, dtype-or-None)
+
+
+def _amp_dtype(name, op=None):
+    """AMP policy lookup (lazy import so amp stays optional).
+
+    Name lists first (so amp.init(fp32_ops=...) user overrides keep
+    winning), memoized per (name, lists version); the dispatch record's
+    registration-declared class covers ops the lists don't know."""
+    amp = _amp_mod[0]
+    if amp is None:
+        import sys
+        amp = sys.modules.get("incubator_mxnet_tpu.amp")
+        if amp is None:
+            return None
+        _amp_mod[0] = amp
+    if not amp.is_active():
         return None
-    return amp_mod.amp_dtype_for(name)
+    ver = amp.lists_version()
+    hit = _amp_name_cache.get(name)
+    if hit is None or hit[0] != ver:
+        hit = (ver, amp.amp_dtype_for(name))
+        _amp_name_cache[name] = hit
+    dt = hit[1]
+    if dt is None and op is not None and op.amp != "neutral":
+        return amp.target_dtype() if op.amp == "safe" else "float32"
+    return dt
 
 
 def _amp_cast(r, dtype):
-    import jax
-    import jax.numpy as jnp
-    if isinstance(r, (jax.Array, _np.ndarray)) and _is_float_dtype(r.dtype) \
+    if isinstance(r, (_jax.Array, _np.ndarray)) and _is_float_dtype(r.dtype) \
             and str(r.dtype) != dtype:
         return r.astype(dtype)
     return r
 
 
-def _amp_wrap(fn, dtype, cast_pos):
-    """Move the autocast inside the traced callable (bulked path): casts the
-    exact positions the eager `_amp_cast` loop would cast."""
+def _cast_positions(raw, amp_dt):
+    """Positions the eager autocast loop would cast (handles _LazyVal
+    placeholders on the bulked path; raw lazies are forced before the
+    immediate path uses this)."""
+    return tuple(
+        i for i, r in enumerate(raw)
+        if ((type(r) is _seg._LazyVal and _aval_is_float(r.aval)
+             and str(r.aval.dtype) != amp_dt)
+            or (isinstance(r, (_jax.Array, _np.ndarray))
+                and not (isinstance(r, _np.ndarray)
+                         and r.dtype == _jax.dtypes.float0)
+                and _is_float_dtype(r.dtype)
+                and str(r.dtype) != amp_dt)))
+
+
+def _amp_wrap(fn, k, dtype, cast_pos):
+    """Memoized autocast-inside-the-callable variant: casts the exact
+    positions the eager `_amp_cast` loop would cast. Cached per
+    (key, dtype, cast_pos) — equal keys imply identical computations, so
+    reusing the first-seen fn is the documented bulking contract."""
+    ck = (k, dtype, cast_pos)
+    with _cache_lock:
+        ent = _AMP_WRAP_CACHE.get(ck)
+        if ent is not None:
+            _AMP_WRAP_CACHE.move_to_end(ck)
+            _STATS["amp_wrap_cache_hit"] += 1
+            return ent
+        _STATS["amp_wrap_cache_miss"] += 1
+
     def wrapped(*xs):
         xs = list(xs)
         for i in cast_pos:
             xs[i] = xs[i].astype(dtype)
         return fn(*xs)
+
+    with _cache_lock:
+        _AMP_WRAP_CACHE[ck] = wrapped
+        while len(_AMP_WRAP_CACHE) > _AMP_WRAP_CAP:
+            _AMP_WRAP_CACHE.popitem(last=False)
     return wrapped
+
+
+# ---------------------------------------------------------------------------
+# lazy heavyweight imports — resolved once, then module-global fast lookups
+# ---------------------------------------------------------------------------
+_jax = None
+_Tracer = None
+_NDArray = None
+_wrap = None
+_wrap_lazy = None
+
+
+def _lazy_init():
+    global _jax, _Tracer, _NDArray, _wrap, _wrap_lazy
+    import jax
+    from ..ndarray import NDArray, _wrap as w, _wrap_lazy as wl
+    _jax = jax
+    _Tracer = jax.core.Tracer
+    _NDArray = NDArray
+    _wrap = w
+    _wrap_lazy = wl
 
 
 _engine_mod = None
@@ -127,8 +298,71 @@ def _aval_is_float(aval):
     return _is_float_dtype(aval.dtype)
 
 
+# ---------------------------------------------------------------------------
+# compiled-kernel cache (the eager CachedOp)
+# ---------------------------------------------------------------------------
+def _jit_for(k, fn):
+    """Cached jax.jit kernel for key k, or None when k is blacklisted."""
+    is_vjp = type(k) is tuple and k and k[0] in ("vjp", "cvjp")
+    with _cache_lock:
+        ent = _JIT_CACHE.get(k)
+        if ent is not None:
+            _JIT_CACHE.move_to_end(k)
+            _STATS["vjp_cache_hit" if is_vjp else "jit_cache_hit"] += 1
+            return ent[0]
+        if k in _JIT_BAD:
+            return None
+        _STATS["vjp_cache_miss" if is_vjp else "jit_cache_miss"] += 1
+        jfn = _jax.jit(fn)
+        _JIT_CACHE[k] = (jfn, fn)
+        while len(_JIT_CACHE) > _JIT_CACHE_CAP:
+            _JIT_CACHE.popitem(last=False)
+    return jfn
+
+
+def _trace_errors():
+    """Exception types that mean 'fn's python body cannot be traced' —
+    the only failures that justify blacklisting a key. Runtime/compile
+    failures (XlaRuntimeError, RESOURCE_EXHAUSTED, ...) may be transient
+    and must NOT permanently demote a hot op to the eager path."""
+    e = _jax.errors
+    return (TypeError, e.ConcretizationTypeError, e.TracerArrayConversionError,
+            e.TracerBoolConversionError, e.TracerIntegerConversionError,
+            e.UnexpectedTracerError, e.NonConcreteBooleanIndexError)
+
+
+def _run_immediate(fn, k, raw):
+    """Execute fn(*raw), through the compiled-kernel cache when keyed.
+
+    A failed jit call falls back to the plain eager call. Only when the
+    eager call SUCCEEDS and the jit failure was a trace error (untraceable
+    python, value-dependent shapes) is the key blacklisted; a genuine user
+    error re-raises with eager semantics, and transient runtime/compile
+    failures retry the kernel next call — neither can permanently disable
+    an op's fast path."""
+    if k is not None and k is not False and _jit_enabled():
+        jfn = _jit_for(k, fn)
+        if jfn is not None:
+            try:
+                out = jfn(*raw)
+                _STATS["fast_path"] += 1
+                return out
+            except Exception as jit_err:
+                _STATS["eager_fallback"] += 1
+                out = fn(*raw)          # user error re-raises right here
+                if isinstance(jit_err, _trace_errors()):
+                    with _cache_lock:   # eager worked: fn is jit-hostile
+                        _JIT_BAD[k] = True
+                        while len(_JIT_BAD) > _JIT_BAD_CAP:
+                            _JIT_BAD.popitem(last=False)
+                        _JIT_CACHE.pop(k, None)
+                return out
+    _STATS["eager_fallback"] += 1
+    return fn(*raw)
+
+
 def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False,
-           cached_vjp=None, key=None):
+           cached_vjp=None, key=None, op=None):
     """Execute `fn` on arrays, wrapping results and taping when recording.
 
     `fn` is a pure jax function of the array-positional args (static/scalar
@@ -142,22 +376,28 @@ def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False,
     recompute-based VJP compiled once per shape.
 
     key: optional stable identity key for the op (hashable). Enables the
-    bulking path even when `fn`'s identity cannot be derived automatically;
-    callers guarantee equal keys imply identical computations for
-    equal-shaped args. Pass key=False to force immediate dispatch (one-shot
-    callables that must never enter the bulking caches).
+    bulking path AND the immediate compiled-kernel fast path even when
+    `fn`'s identity cannot be derived automatically; callers guarantee equal
+    keys imply identical computations for equal-shaped args. Pass key=False
+    to force plain immediate dispatch (one-shot callables that must never
+    enter the dispatch caches).
+
+    op: optional OpInfo dispatch record (apply_op passes it); provides the
+    registration-declared AMP class without a name-list lookup.
     """
-    import jax
-    from ..ndarray import NDArray, _wrap, _wrap_lazy
+    if _jax is None:
+        _lazy_init()
+    _STATS["dispatch"] += 1
 
     raw = []
     tracked_any = False
     lazy_any = False
+    tracer_any = False
     parents = []
     for a in args:
-        if isinstance(a, NDArray):
+        if isinstance(a, _NDArray):
             if a._base is not None:
-                raw.append(a._arr)   # view: force refresh against its base
+                d = a._arr   # view: force refresh against its base
             else:
                 d = a._data
                 if type(d) is _seg._LazyVal:
@@ -165,7 +405,7 @@ def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False,
                         a._data = d = d.value
                     else:
                         lazy_any = True
-                raw.append(d)
+            raw.append(d)
             if a._var is not None:
                 parents.append(("var", a))
                 tracked_any = True
@@ -177,49 +417,51 @@ def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False,
         else:
             raw.append(a)
             parents.append(None)
+        if isinstance(d if isinstance(a, _NDArray) else a, _Tracer):
+            tracer_any = True
 
     if _vjp_tuple:
         inner = fn
         fn = lambda *xs: inner(tuple(xs))
 
-    amp_dt = _amp_dtype(name)
+    amp_dt = _amp_dtype(name, op)
     recording = autograd.is_recording() and tracked_any
     naive = _engine_naive()
 
     # ------------------------------------------------------------------
-    # bulked (deferred) path. Tracer args mean we're already inside someone
-    # else's trace (hybridize cache build, replay tracing, eval_shape) —
-    # compose into that trace via the immediate path instead of deferring.
+    # key resolution. Tracer args mean we're already inside someone else's
+    # trace (hybridize cache build, replay tracing, eval_shape) — compose
+    # into that trace via the plain immediate path instead of deferring or
+    # re-jitting.
     # ------------------------------------------------------------------
-    if key is not False and not naive and _seg.enabled() \
-            and not any(isinstance(r, jax.core.Tracer) for r in raw):
-        k = key if key is not None else _seg.derive_key(fn)
-        if k is not None:
-            bfn = fn
-            if amp_dt is not None:
-                cast_pos = tuple(
-                    i for i, r in enumerate(raw)
-                    if ((type(r) is _seg._LazyVal and _aval_is_float(r.aval)
-                         and str(r.aval.dtype) != amp_dt)
-                        or (isinstance(r, (jax.Array, _np.ndarray))
-                            and not (isinstance(r, _np.ndarray)
-                                     and r.dtype == jax.dtypes.float0)
-                            and _is_float_dtype(r.dtype)
-                            and str(r.dtype) != amp_dt)))
-                if cast_pos:
-                    bfn = _amp_wrap(fn, amp_dt, cast_pos)
-                k = (k, "amp", amp_dt, cast_pos)
-            res = _seg.enqueue(bfn, raw, k, name=name)
-            if res is not None:
-                treedef, lazies = res
-                return _finish_bulked(treedef, lazies, bfn, k, args, parents,
-                                      recording, cached_vjp, raw, name,
-                                      multi_out)
-        if lazy_any:
-            for i, r in enumerate(raw):
-                if type(r) is _seg._LazyVal:
-                    raw[i] = r.force()
-    elif lazy_any:
+    k = False
+    if key is not False and not tracer_any:
+        k = key if key is not None else _seg.derive_key_cached(fn)
+
+    # AMP autocast (≙ the reference's list-driven wrapper injection,
+    # amp/amp.py:105-176): keyed dispatches fold the casts into the
+    # dispatched callable once, here — the bulked path enqueues the wrapped
+    # variant and the immediate path compiles it, under the same amp-tagged
+    # key. Unkeyed dispatches cast eagerly per input (below). cast_pos from
+    # lazy avals stays valid after forcing: same args, same positions.
+    if amp_dt is not None and k is not None and k is not False:
+        cast_pos = _cast_positions(raw, amp_dt)
+        if cast_pos:
+            fn = _amp_wrap(fn, k, amp_dt, cast_pos)
+        k = (k, "amp", amp_dt, cast_pos)
+
+    # ------------------------------------------------------------------
+    # bulked (deferred) path
+    # ------------------------------------------------------------------
+    if k is not False and k is not None and not naive and _seg.enabled():
+        res = _seg.enqueue(fn, raw, k, name=name)
+        if res is not None:
+            _STATS["bulked"] += 1
+            treedef, lazies = res
+            return _finish_bulked(treedef, lazies, fn, k, args, parents,
+                                  recording, cached_vjp, raw, name,
+                                  multi_out)
+    if lazy_any:
         for i, r in enumerate(raw):
             if type(r) is _seg._LazyVal:
                 raw[i] = r.force()
@@ -227,15 +469,13 @@ def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False,
     # ------------------------------------------------------------------
     # immediate path
     # ------------------------------------------------------------------
-    # AMP autocast: cast float inputs per the op's list classification
-    # (≙ the reference's list-driven wrapper injection, amp/amp.py:105-176)
-    if amp_dt is not None:
+    if amp_dt is not None and (k is None or k is False):
         raw = [_amp_cast(r, amp_dt) for r in raw]
 
     if not recording:
-        out = fn(*raw)
+        out = _run_immediate(fn, k, raw)
         if naive:  # MXNET_ENGINE_TYPE=NaiveEngine: block per op
-            jax.block_until_ready(out)
+            _jax.block_until_ready(out)
         if isinstance(out, (tuple, list)):
             # None entries = symbolic-zero cotangents from a cached vjp
             # (non-differentiable slots); pass through unchanged
@@ -243,29 +483,49 @@ def invoke(fn, args, name="", multi_out=False, _vjp_tuple=False,
             return res if (multi_out or len(res) != 1) else res[0]
         return (_wrap(out),) if multi_out else _wrap(out)
 
+    tape_fn = None
+    fast_tape = False
     if cached_vjp is not None:
-        outs = fn(*raw)
+        outs = _run_immediate(fn, k, raw)
         raw_t = tuple(raw)
         tape_fn = lambda cts: cached_vjp(raw_t, tuple(cts))
+    elif k is not None and k is not False and _jit_enabled():
+        # fast recorded path: compiled forward now, re-linearize at backward
+        # time through the cached VJP kernel keyed by (op key, single, n_in)
+        # — no python jax.vjp retrace on repeat (key, avals) pairs. Same
+        # recompute-based taping contract as the bulked path (Node.key).
+        outs = _run_immediate(fn, k, raw)
+        fast_tape = True
     else:
-        outs, vjp_fn = jax.vjp(fn, *raw)
+        _STATS["vjp_trace"] += 1
+        outs, vjp_fn = _jax.vjp(fn, *raw)
     if naive:
-        jax.block_until_ready(outs)
+        _jax.block_until_ready(outs)
     single = not isinstance(outs, (tuple, list))
     outs_t = (outs,) if single else tuple(outs)
 
     any_float = any(_is_float_dtype(o.dtype) for o in outs_t)
     wrapped = tuple(_wrap(o) for o in outs_t)
     if any_float:
-        if cached_vjp is None:
-            if single:
-                tape_fn = lambda cts: vjp_fn(cts[0])
-            else:
-                tape_fn = lambda cts: vjp_fn(tuple(cts))
-        node = autograd.Node(tape_fn, parents,
-                             [(o.shape, o.dtype) for o in outs_t], name=name,
-                             fn=fn,
-                             inputs=tuple(args), single_out=single)
+        if fast_tape:
+            # keyed: tape for re-linearization (vjp_fn=None + key) exactly
+            # like a bulked op — apply_vjp routes backward through invoke,
+            # which serves it from the compiled-kernel cache
+            node = autograd.Node(None, parents,
+                                 [(o.shape, o.dtype) for o in outs_t],
+                                 name=name, fn=fn, inputs=tuple(args),
+                                 single_out=single, key=k,
+                                 inputs_raw=tuple(raw))
+        else:
+            if tape_fn is None:
+                if single:
+                    tape_fn = lambda cts: vjp_fn(cts[0])
+                else:
+                    tape_fn = lambda cts: vjp_fn(tuple(cts))
+            node = autograd.Node(tape_fn, parents,
+                                 [(o.shape, o.dtype) for o in outs_t],
+                                 name=name, fn=fn,
+                                 inputs=tuple(args), single_out=single)
         for i, w in enumerate(wrapped):
             w._entry = (node, i)
     if single and not multi_out:
@@ -277,7 +537,6 @@ def _finish_bulked(treedef, lazies, bfn, k, args, parents, recording,
                    cached_vjp, raw, name, multi_out):
     """Wrap a deferred op's lazy outputs and tape it when recording."""
     import jax.tree_util as jtu
-    from ..ndarray import _wrap_lazy
 
     single = treedef.num_leaves == 1 and jtu.treedef_is_leaf(treedef)
     wrapped = [_wrap_lazy(lv) for lv in lazies]

@@ -44,8 +44,30 @@ import numpy as _np
 
 from ..base import get_env
 
-__all__ = ["enabled", "enqueue", "derive_key", "flush_all", "current_size",
-           "Reject", "canon"]
+__all__ = ["enabled", "enqueue", "derive_key", "derive_key_cached",
+           "flush_all", "current_size", "Reject", "canon", "DISPATCH_STATS"]
+
+# Dispatch observability (ROADMAP open item 6): one flat counter dict shared
+# by the whole dispatch stack. segment.py owns it because it is the lowest
+# module in the ops dependency chain — registry.py (fast-path / key / vjp
+# counters) and this module (bulking-cache counters) both increment it, and
+# profiler.dispatch_stats() / engine.stats() read it. Plain int += under the
+# GIL: the counters are diagnostics, exact cross-thread interleaving does
+# not matter.
+DISPATCH_STATS = {
+    "dispatch": 0,            # total ops.registry.invoke() calls
+    "bulked": 0,              # invokes deferred into a Segment
+    "fast_path": 0,           # immediate invokes served by a cached compiled kernel
+    "eager_fallback": 0,      # immediate invokes executed op-by-op (unkeyed/unjittable)
+    "key_cache_hit": 0, "key_cache_miss": 0,       # derive_key memo
+    "jit_cache_hit": 0, "jit_cache_miss": 0,       # compiled immediate kernels
+    "vjp_cache_hit": 0, "vjp_cache_miss": 0,       # cached VJP kernels (backward)
+    "vjp_trace": 0,           # python-level jax.vjp (re)traces actually run
+    "amp_wrap_cache_hit": 0, "amp_wrap_cache_miss": 0,
+    "replay_cache_hit": 0, "replay_cache_miss": 0,  # bulked-segment replays
+    "aval_cache_hit": 0, "aval_cache_miss": 0,      # eval_shape memo
+    "segment_flush": 0,
+}
 
 _MAX_OPS_DEFAULT = 4096
 # Replay entries hold a jitted callable whose closure carries no array
@@ -75,7 +97,7 @@ def _jax_data_types():
 # key derivation
 # ---------------------------------------------------------------------------
 _HASHABLE_LEAVES = (type(None), bool, int, float, complex, str, bytes, type,
-                    _np.dtype, range, slice, frozenset)
+                    _np.dtype, range, frozenset)
 
 
 def canon(x):
@@ -90,6 +112,10 @@ def canon(x):
     if isinstance(x, _HASHABLE_LEAVES):
         return x
     tx = type(x)
+    if tx is slice:
+        # slice objects are unhashable before py3.12 — tokenize components
+        # (getitem/setitem closures carry them; this keeps slicing bulkable)
+        return ("sl", canon(x.start), canon(x.stop), canon(x.step))
     if tx in (tuple, list):
         return (tx.__name__, tuple(canon(v) for v in x))
     if tx is dict:
@@ -151,6 +177,50 @@ def derive_key(fn):
             return None
         return ("o", fn)
     return None
+
+
+# derive_key memo. Only plain functions WITHOUT closure cells are memoized:
+# their key (code, (), defaults) cannot drift (rebinding a cell must change
+# the key, so closures stay on the uncached path) and cannot reference fn
+# itself. Identity-keyed callables and builtins are deliberately NOT
+# memoized: their keys ("o", fn) / ("b", fn) strong-ref fn, and a WeakKey
+# entry whose value strong-refs its key is immortal — while deriving those
+# keys is a hash() away regardless. partials recurse so their (usually
+# module-level) .func hits the memo even though the partial itself is fresh
+# per call. Sentinel distinguishes "cached as unkeyable" from "not cached".
+_KEY_MEMO = weakref.WeakKeyDictionary()
+_NO_KEY = object()
+
+
+def _key_memoizable(fn):
+    return isinstance(fn, types.FunctionType) and not fn.__closure__
+
+
+def derive_key_cached(fn):
+    """derive_key with a WeakKey memo for drift-free callables."""
+    if isinstance(fn, functools.partial):
+        fk = derive_key_cached(fn.func)
+        if fk is None:
+            return None
+        try:
+            return ("p", fk, canon(fn.args), canon(fn.keywords))
+        except Reject:
+            return None
+    try:
+        k = _KEY_MEMO.get(fn)
+    except TypeError:        # unhashable callable
+        k = None
+    if k is not None:
+        DISPATCH_STATS["key_cache_hit"] += 1
+        return None if k is _NO_KEY else k
+    DISPATCH_STATS["key_cache_miss"] += 1
+    k = derive_key(fn)
+    if _key_memoizable(fn):
+        try:
+            _KEY_MEMO[fn] = _NO_KEY if k is None else k
+        except TypeError:
+            pass
+    return k
 
 
 # ---------------------------------------------------------------------------
@@ -228,6 +298,7 @@ class Segment:
         _maybe_clear_current(self)
         if not self.ops:
             return
+        DISPATCH_STATS["segment_flush"] += 1
         import jax
         import jax.tree_util as jtu
 
@@ -348,6 +419,9 @@ def _replay_cache_get(key):
     entry = _replay_cache.get(key)
     if entry is not None:
         _replay_cache.move_to_end(key)
+        DISPATCH_STATS["replay_cache_hit"] += 1
+    else:
+        DISPATCH_STATS["replay_cache_miss"] += 1
     return entry
 
 
@@ -513,6 +587,8 @@ def _enqueue_locked(seg, fn, raw, key, name):
 
     aval_key = (key, tuple(akeys))
     cached = _aval_cache.get(aval_key)
+    DISPATCH_STATS["aval_cache_hit" if cached is not None
+                   else "aval_cache_miss"] += 1
     if cached is None:
         import jax.tree_util as jtu
         _tls.suspended = getattr(_tls, "suspended", 0) + 1

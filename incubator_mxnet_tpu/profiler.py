@@ -22,7 +22,7 @@ from .base import MXNetError, get_env
 
 __all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
            "state", "Task", "Frame", "Event", "Counter", "Domain", "Marker",
-           "profiler_scope", "scope"]
+           "profiler_scope", "scope", "dispatch_stats"]
 
 _lock = threading.Lock()
 _events = []          # chrome trace events
@@ -107,6 +107,19 @@ def dump(finished=True, profile_process="worker", filename=None):
     with open(fname, "w") as f:
         json.dump(payload, f)
     return fname
+
+
+def dispatch_stats(reset=False):
+    """Counters from the eager dispatch layer (ops/registry + ops/segment):
+    dispatch count, bulked vs immediate split, fast-path (compiled kernel)
+    hits, key-cache / jit-cache / vjp-cache hits and misses, python
+    jax.vjp (re)trace count, segment flushes and replay-cache reuse.
+
+    Always on (plain int increments — no measurable dispatch cost), so it
+    works outside start()/stop() windows too. `reset=True` zeroes the
+    counters after the snapshot. See docs/PERF.md for field meanings."""
+    from .ops.registry import dispatch_stats as _ds
+    return _ds(reset=reset)
 
 
 def dumps(reset=False, format="table"):
