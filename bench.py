@@ -372,6 +372,33 @@ def bench_io_pipeline():
         return None
 
 
+def bench_serve():
+    """Serving-path trend row (subprocess: serve_bench forces CPU — the
+    metric is request-level host throughput, concurrency 32). Returns the
+    bench JSON dict or None."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            out = os.path.join(d, "serve.json")
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(here, "benchmark", "serve_bench.py"),
+                 "--quick", "--duration", "2.0", "--out", out],
+                capture_output=True, text=True, timeout=600, cwd=here,
+                env=env)
+            if r.returncode != 0:
+                return None
+            with open(out) as f:
+                return json.load(f)
+    except Exception:
+        return None
+
+
 def _log(msg):
     import time as _t
     print(f"[bench {_t.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
@@ -465,6 +492,25 @@ def _phase_io():
     return out
 
 
+def _phase_serve():
+    r = bench_serve()
+    if r is None:
+        return {}
+    out = {}
+    b = r.get("batched", {})
+    s = r.get("serial", {})
+    # requests/s + p50/p99 at concurrency 32: the serving trend row
+    if b.get("requests_per_sec"):
+        out["serve_requests_per_sec_c32"] = b["requests_per_sec"]
+        out["serve_p50_ms_c32"] = b.get("p50_ms")
+        out["serve_p99_ms_c32"] = b.get("p99_ms")
+    if s.get("requests_per_sec"):
+        out["serve_serial_requests_per_sec_c32"] = s["requests_per_sec"]
+    if r.get("speedup_vs_serial") is not None:
+        out["serve_speedup_vs_serial"] = r["speedup_vs_serial"]
+    return out
+
+
 def _phase_calib():
     tflops, probes = measure_attainable_tflops()
     return {"calib_attainable_bf16_tflops": tflops,
@@ -482,6 +528,7 @@ PHASES = [
     ("train128", _phase_train128),
     ("infer", _phase_infer),
     ("io", _phase_io),
+    ("serve", _phase_serve),
     ("calib", _phase_calib),
     ("xla_flops", _phase_xla_flops),
 ]

@@ -1,0 +1,238 @@
+"""Serving load generator: dynamic batching vs serial batch-1 serving.
+
+Measures the request-level throughput/latency win of `mx.serve`'s dynamic
+batcher over the capability the repo had before it — single-shot
+`ExportedModel.run` calls serialized one request at a time (the reference's
+c_predict_api contract: one predictor handle, one request, one forward).
+
+Both modes see the SAME closed-loop load: `--concurrency` client threads
+each submitting one sample at a time as fast as replies come back.
+
+  serial    one bs-1 exported program; requests execute one at a time
+            (lock-serialized, the pre-serve deployment story)
+  batched   serve.Server over power-of-two batch buckets: concurrent
+            requests coalesce into padded bucket batches, one compiled
+            program per bucket
+
+Model: ResNet-18 (thumbnail stem, NCHW, 32x32) exported per bucket; --quick
+swaps in a small MLP and shorter runs for the CI smoke. Writes a JSON
+artifact; the committed before/after pair lives in
+benchmark/results/serve_r07_{before,after}.json.
+
+Usage:
+  python benchmark/serve_bench.py                          # both modes, table + JSON
+  python benchmark/serve_bench.py --quick --out /tmp/s.json
+  python benchmark/serve_bench.py --modes serial           # baseline only
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Host-side serving benchmark: force CPU before jax initializes (same recipe
+# as dispatch_bench.py).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def _percentiles(lat_ms):
+    lat = sorted(lat_ms)
+    from incubator_mxnet_tpu.serve.metrics import percentile
+    out = {}
+    for q in (50, 95, 99):
+        v = percentile(lat, q)     # None when nothing completed in-window
+        out[f"p{q}_ms"] = round(v, 3) if v is not None else None
+    return out
+
+
+def _build_and_export(quick, workdir):
+    """Export the bench model once per bucket; returns (BucketedModel,
+    sample factory, bucket list)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import serve
+    from incubator_mxnet_tpu.gluon import nn
+
+    if quick:
+        buckets = [1, 2, 4, 8]
+        net = nn.HybridSequential()
+        net.add(nn.Dense(64, activation="relu", in_units=32),
+                nn.Dense(10))
+        net.initialize()
+        net.hybridize()
+        sample_shape = (32,)
+        name = "mlp"
+    else:
+        from incubator_mxnet_tpu.gluon.model_zoo import vision
+        buckets = [1, 2, 4, 8, 16, 32]
+        net = vision.resnet18_v1(classes=10, thumbnail=True)
+        net.initialize()
+        net.hybridize()
+        sample_shape = (3, 32, 32)
+        name = "resnet18"
+
+    model = serve.BucketedModel.export_block(
+        net, sample_shape, buckets, workdir, name=name)
+    rng = np.random.RandomState(7)
+    pool = [rng.rand(*sample_shape).astype(np.float32) for _ in range(64)]
+
+    def sample(i):
+        return pool[i % len(pool)]
+
+    return model, sample, buckets
+
+
+def _drive(submit_fn, sample, concurrency, duration_s, warmup_s=0.5):
+    """Closed-loop load: each client thread submits-and-waits in a loop.
+    Returns (completed, wall_s, latencies_ms, error_counts).
+
+    Only requests that start AND finish inside the measured window count —
+    warmup-started requests and in-flight stragglers completing after
+    stop would otherwise inflate requests/s (by up to `concurrency`
+    completions, double-digit percent at short durations) and pollute the
+    percentiles."""
+    stop = threading.Event()
+    lat_lock = threading.Lock()
+    lats, errors = [], {}
+    window = [float("inf"), float("-inf")]     # [start, end), set post-warmup
+
+    def client(tid):
+        i = tid
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                submit_fn(sample(i))
+            except Exception as e:
+                with lat_lock:
+                    k = type(e).__name__
+                    errors[k] = errors.get(k, 0) + 1
+                time.sleep(0.001)
+                continue
+            finally:
+                i += concurrency
+            t1 = time.perf_counter()
+            if t0 >= window[0] and t1 <= window[1]:
+                with lat_lock:
+                    lats.append((t1 - t0) * 1e3)
+
+    threads = [threading.Thread(target=client, args=(t,), daemon=True)
+               for t in range(concurrency)]
+    for t in threads:
+        t.start()
+    time.sleep(warmup_s)
+    t_start = time.perf_counter()
+    window[0] = t_start
+    window[1] = t_start + duration_s
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    return len(lats), duration_s, lats, errors
+
+
+def bench_serial(model_bs1, sample, concurrency, duration_s):
+    """Serial batch-1 serving: the pre-serve deployment path. One exported
+    bs-1 program, one request at a time (the predictor's single-shot
+    contract is not concurrent — a lock stands in for the request queue
+    callers would have to build themselves)."""
+    lock = threading.Lock()
+
+    def submit(x):
+        with lock:
+            return model_bs1.run(x[None])
+
+    model_bs1.warmup()
+    done, wall, lats, errors = _drive(submit, sample, concurrency, duration_s)
+    out = {"mode": "serial", "requests_per_sec": round(done / wall, 2),
+           "completed": done, "wall_s": round(wall, 2), "errors": errors}
+    out.update(_percentiles(lats))
+    return out
+
+
+def bench_batched(model, sample, concurrency, duration_s, batch_timeout_ms):
+    from incubator_mxnet_tpu import serve
+    with serve.Server(model, batch_timeout_ms=batch_timeout_ms,
+                      max_queue=max(256, 8 * concurrency)) as srv:
+        ccs_warm = model.compile_cache_size()
+
+        def submit(x):
+            return srv.predict(x, timeout=60)
+
+        done, wall, lats, errors = _drive(submit, sample, concurrency,
+                                          duration_s)
+        st = srv.stats()
+    out = {"mode": "batched", "requests_per_sec": round(done / wall, 2),
+           "completed": done, "wall_s": round(wall, 2), "errors": errors,
+           "batch_occupancy": st["batch_occupancy"],
+           "batches": st["batches"],
+           "programs_compiled": st["programs_compiled"],
+           "compile_cache_size_after_warmup": ccs_warm,
+           "compile_cache_size_final": st["compile_cache_size"],
+           "queue_depth_max": st["queue_depth_max"]}
+    out.update(_percentiles(lats))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small MLP + short runs (CI smoke)")
+    ap.add_argument("--concurrency", type=int, default=32)
+    ap.add_argument("--duration", type=float, default=None,
+                    help="seconds of measured load per mode")
+    ap.add_argument("--batch-timeout-ms", type=float, default=2.0)
+    ap.add_argument("--modes", default="serial,batched",
+                    help="comma list: serial,batched")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "results", "serve_bench.json"))
+    args = ap.parse_args()
+    duration = args.duration or (2.0 if args.quick else 10.0)
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+
+    with tempfile.TemporaryDirectory(prefix="serve_bench_") as d:
+        model, sample, buckets = _build_and_export(args.quick, d)
+        out = {"meta": {"bench": "serve_bench", "quick": bool(args.quick),
+                        "model": "mlp64" if args.quick
+                                 else "resnet18_thumb_32x32",
+                        "concurrency": args.concurrency,
+                        "duration_s": duration,
+                        "buckets": buckets,
+                        "batch_timeout_ms": args.batch_timeout_ms,
+                        "host_cores": os.cpu_count(),
+                        "platform": "cpu"}}
+        if "serial" in modes:
+            # bucket-1 artifact doubles as the serial baseline program
+            bs1 = model._models[1]
+            out["serial"] = bench_serial(bs1, sample, args.concurrency,
+                                         duration)
+            print(f"serial   {out['serial']['requests_per_sec']:>9.1f} req/s"
+                  f"  p50 {out['serial']['p50_ms']:.1f}ms"
+                  f"  p99 {out['serial']['p99_ms']:.1f}ms")
+        if "batched" in modes:
+            out["batched"] = bench_batched(model, sample, args.concurrency,
+                                           duration, args.batch_timeout_ms)
+            print(f"batched  {out['batched']['requests_per_sec']:>9.1f} req/s"
+                  f"  p50 {out['batched']['p50_ms']:.1f}ms"
+                  f"  p99 {out['batched']['p99_ms']:.1f}ms")
+        if "serial" in modes and "batched" in modes:
+            base = out["serial"]["requests_per_sec"]
+            out["speedup_vs_serial"] = round(
+                out["batched"]["requests_per_sec"] / base, 2) if base else None
+            print(f"dynamic batching speedup: {out['speedup_vs_serial']}x")
+
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,18 @@ class ExportedModel:
 
         model = ExportedModel("model-0000")      # or explicit paths
         out = model.run(x)                       # np.ndarray in, out
+
+    Concurrency contract (the reference predictor requires one handle per
+    thread; this one does not): `run`/`call_arrays` are safe to call from
+    any number of threads on a SHARED instance. The jitted call is a
+    compiled-program invocation on the PJRT client, which is thread-safe,
+    and `run` touches no mutable instance state after construction. The
+    only races are benign: concurrent FIRST calls may both enter tracing —
+    jax serializes compilation internally — so latency-sensitive servers
+    should `warmup()` once before going multi-threaded (serve.Server does).
+    `compile_cache_size()` exposes the jit cache entry count so callers can
+    assert the zero-retrace steady state (tests/test_serve.py holds this
+    contract under an 8-thread hammer).
     """
 
     def __init__(self, prefix=None, *, jaxport=None, params=None,
@@ -110,6 +122,25 @@ class ExportedModel:
     @property
     def output_arity(self):
         return self.n_out
+
+    @property
+    def batch_size(self):
+        """Leading dim of the first exported input (the batch bucket this
+        artifact serves; exports are static-shape programs)."""
+        return int(self.input_specs[0][0][0])
+
+    def warmup(self):
+        """Compile (and run once on zeros) ahead of traffic, so no serving
+        thread ever hits tracing. Returns self."""
+        self.run(*[_np.zeros(s, dtype=_np_dtype(d))
+                   for s, d in self.input_specs])
+        return self
+
+    def compile_cache_size(self):
+        """Entries in the jitted call's compile cache (1 after warmup; any
+        growth in steady state is a retrace). -1 when the running jax
+        version does not expose the counter."""
+        return int(getattr(self._call, "_cache_size", lambda: -1)())
 
     def _check_inputs(self, inputs):
         if len(inputs) != len(self.input_specs):

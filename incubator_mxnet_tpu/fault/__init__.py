@@ -74,6 +74,9 @@ POINTS = {
     "kvstore.collective": "cross-process collective sum (dist mode)",
     "engine.flush": "bulked-segment flush, before the XLA replay runs",
     "estimator.checkpoint": "gluon estimator CheckpointHandler save",
+    "serve.enqueue": "serve.Server.submit, before admission control",
+    "serve.execute": "serve batcher, before the bucketed program runs",
+    "serve.reply": "serve batcher, after execution / before futures resolve",
     "resilient.step": "run_resilient, inside the watchdog around step_fn",
     "resilient.loss": "run_resilient, applied to the returned loss "
                       "(nan kind poisons it)",

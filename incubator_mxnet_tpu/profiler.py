@@ -22,7 +22,7 @@ from .base import MXNetError, get_env
 
 __all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
            "state", "Task", "Frame", "Event", "Counter", "Domain", "Marker",
-           "profiler_scope", "scope", "dispatch_stats"]
+           "profiler_scope", "scope", "dispatch_stats", "serve_stats"]
 
 _lock = threading.Lock()
 _events = []          # chrome trace events
@@ -120,6 +120,17 @@ def dispatch_stats(reset=False):
     counters after the snapshot. See docs/PERF.md for field meanings."""
     from .ops.registry import dispatch_stats as _ds
     return _ds(reset=reset)
+
+
+def serve_stats(reset=False):
+    """Process-wide serving counters from mx.serve (requests, replies,
+    rejected/shed/timeouts, batches, padded rows, programs compiled) —
+    the serving analog of dispatch_stats(). Per-server latency percentiles
+    and the batch-occupancy histogram live on `Server.stats()`. Executed
+    batches also land in the Chrome trace as "serve.batch" events (cat
+    "serve") while the profiler runs — the serving lane."""
+    from .serve.metrics import serve_stats as _ss
+    return _ss(reset=reset)
 
 
 def dumps(reset=False, format="table"):

@@ -1,0 +1,50 @@
+"""mx.serve — dynamic-batching inference server over exported artifacts.
+
+The ROADMAP north star serves "heavy traffic from millions of users"; the
+deploy layer (deploy.py, ≙ the reference's c_predict_api.h predictor) stops
+at single-shot `ExportedModel.run`. This subsystem adds the request-level
+layer above it:
+
+  serve.Server          thread-safe bounded queue + dynamic batcher:
+                        concurrent requests coalesce into padded
+                        power-of-two batch buckets, execute through one
+                        compiled program per bucket, and split back to
+                        per-request futures
+  serve.BucketedModel   bucket -> ExportedModel map (+ `export_block` to
+                        produce the per-bucket artifact set from one block)
+  serve.CallableModel   the same contract over an in-process jax callable
+  serve.stats()         process-wide serving counters (also
+                        `profiler.serve_stats()`); per-server metrics —
+                        requests/s, p50/p95/p99 latency, batch-occupancy
+                        histogram, queue depth — via `Server.stats()`
+
+Overload behavior is explicit, not emergent: admission control bounds the
+queue (`MXNET_SERVE_MAX_QUEUE`), the overload policy picks reject-newest
+or shed-oldest (`MXNET_SERVE_OVERLOAD_POLICY`), per-request deadlines fail
+fast with typed errors (`MXNET_SERVE_DEADLINE_MS`), and the
+`serve.enqueue` / `serve.execute` / `serve.reply` fault points make every
+degraded path deterministically testable via `MXNET_FAULT_SPEC`. See
+docs/SERVING.md.
+"""
+from __future__ import annotations
+
+from ..base import _register_env
+from .batcher import (ServeError, QueueFullError, RequestTimeout,
+                      ServerClosed, BucketedModel, CallableModel, Server,
+                      pick_bucket)
+from .metrics import SERVE_STATS, ServeMetrics, serve_stats as stats
+
+__all__ = [
+    "Server", "BucketedModel", "CallableModel", "pick_bucket",
+    "ServeError", "QueueFullError", "RequestTimeout", "ServerClosed",
+    "ServeMetrics", "SERVE_STATS", "stats",
+]
+
+_register_env("MXNET_SERVE_MAX_QUEUE", int, 256,
+              "Bound on queued inference requests (admission control)")
+_register_env("MXNET_SERVE_BATCH_TIMEOUT_MS", float, 2.0,
+              "Max wait to fill a batch after its first request")
+_register_env("MXNET_SERVE_DEADLINE_MS", float, None,
+              "Default per-request queue deadline (unset = none)")
+_register_env("MXNET_SERVE_OVERLOAD_POLICY", str, "reject",
+              "Queue-full behavior: 'reject' (newest) or 'shed' (oldest)")

@@ -1,0 +1,482 @@
+"""Request queue + dynamic batcher over exported artifacts.
+
+Architecture (docs/SERVING.md):
+
+    submit() ──► bounded queue ──► batcher thread ──► bucketed program ──► futures
+                 (admission          (assembles          (ExportedModel
+                  control,            shape-bucket        per power-of-two
+                  backpressure)       batches)            batch bucket)
+
+One request is ONE sample (the exported input shape minus its leading batch
+dim). The batcher coalesces concurrent requests into a batch, rounds the
+batch up to the smallest available bucket (pad rows are zeros, sliced off
+before the reply), and executes it through the bucket's compiled program.
+Buckets are a small fixed set (powers of two by convention), so steady-state
+traffic re-executes a handful of compiled programs — zero retraces after
+warmup, observable via `compile_cache_size()` and the `programs_compiled`
+counter (the serving analog of the PR 2 dispatch/compile counters).
+
+Failure semantics are typed and fail-fast (MXNetError subclasses):
+`QueueFullError` for admission-control rejects and shed requests,
+`RequestTimeout` for missed deadlines, `ServerClosed` after shutdown.
+`mx.fault` injection points `serve.enqueue` / `serve.execute` /
+`serve.reply` wire the `MXNET_FAULT_SPEC` machinery through the three
+stages, so overload and fault behavior is testable deterministically.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as _np
+
+from ..base import MXNetError, get_env
+from ..deploy import _np_dtype
+from .. import fault as _fault
+from .metrics import ServeMetrics, SERVE_STATS
+
+__all__ = [
+    "ServeError", "QueueFullError", "RequestTimeout", "ServerClosed",
+    "BucketedModel", "CallableModel", "Server", "pick_bucket",
+]
+
+
+class ServeError(MXNetError):
+    """Base class for serving failures."""
+
+
+class QueueFullError(ServeError):
+    """Admission control failed the request: the queue was at capacity and
+    the overload policy rejected this request (`policy='reject'`) or shed it
+    after it was queued (`policy='shed'`)."""
+
+    def __init__(self, msg, policy="reject"):
+        super().__init__(msg)
+        self.policy = policy
+
+
+class RequestTimeout(ServeError):
+    """The request missed its deadline while waiting in the queue."""
+
+
+class ServerClosed(ServeError):
+    """submit() after close(), or the request was pending at a non-draining
+    shutdown."""
+
+
+def pick_bucket(n, buckets):
+    """Smallest bucket >= n, or None when n exceeds the largest bucket."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return None
+
+
+def _as_row(x):
+    a = _np.asarray(getattr(x, "asnumpy", lambda: x)())
+    return a
+
+
+# ---------------------------------------------------------------------------
+# model backends
+# ---------------------------------------------------------------------------
+class BucketedModel:
+    """A fixed set of batch-size buckets, each served by one ExportedModel.
+
+    The per-bucket artifacts come from exporting the SAME block at several
+    batch sizes (`export_block`), the deployment recipe for static-shape
+    programs: traffic is padded onto a small closed set of compiled
+    programs instead of compiling one program per observed batch size.
+    """
+
+    def __init__(self, models):
+        if not models:
+            raise ServeError("BucketedModel needs at least one model")
+        if not isinstance(models, dict):
+            models = {int(m.input_specs[0][0][0]): m for m in models}
+        self._models = dict(sorted(models.items()))
+        self.batch_sizes = list(self._models)
+        if any(b < 1 for b in self.batch_sizes):
+            raise ServeError(f"invalid batch buckets {self.batch_sizes}")
+        # row specs (per-sample shape/dtype) must agree across buckets
+        ref = self.row_specs
+        for b, m in self._models.items():
+            specs = [(tuple(s[1:]), d) for s, d in m.input_specs]
+            if specs != ref:
+                raise ServeError(
+                    f"bucket {b} row specs {specs} != bucket "
+                    f"{self.batch_sizes[0]} row specs {ref}")
+        m0 = self._models[self.batch_sizes[0]]
+        self.single_output = m0.single_output
+        self.n_out = m0.n_out
+
+    @classmethod
+    def from_prefixes(cls, prefixes):
+        """Load one exported artifact triple per bucket."""
+        from ..deploy import ExportedModel
+        return cls([ExportedModel(p) for p in prefixes])
+
+    @classmethod
+    def export_block(cls, block, sample_shape, buckets, directory,
+                     name="model", dtype="float32"):
+        """Export `block` once per bucket size and load the artifacts back.
+
+        `sample_shape` is the per-sample input shape (no batch dim).
+        Returns the BucketedModel over `<directory>/<name>-b<bucket>-0000`.
+        """
+        from .. import np as mxnp
+        from ..deploy import ExportedModel
+        models = {}
+        for b in sorted(set(int(x) for x in buckets)):
+            x = mxnp.zeros((b,) + tuple(sample_shape), dtype=dtype)
+            prefix = os.path.join(directory, f"{name}-b{b}")
+            block(x)   # shape inference at this batch size
+            block.export(prefix, example_inputs=x)
+            models[b] = ExportedModel(f"{prefix}-0000")
+        return cls(models)
+
+    @property
+    def num_inputs(self):
+        return self._models[self.batch_sizes[0]].num_inputs
+
+    @property
+    def row_specs(self):
+        """[(per-sample shape, dtype), ...] — input specs minus batch dim."""
+        m = self._models[self.batch_sizes[0]]
+        return [(tuple(s[1:]), d) for s, d in m.input_specs]
+
+    def run_batch(self, bucket, arrs):
+        """Execute the bucket's program; returns a tuple of stacked
+        outputs (leading dim == bucket)."""
+        out = self._models[bucket].run(*arrs)
+        return out if isinstance(out, tuple) else (out,)
+
+    def warmup(self):
+        for b, m in self._models.items():
+            m.warmup()
+
+    def compile_cache_size(self):
+        """Total compiled programs across buckets (-1 when unknown)."""
+        sizes = [m.compile_cache_size() for m in self._models.values()]
+        if any(s < 0 for s in sizes):
+            return -1
+        return sum(sizes)
+
+
+class CallableModel:
+    """Serve a plain callable (jax-traceable, params closed over) at a fixed
+    bucket set: `fn(*batched_arrays) -> array | tuple`. One `jax.jit`
+    wrapper; each bucket's shape compiles once into its cache (the same
+    small-closed-set contract as BucketedModel, without the export
+    round-trip — the in-process deployment path)."""
+
+    def __init__(self, fn, batch_sizes, row_specs, single_output=True):
+        import jax
+        self._jit = jax.jit(fn)
+        self.batch_sizes = sorted(int(b) for b in batch_sizes)
+        self.row_specs = [(tuple(s), str(d)) for s, d in row_specs]
+        self.single_output = bool(single_output)
+
+    @property
+    def num_inputs(self):
+        return len(self.row_specs)
+
+    def run_batch(self, bucket, arrs):
+        out = self._jit(*arrs)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return tuple(_np.asarray(o) for o in out)
+
+    def warmup(self):
+        for b in self.batch_sizes:
+            arrs = [_np.zeros((b,) + s, dtype=_np_dtype(d))
+                    for s, d in self.row_specs]
+            self.run_batch(b, arrs)
+
+    def compile_cache_size(self):
+        return int(getattr(self._jit, "_cache_size", lambda: -1)())
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+class _Request:
+    __slots__ = ("inputs", "future", "deadline", "t_submit")
+
+    def __init__(self, inputs, deadline):
+        self.inputs = inputs
+        self.future = Future()
+        self.deadline = deadline
+        self.t_submit = time.perf_counter()
+
+
+class Server:
+    """Thread-safe dynamic-batching server over a bucketed model.
+
+    ::
+
+        model = serve.BucketedModel.from_prefixes(["m-b1-0000", "m-b8-0000"])
+        with serve.Server(model, batch_timeout_ms=2.0) as srv:
+            fut = srv.submit(x_row)          # returns a Future
+            y = fut.result()
+            y = srv.predict(x_row)           # submit + wait
+
+    Knobs (constructor arg > MXNET_SERVE_* env > default):
+
+      max_queue          bound on queued requests (admission control)
+      batch_timeout_ms   max wait to fill a batch after its first request
+      default_deadline_ms  per-request queue deadline (None = no deadline)
+      overload_policy    'reject' (reject-newest, fail the submitter) or
+                         'shed' (shed-oldest, fail the oldest queued
+                         request and admit the new one)
+
+    Exactly one batcher thread executes batches, so the underlying jit call
+    never runs concurrently with itself; submit() is safe from any number
+    of threads.
+    """
+
+    def __init__(self, model, *, max_queue=None, batch_timeout_ms=None,
+                 default_deadline_ms=None, overload_policy=None,
+                 name="serve"):
+        from ..deploy import ExportedModel
+        if isinstance(model, ExportedModel):
+            model = BucketedModel([model])
+        elif isinstance(model, (dict, list)) :
+            model = BucketedModel(model)
+        self.model = model
+        self.name = name
+        self.max_queue = int(
+            max_queue if max_queue is not None
+            else get_env("MXNET_SERVE_MAX_QUEUE", 256, typ=int))
+        self.batch_timeout_s = float(
+            batch_timeout_ms if batch_timeout_ms is not None
+            else get_env("MXNET_SERVE_BATCH_TIMEOUT_MS", 2.0,
+                         typ=float)) / 1e3
+        dl = (default_deadline_ms if default_deadline_ms is not None
+              else get_env("MXNET_SERVE_DEADLINE_MS", typ=float))
+        self.default_deadline_s = None if dl is None else float(dl) / 1e3
+        self.overload_policy = (
+            overload_policy
+            or get_env("MXNET_SERVE_OVERLOAD_POLICY", "reject"))
+        if self.overload_policy not in ("reject", "shed"):
+            raise ServeError(
+                f"overload_policy must be 'reject' or 'shed', got "
+                f"{self.overload_policy!r}")
+        if self.max_queue < 1:
+            raise ServeError("max_queue must be >= 1")
+
+        self.metrics = ServeMetrics()
+        self._queue = deque()
+        self._cv = threading.Condition()
+        self._closing = False
+        self._drain = True
+        self._started = False
+        self._warm = set()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"{name}-batcher", daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, warmup=True):
+        """Compile every bucket's program (unless warmup=False), then start
+        the batcher thread. Warming up front keeps compilation out of the
+        serving path: steady state never retraces."""
+        if self._started:
+            return self
+        if warmup:
+            self.model.warmup()
+            for b in self.model.batch_sizes:
+                if b not in self._warm:
+                    self._warm.add(b)
+                    self.metrics.count("programs_compiled")
+        self._started = True
+        self._thread.start()
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def close(self, drain=True, timeout=30.0):
+        """Stop the batcher. `drain=True` serves the queued requests first;
+        `drain=False` fails them with ServerClosed."""
+        with self._cv:
+            if self._closing:
+                pending = []
+            else:
+                self._closing = True
+                self._drain = drain
+                pending = [] if drain else list(self._queue)
+                if not drain:
+                    self._queue.clear()
+            self._cv.notify_all()
+        for req in pending:
+            _fail(req, ServerClosed("server closed before execution"))
+        if self._started:
+            self._thread.join(timeout=timeout)
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- submission --------------------------------------------------------
+    def _check_row(self, inputs):
+        specs = self.model.row_specs
+        if len(inputs) != len(specs):
+            raise ServeError(
+                f"model takes {len(specs)} inputs, got {len(inputs)}")
+        rows = []
+        for i, (x, (shape, dtype)) in enumerate(zip(inputs, specs)):
+            a = _as_row(x)
+            if tuple(a.shape) != shape:
+                raise ServeError(
+                    f"input {i}: sample shape {tuple(a.shape)} != exported "
+                    f"row shape {shape} (one request = one sample)")
+            if str(a.dtype) != dtype:
+                a = a.astype(_np_dtype(dtype))   # bf16-aware
+            rows.append(a)
+        return tuple(rows)
+
+    def submit(self, *inputs, deadline_ms=None):
+        """Enqueue one sample; returns a `concurrent.futures.Future`.
+
+        Raises QueueFullError immediately when the queue is at capacity
+        under the reject-newest policy; under shed-oldest the OLDEST queued
+        request fails instead and this one is admitted. Raises ServerClosed
+        after close()."""
+        if not self._started:
+            raise ServeError("Server.start() (or `with Server(...)`) first")
+        rows = self._check_row(inputs)
+        _fault.inject("serve.enqueue")
+        dl = (deadline_ms / 1e3 if deadline_ms is not None
+              else self.default_deadline_s)
+        req = _Request(rows, None if dl is None
+                       else time.perf_counter() + dl)
+        shed_req = None
+        with self._cv:
+            if self._closing:
+                raise ServerClosed("server is closed")
+            if len(self._queue) >= self.max_queue:
+                if self.overload_policy == "reject":
+                    self.metrics.count("rejected")
+                    raise QueueFullError(
+                        f"queue full ({self.max_queue}); request rejected",
+                        policy="reject")
+                shed_req = self._queue.popleft()
+            self._queue.append(req)
+            depth = len(self._queue)
+            self._cv.notify()
+        self.metrics.count("requests")
+        self.metrics.set_queue_depth(depth)
+        if shed_req is not None:
+            self.metrics.count("shed")
+            _fail(shed_req, QueueFullError(
+                f"queue full ({self.max_queue}); oldest request shed",
+                policy="shed"))
+        return req.future
+
+    def predict(self, *inputs, timeout=None, deadline_ms=None):
+        """submit() + wait; returns the model output for this sample."""
+        return self.submit(*inputs, deadline_ms=deadline_ms).result(
+            timeout=timeout)
+
+    def stats(self):
+        """Metrics snapshot + compile accounting for the zero-retrace
+        assertion."""
+        out = self.metrics.snapshot()
+        out["buckets"] = list(self.model.batch_sizes)
+        out["compile_cache_size"] = self.model.compile_cache_size()
+        return out
+
+    # -- batcher thread ----------------------------------------------------
+    def _assemble(self):
+        """Pop the next batch (up to the largest bucket), honoring the
+        assembly timeout measured from the FIRST queued request."""
+        max_b = self.model.batch_sizes[-1]
+        with self._cv:
+            while not self._queue and not self._closing:
+                self._cv.wait()
+            if not self._queue:
+                return None          # closing and empty
+            t_end = self._queue[0].t_submit + self.batch_timeout_s
+            while (len(self._queue) < max_b and not self._closing):
+                remaining = t_end - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cv.wait(timeout=remaining)
+            batch = [self._queue.popleft()
+                     for _ in range(min(len(self._queue), max_b))]
+            depth = len(self._queue)
+        self.metrics.set_queue_depth(depth)
+        return batch, depth
+
+    def _loop(self):
+        while True:
+            got = self._assemble()
+            if got is None:
+                return
+            batch, depth = got
+            now = time.perf_counter()
+            live = []
+            for req in batch:
+                if req.deadline is not None and now > req.deadline:
+                    self.metrics.count("timeouts")
+                    _fail(req, RequestTimeout(
+                        "deadline expired after "
+                        f"{(now - req.t_submit) * 1e3:.1f}ms in queue"))
+                else:
+                    live.append(req)
+            if not live:
+                continue
+            self._execute(live, depth)
+
+    def _execute(self, batch, depth):
+        n = len(batch)
+        bucket = pick_bucket(n, self.model.batch_sizes)
+        if bucket is None:       # can't happen: assembly caps at max bucket
+            bucket = self.model.batch_sizes[-1]
+        if bucket not in self._warm:
+            self._warm.add(bucket)
+            self.metrics.count("programs_compiled")
+        t0 = time.perf_counter()
+        try:
+            _fault.inject("serve.execute")
+            arrs = []
+            for j, (shape, dtype) in enumerate(self.model.row_specs):
+                a = _np.zeros((bucket,) + shape, dtype=_np_dtype(dtype))
+                for i, req in enumerate(batch):
+                    a[i] = req.inputs[j]
+                arrs.append(a)
+            outs = self.model.run_batch(bucket, arrs)
+        except BaseException as e:
+            self.metrics.count("errors", n)
+            err = e if isinstance(e, MXNetError) else ServeError(
+                f"batch execution failed: {type(e).__name__}: {e}")
+            for req in batch:
+                _fail(req, err)
+            return
+        exec_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.observe_batch(bucket, n, exec_ms, depth)
+        try:
+            _fault.inject("serve.reply")
+        except BaseException as e:
+            self.metrics.count("errors", n)
+            err = e if isinstance(e, MXNetError) else ServeError(
+                f"reply failed: {type(e).__name__}: {e}")
+            for req in batch:
+                _fail(req, err)
+            return
+        done = time.perf_counter()
+        for i, req in enumerate(batch):
+            row = tuple(o[i] for o in outs)
+            if self.model.single_output:
+                row = row[0]
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_result(row)
+            self.metrics.count("replies")
+            self.metrics.observe_latency((done - req.t_submit) * 1e3)
+
+
+def _fail(req, exc):
+    if req.future.set_running_or_notify_cancel():
+        req.future.set_exception(exc)

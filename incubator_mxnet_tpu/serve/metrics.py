@@ -1,0 +1,139 @@
+"""Serving metrics: profiler-style counters, latency percentiles, batch
+occupancy, and a Chrome-trace lane.
+
+Two layers, mirroring the PR 2 dispatch-stats design (ops/segment.py
+DISPATCH_STATS):
+
+  * SERVE_STATS — one flat module-level counter dict aggregated across every
+    Server in the process, readable via `profiler.serve_stats()` (the
+    profiler-counter surface the reference exposes through MXProfile*
+    counters). Plain int += under the GIL: diagnostics, not accounting.
+  * ServeMetrics — per-Server instance metrics with the derived views the
+    counters cannot carry: latency p50/p95/p99 over a bounded reservoir,
+    a batch-occupancy histogram keyed by bucket, live queue depth, and
+    requests/s over the server's lifetime.
+
+Chrome-trace lane: each executed batch lands in the profiler event buffer
+(name "serve.batch", cat "serve") when the profiler is running, so
+`profiler.dump()` renders serving alongside op dispatch and the storage
+lane — the serving analog of the reference's per-worker device lanes.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+__all__ = ["SERVE_STATS", "ServeMetrics", "serve_stats", "percentile"]
+
+# Process-wide aggregate (all Server instances). Field meanings:
+#   requests        submitted (accepted into the queue)
+#   replies         futures resolved with a result
+#   rejected        admission-control failures (reject-newest policy)
+#   shed            queued requests dropped by the shed-oldest policy
+#   timeouts        requests failed for missing their deadline while queued
+#   errors          requests failed by an execution error
+#   batches         batch executions
+#   padded_rows     pad rows added to round batches up to their bucket
+#   programs_compiled  first-execution compiles (bucket warmups); steady
+#                      state MUST hold this flat (zero-retrace contract)
+SERVE_STATS = {
+    "requests": 0, "replies": 0, "rejected": 0, "shed": 0,
+    "timeouts": 0, "errors": 0, "batches": 0, "padded_rows": 0,
+    "programs_compiled": 0,
+}
+
+
+def serve_stats(reset=False):
+    """Snapshot of the process-wide serving counters (read via
+    `profiler.serve_stats()` or `mx.serve.stats()`)."""
+    snap = dict(SERVE_STATS)
+    if reset:
+        for k in SERVE_STATS:
+            SERVE_STATS[k] = 0
+    return snap
+
+
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile over an already-sorted list (no numpy needed
+    on the reply path)."""
+    if not sorted_vals:
+        return None
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class ServeMetrics:
+    """Per-Server metrics. All mutators take the internal lock; `snapshot`
+    returns plain data safe to json.dumps."""
+
+    LATENCY_WINDOW = 4096    # bounded reservoir: recent-request percentiles
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._lat_ms = deque(maxlen=self.LATENCY_WINDOW)
+        # bucket -> [batches, occupied_rows, padded_rows]
+        self._occupancy = {}
+        self.counters = {k: 0 for k in SERVE_STATS}
+        self.queue_depth = 0
+        self.queue_depth_max = 0
+
+    def count(self, key, n=1):
+        with self._lock:
+            self.counters[key] += n
+        SERVE_STATS[key] += n
+
+    def set_queue_depth(self, depth):
+        with self._lock:
+            self.queue_depth = depth
+            if depth > self.queue_depth_max:
+                self.queue_depth_max = depth
+
+    def observe_batch(self, bucket, occupancy, exec_ms, queue_depth):
+        """One executed batch: occupancy rows served out of `bucket` slots."""
+        pad = bucket - occupancy
+        with self._lock:
+            self.counters["batches"] += 1
+            self.counters["padded_rows"] += pad
+            row = self._occupancy.setdefault(bucket, [0, 0, 0])
+            row[0] += 1
+            row[1] += occupancy
+            row[2] += pad
+            self.queue_depth = queue_depth
+            if queue_depth > self.queue_depth_max:
+                self.queue_depth_max = queue_depth
+        SERVE_STATS["batches"] += 1
+        SERVE_STATS["padded_rows"] += pad
+        # Chrome-trace lane (no-op unless the profiler is running)
+        from .. import profiler
+        profiler.record_event(
+            "serve.batch", "serve", exec_ms * 1000.0,
+            args={"bucket": bucket, "occupancy": occupancy,
+                  "queue_depth": queue_depth})
+
+    def observe_latency(self, ms):
+        with self._lock:
+            self._lat_ms.append(ms)
+
+    def snapshot(self):
+        with self._lock:
+            lat = sorted(self._lat_ms)
+            elapsed = time.perf_counter() - self._t0
+            counters = dict(self.counters)
+            occ = {b: {"batches": r[0], "rows": r[1], "padded": r[2],
+                       "mean_occupancy": round(r[1] / (r[0] * b), 4)}
+                   for b, r in sorted(self._occupancy.items())}
+            depth, depth_max = self.queue_depth, self.queue_depth_max
+        out = dict(counters)
+        out["queue_depth"] = depth
+        out["queue_depth_max"] = depth_max
+        out["batch_occupancy"] = occ
+        out["elapsed_s"] = round(elapsed, 3)
+        out["requests_per_sec"] = round(
+            counters["replies"] / elapsed, 2) if elapsed > 0 else 0.0
+        for q in (50, 95, 99):
+            v = percentile(lat, q)
+            out[f"p{q}_ms"] = round(v, 3) if v is not None else None
+        return out

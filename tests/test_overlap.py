@@ -1,0 +1,53 @@
+"""Dist-overlap measurement (VERDICT Next #5): the bucketed-allreduce /
+backward interleave hides a measurable fraction of comm on the 8-CPU
+virtual mesh.
+
+Runs benchmark/overlap_bench.py --quick in a fresh process (clean XLA pool,
+no interference from the rest of the suite's device state) and asserts the
+hidden-comm fraction is positive — the claim the committed artifact
+benchmark/results/overlap_r07_cpu8.json records for the full run.
+"""
+import json
+import os
+import subprocess
+import sys
+
+
+def test_overlap_bench_hidden_comm_positive(tmp_path):
+    out = tmp_path / "overlap_quick.json"
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmark", "overlap_bench.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the bench sets its own 8-device flag when absent; the conftest may
+    # already have set it in this env — both paths give 8 devices
+    r = subprocess.run(
+        [sys.executable, script, "--quick", "--out", str(out)],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    data = json.loads(out.read_text())
+    assert data["meta"]["devices"] == 8
+    ov = data["overlap"]
+    assert ov["backward_ms"] > 0 and ov["comm_ms"] > 0
+    # the event-based hidden fraction: some of the bucketed reduction
+    # provably executed while the async-dispatched backward was still in
+    # flight. Were dispatch synchronous, this would be exactly 0.
+    assert ov["hidden_comm_fraction"] > 0.0, ov
+    assert len(ov["trials"]) >= 3
+    # wall-clock deltas ride along (noise-bounded on a 2-core host; no
+    # assertion beyond presence)
+    assert "wallclock_hidden_fraction" in ov
+
+
+def test_committed_overlap_artifact_retires_loopback_numbers():
+    """The r7 artifact exists, carries the per-bucket timeline, and its
+    measured hidden fraction is positive (the loopback bandwidth file it
+    retires had no overlap measurement at all)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmark", "results",
+        "overlap_r07_cpu8.json")
+    data = json.load(open(path))
+    assert data["overlap"]["hidden_comm_fraction"] > 0
+    tl = data["bucketed_allreduce"]["per_bucket_timeline"]
+    assert len(tl) == data["bucketed_allreduce"]["n_buckets"]
+    assert all(row["ms"] > 0 for row in tl)
