@@ -372,6 +372,27 @@ def bench_io_pipeline():
         return None
 
 
+def bench_input_pipeline():
+    """Input-pipeline overlap trend row (subprocess: CPU-forced jax; see
+    benchmark/io_bench.py --overlap). Measures the device-feed's
+    steady-state step time against max(data, compute) and the event-based
+    hidden-input fraction. Returns the bench JSON dict or None."""
+    import os
+    import subprocess
+    import sys
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(here, "benchmark", "io_bench.py"),
+             "--overlap"],
+            capture_output=True, text=True, timeout=600, cwd=here)
+        line = r.stdout.strip().splitlines()[-1]
+        data = json.loads(line)
+        return data if "device_fed_step_ms" in data else None
+    except Exception:
+        return None
+
+
 def bench_serve():
     """Serving-path trend row (subprocess: serve_bench forces CPU — the
     metric is request-level host throughput, concurrency 32). Returns the
@@ -492,6 +513,23 @@ def _phase_io():
     return out
 
 
+def _phase_input_pipeline():
+    r = bench_input_pipeline()
+    if r is None:
+        return {}
+    out = {"input_pipeline_step_ms": r["device_fed_step_ms"],
+           "input_pipeline_host_fed_step_ms": r["host_fed_step_ms"],
+           # ≤1.15 is the ISSUE-4 overlap target on the augment-heavy
+           # synthetic pipeline (vs ≈ serial sum without the feed)
+           "input_pipeline_vs_max": r["device_fed_vs_max"],
+           "input_pipeline_host_fed_vs_sum": r["host_fed_vs_sum"],
+           "input_pipeline_overlap_fraction": r["hidden_input_fraction"],
+           "input_pipeline_speedup": r["speedup_vs_host_fed"]}
+    for k in ("data_ms", "compute_ms"):
+        out[f"input_pipeline_{k}"] = r[k]
+    return out
+
+
 def _phase_serve():
     r = bench_serve()
     if r is None:
@@ -528,6 +566,7 @@ PHASES = [
     ("train128", _phase_train128),
     ("infer", _phase_infer),
     ("io", _phase_io),
+    ("input_pipeline", _phase_input_pipeline),
     ("serve", _phase_serve),
     ("calib", _phase_calib),
     ("xla_flops", _phase_xla_flops),

@@ -2,12 +2,30 @@
 measurement: ImageRecordIter ~3000 img/s with a full decode+augment
 pipeline, docs/.../note_data_loading.md:181).
 
-Synthesizes a .rec of realistic JPEGs once (256px shorter side), then
-measures ImageRecordIter end-to-end: threaded C++ JPEG decode + shorter-
-side resize + random crop 224 + mirror + mean/std normalize + contiguous
-NHWC batch. Prints one JSON line.
+Default mode synthesizes a .rec of realistic JPEGs once (256px shorter
+side), then measures ImageRecordIter end-to-end: threaded C++ JPEG decode +
+shorter-side resize + random crop 224 + mirror + mean/std normalize +
+contiguous NHWC batch. Prints one JSON line.
 
-Usage: python benchmark/io_bench.py [--n 768] [--batch 128] [--threads 0]
+`--overlap` measures the INPUT-PIPELINE OVERLAP `io.DeviceFeed` provides,
+directly: a synthetic augment-heavy pipeline (RNG sample + a chain of
+elementwise host transforms per batch) feeds a jitted train-step proxy with
+a per-step host sync (the "user reads the loss" loop). Four measures per
+trial — data_ms (pipeline alone), compute_ms (pre-staged batch),
+host_fed_step_ms (fetch→step serially: pays data+compute), and
+device_fed_step_ms (through DeviceFeed: the feeder preps+transfers batch
+N+1 while batch N computes) — plus the event-based hidden-input fraction
+from `profiler.feed_stats()` stall accounting, which is stable where the
+wall-clock ratio wobbles on a shared-core host (same convention as
+overlap_bench.py). By default the XLA CPU pool is spun up while the
+process is affinity-restricted to one cpu (`--no-pin` disables), so
+"compute_ms" means the same thing alone and under the feed — the
+shared-core-host analog of a dedicated accelerator.
+
+Usage:
+  python benchmark/io_bench.py [--n 768] [--batch 128] [--threads 0]
+  python benchmark/io_bench.py --overlap [--quick] [--depth 2]
+                               [--pair-out results/feed_r08] [--no-pin]
 """
 import argparse
 import io
@@ -84,13 +102,253 @@ def bench(rec_path, batch_size, threads, epochs=2):
     return total / dt, native, dt, stages
 
 
+# ---------------------------------------------------------------------------
+# --overlap: device-feed overlap measurement (ISSUE 4 acceptance artifact)
+# ---------------------------------------------------------------------------
+def _median(vals):
+    vals = sorted(vals)
+    return vals[len(vals) // 2]
+
+
+def bench_overlap(quick=False, depth=2, trials=None, steps=None,
+                  pin=True):
+    """Steady-state per-step wall time of an augment-heavy pipeline, host-fed
+    vs device-fed. Per-step medians inside each trial, median trial across
+    `trials` (this box's XLA step time wobbles ±15% run to run).
+
+    `pin=True` (default): the process affinity is restricted to ONE cpu
+    while the XLA CPU client spins up its thread pool, then restored — the
+    pool stays effectively single-core, so `compute_ms` means the same
+    thing measured alone and under the feed (the shared-core-host analog
+    of a dedicated accelerator; without it the idle measurement borrows
+    the feeder's core and the comparison is apples-to-oranges)."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import profiler
+    from incubator_mxnet_tpu.io import DeviceFeed
+
+    if quick:
+        B, D, AUG, COMP = 128, 512, 8, 6
+        steps = steps or 6
+        trials = trials or 2
+    else:
+        B, D, AUG, COMP = 256, 1024, 45, 14
+        steps = steps or 12
+        trials = trials or 5
+
+    class AugmentPipeline:
+        """Synthetic augment-heavy host pipeline: per batch, an RNG sample
+        (decode stand-in) + AUG chained elementwise transforms (augment).
+        Pure numpy — releases the GIL, so a feeder thread can run it while
+        the consumer's step computes."""
+
+        def __init__(self, n):
+            self.n = n
+
+        def __len__(self):
+            return self.n
+
+        def __iter__(self):
+            rng = np.random.RandomState(42)
+            for _ in range(self.n):
+                x = rng.standard_normal((B, D)).astype(np.float32)
+                for _ in range(AUG):
+                    x = np.sin(x) * 1.1 + np.cos(0.5 * x)
+                yield x
+
+    restore_affinity = None
+    if pin and hasattr(os, "sched_setaffinity"):
+        orig = os.sched_getaffinity(0)
+        if len(orig) > 1:
+            os.sched_setaffinity(0, {sorted(orig)[0]})
+            restore_affinity = orig
+
+    W = jnp.asarray(np.random.RandomState(0)
+                    .standard_normal((D, D)).astype(np.float32) * 0.04)
+
+    @jax.jit
+    def train_step(x, w):
+        y = x
+        for _ in range(COMP):
+            y = jnp.tanh(y @ w)
+        return y.sum()
+
+    dev = jax.devices()[0]
+    # force client + thread-pool creation (and the compile) while pinned,
+    # then give the feeder its core back
+    float(train_step(jax.device_put(
+        np.zeros((B, D), np.float32), dev), W))
+    if restore_affinity is not None:
+        os.sched_setaffinity(0, restore_affinity)
+
+    def _timed_loop(batch_iter, consume):
+        """Per-step wall time INCLUDING the fetch — the loop a real
+        training epoch runs (fetch batch, step, read the loss)."""
+        it = iter(batch_iter)
+        ts = []
+        while True:
+            t0 = time.perf_counter()
+            x = next(it, None)
+            if x is None:
+                break
+            consume(x)
+            ts.append(time.perf_counter() - t0)
+        return ts
+
+    rows = []
+    for _ in range(trials):
+        # 1. data: the host pipeline alone, per-batch
+        it = iter(AugmentPipeline(steps))
+        next(it)                                     # warm (allocator, rng)
+        ts = []
+        while True:
+            t0 = time.perf_counter()
+            x = next(it, None)
+            if x is None:
+                break
+            ts.append(time.perf_counter() - t0)
+        data_ms = _median(ts) * 1e3
+
+        # 2. compute: pre-staged device batch, per-step host sync
+        xd = jax.device_put(next(iter(AugmentPipeline(1))), dev)
+        float(train_step(xd, W))                     # compile + warm
+        ts = [0.0] * steps
+        for i in range(steps):
+            t0 = time.perf_counter()
+            float(train_step(xd, W))
+            ts[i] = time.perf_counter() - t0
+        comp_ms = _median(ts) * 1e3
+
+        # 3. host-fed (before): fetch -> step -> sync, strictly serial
+        ts = _timed_loop(AugmentPipeline(steps + 1),
+                         lambda x: float(train_step(x, W)))
+        host_ms = _median(ts[1:]) * 1e3              # drop the cold step
+
+        # 4. device-fed (after): DeviceFeed preps + transfers batch N+1
+        #    while batch N computes
+        profiler.feed_stats(reset=True)
+        feed = DeviceFeed(AugmentPipeline(steps + 1), depth=depth)
+        ts = _timed_loop(feed, lambda b: float(train_step(b._arr, W)))
+        dev_ms = _median(ts[1:]) * 1e3
+        fs = profiler.feed_stats()
+        consumed = max(fs["batches_consumed"] - 1, 1)
+        hidden = 1.0 - fs["stall_data_us"] / (consumed * data_ms * 1e3)
+        rows.append({
+            "data_ms": round(data_ms, 2),
+            "compute_ms": round(comp_ms, 2),
+            "host_fed_step_ms": round(host_ms, 2),
+            "device_fed_step_ms": round(dev_ms, 2),
+            "hidden_input_fraction": round(min(max(hidden, 0.0), 1.0), 4),
+            "feed_occupancy_mean": round(fs["occupancy_mean"], 2),
+        })
+
+    def _med_key(key):
+        return _median([r[key] for r in rows])
+
+    data_ms = _med_key("data_ms")
+    comp_ms = _med_key("compute_ms")
+    host_ms = _med_key("host_fed_step_ms")
+    dev_ms = _med_key("device_fed_step_ms")
+    mx_ms = max(data_ms, comp_ms)
+    out = {
+        "metric": "input_pipeline_device_fed_step_ms",
+        "value": round(dev_ms, 2),
+        "unit": "ms/step",
+        "data_ms": data_ms,
+        "compute_ms": comp_ms,
+        "host_fed_step_ms": host_ms,
+        "device_fed_step_ms": dev_ms,
+        "serial_sum_ms": round(data_ms + comp_ms, 2),
+        "max_ms": round(mx_ms, 2),
+        # acceptance metric: device-fed steady state vs max(data, compute)
+        "device_fed_vs_max": round(dev_ms / mx_ms, 4),
+        "device_fed_vs_max_best": round(
+            min(r["device_fed_step_ms"]
+                / max(r["data_ms"], r["compute_ms"]) for r in rows), 4),
+        "host_fed_vs_sum": round(host_ms / (data_ms + comp_ms), 4),
+        "speedup_vs_host_fed": round(host_ms / dev_ms, 4),
+        # event-based: fraction of host data prep that provably ran while
+        # compute was in flight (stable where wall-clock wobbles)
+        "hidden_input_fraction": _med_key("hidden_input_fraction"),
+        "overlap_wallclock_fraction": round(
+            min(max((host_ms - dev_ms) / min(data_ms, comp_ms), 0.0), 1.0),
+            4),
+        "trials": rows,
+    }
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=768)
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--threads", type=int, default=0)
     ap.add_argument("--rec", default=None)
+    ap.add_argument("--overlap", action="store_true",
+                    help="measure DeviceFeed input-pipeline overlap")
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--no-pin", action="store_true",
+                    help="overlap mode: do not pin XLA compute to one "
+                         "worker thread")
+    ap.add_argument("--pair-out", default=None,
+                    help="overlap mode: write <prefix>_before.json / "
+                         "<prefix>_after.json artifact pair")
     args = ap.parse_args()
+
+    if args.overlap:
+        pinned = not args.no_pin
+        out = bench_overlap(quick=args.quick, depth=args.depth, pin=pinned)
+        out["pinned_compute"] = pinned
+        out["depth"] = args.depth
+        out["quick"] = bool(args.quick)
+        out["host_cores"] = os.cpu_count()
+        out["host_loadavg_1m"] = round(os.getloadavg()[0], 2)
+        if args.pair_out:
+            meta = {"bench": "io_bench --overlap",
+                    "quick": bool(args.quick),
+                    "pinned_compute": pinned,
+                    "depth": args.depth,
+                    "host_cores": os.cpu_count(),
+                    "host_loadavg_1m": round(os.getloadavg()[0], 2),
+                    "platform": "cpu",
+                    "note": "measured back-to-back within ONE run on the "
+                            "same host: 'before' is the host-fed serial "
+                            "loop (fetch -> step -> sync), 'after' the "
+                            "identical loop through io.DeviceFeed"}
+            before = {
+                "meta": dict(meta, label="host-fed (no DeviceFeed)"),
+                "input_pipeline": {
+                    "step_ms": out["host_fed_step_ms"],
+                    "data_ms": out["data_ms"],
+                    "compute_ms": out["compute_ms"],
+                    "serial_sum_ms": out["serial_sum_ms"],
+                    "vs_sum": out["host_fed_vs_sum"],
+                    "vs_max": round(
+                        out["host_fed_step_ms"] / out["max_ms"], 4),
+                }}
+            after = {
+                "meta": dict(meta,
+                             label=f"device-fed (DeviceFeed depth="
+                                   f"{args.depth})"),
+                "input_pipeline": {
+                    "step_ms": out["device_fed_step_ms"],
+                    "data_ms": out["data_ms"],
+                    "compute_ms": out["compute_ms"],
+                    "max_ms": out["max_ms"],
+                    "vs_max": out["device_fed_vs_max"],
+                    "vs_max_best": out["device_fed_vs_max_best"],
+                    "speedup_vs_host_fed": out["speedup_vs_host_fed"],
+                    "hidden_input_fraction": out["hidden_input_fraction"],
+                    "trials": out["trials"],
+                }}
+            os.makedirs(os.path.dirname(os.path.abspath(
+                args.pair_out + "_before.json")), exist_ok=True)
+            for suffix, payload in (("_before", before), ("_after", after)):
+                with open(args.pair_out + suffix + ".json", "w") as f:
+                    json.dump(payload, f, indent=1)
+        print(json.dumps(out))
+        return
 
     if args.rec is None:
         # size-stamped per-user cache: no stale-count reuse, no /tmp clash

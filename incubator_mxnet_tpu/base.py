@@ -188,6 +188,13 @@ _register_env("MXNET_PREFETCH_RESTARTS", int, 3,
 _register_env("MXNET_DATALOADER_RETRIES", int, 3,
               "Max attempts for a gluon DataLoader batch fetch on "
               "transient I/O errors")
+_register_env("MXNET_PREFETCH_TO_DEVICE", bool, False,
+              "Route estimator.fit / gluon DataLoader batches through "
+              "io.DeviceFeed: async H2D prefetch overlapping the train "
+              "step (≙ iter_prefetcher.h hiding input latency)")
+_register_env("MXNET_DEVICE_FEED_DEPTH", int, 2,
+              "io.DeviceFeed buffer depth (batches staged ahead; "
+              "2 = double buffering)")
 _register_env("MXNET_KV_BARRIER_TIMEOUT", float, None,
               "Seconds before a dist kvstore barrier aborts with "
               "WatchdogTimeout instead of hanging on a dead peer")

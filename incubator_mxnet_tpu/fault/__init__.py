@@ -68,6 +68,7 @@ POINTS = {
                                "the rename+manifest commit",
     "checkpoint.load": "load_checkpoint / load_sharded entry",
     "io.prefetch": "PrefetchingIter worker, per fetched batch",
+    "io.device_feed": "DeviceFeed feeder thread, before each source fetch",
     "dataloader.fetch": "gluon DataLoader batch assembly, per batch",
     "kvstore.push": "KVStore.push entry",
     "kvstore.pull": "KVStore.pull entry",

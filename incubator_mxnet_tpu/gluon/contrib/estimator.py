@@ -348,8 +348,19 @@ class Estimator:
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
             batches=None, batch_axis=0):
         from ... import autograd
+        from ...base import get_env
         if epochs is None and batches is None:
             epochs = 1
+        # MXNET_PREFETCH_TO_DEVICE: route batches through io.DeviceFeed so
+        # host data prep + H2D for batch N+1 overlap batch N's step (the
+        # feed re-iterates per epoch like any loader); skip when the loader
+        # already feeds device batches (DeviceFeed, opted-in DataLoader) or
+        # EXPLICITLY opted out (DataLoader(prefetch_to_device=False))
+        if get_env("MXNET_PREFETCH_TO_DEVICE", False, typ=bool) and \
+                not getattr(train_data, "_feeds_device", False) and \
+                not getattr(train_data, "_prefetch_opt_out", False):
+            from ...io.device_feed import DeviceFeed
+            train_data = DeviceFeed(train_data, batch_axis=batch_axis)
         handlers = list(event_handlers or [])
         handlers.append(StoppingHandler(epochs, batches))
         handlers.append(MetricHandler(self.train_metrics))

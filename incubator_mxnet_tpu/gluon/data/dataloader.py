@@ -48,7 +48,7 @@ class DataLoader:
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
                  prefetch=None, thread_pool=True, timeout=120,
-                 try_nopython=None):
+                 try_nopython=None, prefetch_to_device=None):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -83,6 +83,17 @@ class DataLoader:
         self._make_batch = _fault.retrying(
             max_attempts=get_env("MXNET_DATALOADER_RETRIES", 3, typ=int),
             name="dataloader.fetch")(self._fetch_batch)
+        # opt-in device prefetch (MXNET_PREFETCH_TO_DEVICE, or the explicit
+        # kwarg): batches stage onto the device through io.DeviceFeed so
+        # host assembly + H2D overlap the consumer's step
+        self._prefetch_to_device = (
+            get_env("MXNET_PREFETCH_TO_DEVICE", False, typ=bool)
+            if prefetch_to_device is None else bool(prefetch_to_device))
+        self._feeds_device = self._prefetch_to_device
+        # an EXPLICIT falsy prefetch_to_device is an opt-out that downstream
+        # wrappers (estimator.fit's env-driven wrap) must respect
+        self._prefetch_opt_out = (prefetch_to_device is not None
+                                  and not prefetch_to_device)
 
     def _fetch_batch(self, indices):
         from ... import fault as _fault
@@ -91,6 +102,17 @@ class DataLoader:
         return self._batchify_fn(samples)
 
     def __iter__(self):
+        if self._prefetch_to_device:
+            from ...io.device_feed import DeviceFeed
+            feed = DeviceFeed(self._host_iter())
+            try:
+                yield from feed
+            finally:
+                feed.close()
+            return
+        yield from self._host_iter()
+
+    def _host_iter(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)

@@ -136,6 +136,9 @@ class NDArrayIter(DataIter):
         if self.shuffle:
             _np.random.shuffle(self._order)
 
+    def __len__(self):
+        return self.num_batches
+
     def iter_next(self):
         self.cursor += self.batch_size
         if self.last_batch_handle == "discard":
@@ -229,8 +232,12 @@ class PrefetchingIter(DataIter):
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
         if len(iters) != 1:
-            raise MXNetError("multi-iter prefetching is not supported; "
-                             "compose datasets instead")
+            raise MXNetError(
+                "PrefetchingIter wraps exactly ONE iterator; for multiple "
+                "streams compose them into a single source first (zip your "
+                "iterators, or build one combined Dataset/DataLoader) and "
+                "wrap that — for host->device prefetch of the combined "
+                "stream use io.DeviceFeed / io.prefetch_to_device instead")
         super().__init__(iters[0].batch_size)
         from ..base import get_env
         self.iter = iters[0]
@@ -243,31 +250,18 @@ class PrefetchingIter(DataIter):
         self._terminated = False  # terminal sentinel already consumed
 
     def _worker(self):
-        from .. import fault as _fault
-        restarts = 0
-        it = iter(self.iter)
-        while True:
-            try:
-                # inject BEFORE the fetch: a transient injected fault must
-                # not consume a batch from the source
-                _fault.inject("io.prefetch")
-                batch = next(it)
-            except StopIteration:
-                self._queue.put(None)
-                return
-            except (IOError, OSError, TimeoutError) as e:
-                if restarts < self._max_restarts:
-                    restarts += 1
-                    _fault._log_event("io.prefetch_restart",
-                                      attempt=restarts, error=repr(e))
-                    continue
-                self._queue.put(_WorkerFailure(e))
-                return
-            except BaseException as e:  # re-raised in the consumer
-                self._queue.put(_WorkerFailure(e))
-                return
-            restarts = 0   # budget bounds CONSECUTIVE errors, not lifetime
-            self._queue.put(batch)
+        # the fetch/retry protocol (inject-before-fetch, consecutive
+        # restart budget, original-exception re-raise) is shared with
+        # DeviceFeed's feeder
+        from .device_feed import _fetch_with_restarts
+        try:
+            for batch in _fetch_with_restarts(self.iter, "io.prefetch",
+                                              self._max_restarts):
+                self._queue.put(batch)
+        except BaseException as e:  # re-raised in the consumer
+            self._queue.put(_WorkerFailure(e))
+            return
+        self._queue.put(None)
 
     def _ensure_started(self):
         import threading
@@ -301,6 +295,19 @@ class PrefetchingIter(DataIter):
             raise batch.error
         self.current_batch = batch
         return True
+
+    def __len__(self):
+        # passthrough so the wrapper composes with epoch loops and
+        # DeviceFeed the same as its inner iterator
+        return len(self.iter)
+
+    @property
+    def provide_data(self):
+        return getattr(self.iter, "provide_data", None)
+
+    @property
+    def provide_label(self):
+        return getattr(self.iter, "provide_label", None)
 
     def getdata(self):
         return self.current_batch.data
@@ -633,3 +640,8 @@ class ImageRecordIter(DataIter):
 
 
 __all__ += ["ImageRecordIter"]
+
+from .device_feed import (DeviceFeed, prefetch_to_device,  # noqa: E402
+                          feed_stats)
+
+__all__ += ["DeviceFeed", "prefetch_to_device", "feed_stats"]

@@ -32,6 +32,7 @@ __all__ = [
     "allreduce", "allgather", "reduce_scatter", "broadcast", "ppermute",
     "axis_is_bound", "shard", "replicate", "shard_map", "num_devices",
     "local_rank", "rank", "world_size", "DataParallel", "split_and_load",
+    "data_sharding",
     "ring_attention", "pipeline_apply", "moe_dispatch",
 ]
 
@@ -330,6 +331,21 @@ def shard_map(fn, mesh, in_specs, out_specs, check_rep=False):
         with _axis_scope(list(names)):
             return inner(*args)
     return wrapped
+
+
+def data_sharding(ndim, batch_axis=0, mesh=None):
+    """NamedSharding for an input batch of rank `ndim`: the batch axis
+    split over 'dp', everything else replicated — the placement
+    `io.DeviceFeed` uses for data-parallel device prefetch. Returns None
+    when no mesh is active (or it has no 'dp' axis): callers then fall
+    back to plain default-device placement."""
+    mesh = mesh or current_mesh()
+    if mesh is None or "dp" not in mesh.axis_sizes:
+        return None
+    spec = [None] * ndim
+    if ndim > batch_axis:
+        spec[batch_axis] = "dp"
+    return mesh.sharding(*spec)
 
 
 def split_and_load(data, ctx_list=None, batch_axis=0, even_split=True,

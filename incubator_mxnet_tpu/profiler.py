@@ -22,7 +22,8 @@ from .base import MXNetError, get_env
 
 __all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
            "state", "Task", "Frame", "Event", "Counter", "Domain", "Marker",
-           "profiler_scope", "scope", "dispatch_stats", "serve_stats"]
+           "profiler_scope", "scope", "dispatch_stats", "serve_stats",
+           "feed_stats"]
 
 _lock = threading.Lock()
 _events = []          # chrome trace events
@@ -131,6 +132,22 @@ def serve_stats(reset=False):
     "serve") while the profiler runs — the serving lane."""
     from .serve.metrics import serve_stats as _ss
     return _ss(reset=reset)
+
+
+def feed_stats(reset=False):
+    """Counters from the device-feed input pipeline (io.DeviceFeed /
+    prefetch_to_device and the FusedTrainStep input-staging guard):
+    batches fed/consumed, real H2D transfers vs redundant-transfer skips
+    (`device_put_skipped`), buffer occupancy, and stall time split into
+    waiting-on-data (`stall_data_us` — the pipeline is input-bound) vs
+    waiting-on-compute (`stall_compute_us` — the feed is keeping up).
+
+    Always on, like dispatch_stats(). `reset=True` zeroes after the
+    snapshot. While the profiler runs, consumer waits land in the Chrome
+    trace as "io.feed" events and feeder staging as "feed.stage" (cat
+    "io") — the input-pipeline lane. See docs/PERF.md "Input pipeline"."""
+    from .io.device_feed import feed_stats as _fs
+    return _fs(reset=reset)
 
 
 def dumps(reset=False, format="table"):
