@@ -1,0 +1,253 @@
+"""Registry-consistency pass: every knob/fault-point/stats-key surface the
+package exposes must stay in sync with its registry, docs, and tests.
+
+Three symbol families, six rules:
+
+  MXNET_* environment variables — read sites are `get_env(...)`,
+  `_register_env(...)`, `os.environ.get/[...]`, `os.getenv`; the doc
+  surface is the table in docs/ENV_VARS.md.
+
+    env-undocumented    a variable is read in the package but absent from
+                        the doc table (users cannot discover the knob)
+    env-doc-stale       a doc-table variable is no longer read anywhere
+                        (the doc promises a knob that does nothing)
+
+  fault injection points — the registry is `POINTS` (a module-level dict
+  literal named POINTS); wired sites are string literals passed to
+  `inject(...)` / `_fault_inject(...)` / `_fetch_with_restarts(_, "pt")`;
+  the doc surface is the injection-point table in docs/RESILIENCE.md.
+
+    fault-point-unwired       registered in POINTS, no inject call site
+    fault-point-unregistered  injected under a name POINTS doesn't know
+    fault-point-undocumented  registered but missing from RESILIENCE.md
+    fault-doc-stale           a RESILIENCE.md table point not in POINTS
+
+  profiler stats keys — module-level dict literals named `*_STATS`
+  (DISPATCH_STATS / SERVE_STATS / FEED_STATS) are the
+  `profiler.*_stats()` key surface.
+
+    stats-key-untested  a stats key never appears in any tests/*.py —
+                        nothing would notice the counter going dead
+
+All comparisons are literal-based on purpose: a knob that only exists
+behind computed strings is unauditable and should be rewritten, not
+special-cased.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Finding, call_name, str_const
+
+__all__ = ["run"]
+
+RULES = ("env-undocumented", "env-doc-stale", "fault-point-unwired",
+         "fault-point-unregistered", "fault-point-undocumented",
+         "fault-doc-stale", "stats-key-untested")
+
+_ENV_RE = re.compile(r"MXNET_[A-Z0-9_]+")
+_STATS_NAME_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*_STATS$")
+_ENV_READERS = {"get_env", "_register_env", "getenv"}
+_INJECT_CALLEES = {"inject", "_fault_inject"}
+_POINT_TABLE_RE = re.compile(r"^\|\s*`([a-z0-9_.]+)`(?:\s*/\s*`([a-z0-9_.]+)`)*")
+
+
+def _env_reads(modules):
+    """{var: (relpath, line)} for every literal MXNET_* read site."""
+    reads = {}
+
+    def note(name, mod, line):
+        if name and name.startswith("MXNET_") and name not in reads:
+            reads[name] = (mod.relpath, line)
+
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                cname = call_name(node)
+                last = cname.split(".")[-1] if cname else None
+                if last in _ENV_READERS and node.args:
+                    note(str_const(node.args[0]), mod, node.lineno)
+                elif cname and cname.endswith("environ.get") and node.args:
+                    note(str_const(node.args[0]), mod, node.lineno)
+            elif isinstance(node, ast.Subscript):
+                # os.environ["X"] (read or write — both are knob surface)
+                base = node.value
+                if isinstance(base, ast.Attribute) \
+                        and base.attr == "environ":
+                    note(str_const(node.slice), mod, node.lineno)
+    return reads
+
+
+def _doc_env_vars(doc_path):
+    """{var: line} for MXNET_* vars in the ENV_VARS.md table."""
+    doc = {}
+    if not os.path.exists(doc_path):
+        return doc
+    with open(doc_path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            if not line.lstrip().startswith("|"):
+                continue
+            first_cell = line.split("|")[1] if "|" in line[1:] else ""
+            for m in _ENV_RE.finditer(first_cell):
+                doc.setdefault(m.group(0), i)
+    return doc
+
+
+def _points_registry(modules):
+    """(points {name: line}, module relpath) from `POINTS = {...}`."""
+    for mod in modules:
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Dict):
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                if "POINTS" in names:
+                    pts = {}
+                    for k in node.value.keys:
+                        s = str_const(k)
+                        if s:
+                            pts[s] = k.lineno
+                    return pts, mod.relpath
+    return {}, None
+
+
+def _inject_sites(modules):
+    """{point: (relpath, line)} for literal injection call sites."""
+    sites = {}
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            last = cname.split(".")[-1] if cname else None
+            lit = None
+            if last in _INJECT_CALLEES and node.args:
+                lit = str_const(node.args[0])
+            elif last == "_fetch_with_restarts" and len(node.args) >= 2:
+                lit = str_const(node.args[1])
+            if lit and lit not in sites:
+                sites[lit] = (mod.relpath, node.lineno)
+    return sites
+
+
+def _doc_points(doc_path):
+    """(all_text, {point: line} from the injection-point table rows)."""
+    table = {}
+    text = ""
+    if not os.path.exists(doc_path):
+        return text, table
+    with open(doc_path, encoding="utf-8") as f:
+        text = f.read()
+    for i, line in enumerate(text.splitlines(), 1):
+        m = _POINT_TABLE_RE.match(line.strip())
+        if m:
+            for pt in re.findall(r"`([a-z0-9_.]+)`",
+                                 line.split("|")[1]):
+                if "." in pt:
+                    table.setdefault(pt, i)
+    return text, table
+
+
+def _stats_dicts(modules):
+    """[(dict_name, {key: line}, relpath, line)] for *_STATS literals."""
+    out = []
+    for mod in modules:
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Dict):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) \
+                            and _STATS_NAME_RE.match(t.id):
+                        keys = {}
+                        for k in node.value.keys:
+                            s = str_const(k)
+                            if s:
+                                keys[s] = k.lineno
+                        out.append((t.id, keys, mod.relpath, node.lineno))
+    return out
+
+
+def _tests_text(tests_dir):
+    chunks = []
+    if os.path.isdir(tests_dir):
+        for dirpath, dirnames, filenames in os.walk(tests_dir):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", "lint_fixtures")]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    with open(os.path.join(dirpath, fn),
+                              encoding="utf-8") as f:
+                        chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def run(modules, root,
+        env_doc="docs/ENV_VARS.md", resilience_doc="docs/RESILIENCE.md",
+        tests_dir="tests"):
+    findings = []
+    env_doc_path = os.path.join(root, env_doc)
+    res_doc_path = os.path.join(root, resilience_doc)
+    tests_path = os.path.join(root, tests_dir)
+
+    # ---- env vars ------------------------------------------------------
+    reads = _env_reads(modules)
+    documented = _doc_env_vars(env_doc_path)
+    for var, (path, line) in sorted(reads.items()):
+        if var not in documented:
+            findings.append(Finding(
+                "env-undocumented", path, line,
+                f"`{var}` is read here but not documented in {env_doc}",
+                scope="env", symbol=var))
+    for var, line in sorted(documented.items()):
+        if var not in reads:
+            findings.append(Finding(
+                "env-doc-stale", env_doc, line,
+                f"`{var}` is documented in {env_doc} but never read in "
+                f"the package — delete the entry or wire the knob",
+                scope="env", symbol=var))
+
+    # ---- fault points --------------------------------------------------
+    points, points_path = _points_registry(modules)
+    sites = _inject_sites(modules)
+    res_text, res_table = _doc_points(res_doc_path)
+    for pt, line in sorted(points.items()):
+        if pt not in sites:
+            findings.append(Finding(
+                "fault-point-unwired", points_path or "", line,
+                f"fault point `{pt}` is registered in POINTS but no "
+                f"inject() call site exists — it can never fire",
+                scope="POINTS", symbol=pt))
+        if res_text and pt not in res_text:
+            findings.append(Finding(
+                "fault-point-undocumented", points_path or "", line,
+                f"fault point `{pt}` is registered but missing from "
+                f"{resilience_doc}", scope="POINTS", symbol=pt))
+    for pt, (path, line) in sorted(sites.items()):
+        if points and pt not in points:
+            findings.append(Finding(
+                "fault-point-unregistered", path, line,
+                f"inject site uses point `{pt}` which is not registered "
+                f"in POINTS — specs naming it are silently dead to "
+                f"introspection", scope="inject", symbol=pt))
+    for pt, line in sorted(res_table.items()):
+        if points and pt not in points:
+            findings.append(Finding(
+                "fault-doc-stale", resilience_doc, line,
+                f"{resilience_doc} documents injection point `{pt}` "
+                f"which is not in POINTS", scope="doc", symbol=pt))
+
+    # ---- stats keys ----------------------------------------------------
+    tests_text = _tests_text(tests_path)
+    if tests_text:
+        for dname, keys, relpath, dline in _stats_dicts(modules):
+            for key, line in sorted(keys.items()):
+                if f'"{key}"' in tests_text or f"'{key}'" in tests_text:
+                    continue
+                findings.append(Finding(
+                    "stats-key-untested", relpath, line,
+                    f"stats key `{dname}[{key!r}]` never appears in any "
+                    f"test — nothing notices if the counter goes dead",
+                    scope=dname, symbol=key))
+    return findings

@@ -1,0 +1,438 @@
+"""Trace-safety pass: host-Python hazards inside jit-reachable functions.
+
+A function is *jit-reachable* when a trace can enter it: it is passed to
+`jax.jit` (call or decorator, including `functools.partial(jax.jit, ...)`),
+registered as an op kernel via `register_op` (the dispatch layer jits
+registered kernels through its compiled-kernel cache), or called (by simple
+name) / lexically nested inside a reachable function. Reachability is
+resolved per module — cross-module calls are out of scope by design: the
+package's kernels are self-contained, and a cheaper, precise pass that
+always runs beats a whole-program one nobody waits for.
+
+Rules (all anchored at the hazard expression):
+
+  trace-host-capture   `float(x)`/`int(x)`/`bool(x)` on a parameter,
+                       `.item()`/`.tolist()`/`.asnumpy()` anywhere, and
+                       `np.asarray`/`np.array` on a parameter. Under trace
+                       these force a concrete value: either they raise
+                       `TracerArrayConversionError` at runtime or — worse —
+                       silently bake a host constant into the compiled
+                       program.
+  trace-impure-host    calls into stdlib `time.*` / `random.*` and
+                       environment reads (`os.environ`, `os.getenv`,
+                       `get_env`) inside a kernel: the value observed at
+                       TRACE time is frozen into every later execution,
+                       the classic "why does my jitted code ignore the
+                       env var" bug. (`jax.random` is fine and not
+                       matched — module aliases are resolved from the
+                       file's imports.)
+  trace-closure-mutation  assignment/augmented-assignment or a mutating
+                       method call (.append/.update/...) on closed-over or
+                       global state, or on objects derived from closed-over
+                       iterables, plus any `global`/`nonlocal` rebinding.
+                       The mutation runs ONCE at trace time, then never
+                       again — state silently stops updating after the
+                       first call.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, call_name, dotted, str_const
+
+__all__ = ["run"]
+
+RULES = ("trace-host-capture", "trace-impure-host", "trace-closure-mutation")
+
+_HOST_CONVERT_ATTRS = {"item", "tolist", "asnumpy"}
+_HOST_CONVERT_BUILTINS = {"float", "int", "bool"}
+_NP_CONVERT = {"asarray", "array"}
+_MUTATORS = {"append", "add", "update", "extend", "insert", "remove",
+             "discard", "pop", "popitem", "popleft", "appendleft", "clear",
+             "setdefault", "sort", "reverse"}
+_IMPURE_STDLIB = {"time", "random"}
+
+
+def _import_aliases(tree):
+    """Map local alias -> real top-level module, plus names imported FROM
+    modules of interest ('get_env', 'environ', 'getenv')."""
+    aliases = {}
+    from_names = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = \
+                    a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            mod = (node.module or "").split(".")[-1]
+            for a in node.names:
+                from_names[a.asname or a.name] = (mod, a.name)
+    return aliases, from_names
+
+
+class _FnInfo:
+    __slots__ = ("node", "qualname", "parent")
+
+    def __init__(self, node, qualname, parent):
+        self.node = node
+        self.qualname = qualname
+        self.parent = parent
+
+
+def _collect_functions(tree):
+    """All function defs with qualnames and lexical parents."""
+    fns = {}
+
+    def visit(node, prefix, parent):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                fns[id(child)] = _FnInfo(child, q, parent)
+                visit(child, q + ".", child)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.", parent)
+            else:
+                visit(child, prefix, parent)
+
+    visit(tree, "", None)
+    return fns
+
+
+def _is_jit_callee(name, aliases):
+    """True when the dotted callee name denotes jax.jit."""
+    if name is None:
+        return False
+    parts = name.split(".")
+    if parts[-1] != "jit":
+        return False
+    if len(parts) == 1:
+        return True                      # `from jax import jit`
+    base = parts[0]
+    return aliases.get(base, base).lstrip("_") == "jax" or base == "_jax"
+
+
+def _jit_roots(tree, aliases, by_name):
+    """Function defs directly entered by a trace."""
+    roots = set()
+
+    def mark(node):
+        if isinstance(node, ast.Name):
+            for fid in by_name.get(node.id, ()):
+                roots.add(fid)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                dname = dotted(dec) if not isinstance(dec, ast.Call) \
+                    else call_name(dec)
+                if _is_jit_callee(dname, aliases):
+                    roots.add(id(node))
+                elif isinstance(dec, ast.Call):
+                    # @partial(jax.jit, ...) / @register_op("name")
+                    if dname and dname.split(".")[-1] == "partial" \
+                            and dec.args \
+                            and _is_jit_callee(dotted(dec.args[0]), aliases):
+                        roots.add(id(node))
+                    if dname and dname.split(".")[-1] == "register_op":
+                        roots.add(id(node))
+        elif isinstance(node, ast.Call):
+            cname = call_name(node)
+            if _is_jit_callee(cname, aliases) and node.args:
+                mark(node.args[0])
+            elif cname and cname.split(".")[-1] == "partial" and node.args \
+                    and _is_jit_callee(dotted(node.args[0]), aliases) \
+                    and len(node.args) > 1:
+                mark(node.args[1])
+            elif cname and cname.split(".")[-1] == "register_op":
+                # register_op(name, fn) / register_op(name, fn=kernel)
+                for arg in list(node.args[1:]) + \
+                        [k.value for k in node.keywords if k.arg == "fn"]:
+                    mark(arg)
+    return roots
+
+
+def _reachable(fns, roots):
+    """Expand roots through same-module calls and lexical nesting."""
+    by_name = {}
+    for fid, info in fns.items():
+        by_name.setdefault(info.node.name, []).append(fid)
+
+    reach = set(roots)
+    changed = True
+    while changed:
+        changed = False
+        for fid, info in list(fns.items()):
+            if fid in reach:
+                continue
+            # nested inside a reachable function -> reachable
+            p = info.parent
+            if p is not None and id(p) in reach:
+                reach.add(fid)
+                changed = True
+                continue
+        for fid in list(reach):
+            info = fns[fid]
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name):
+                    for cand in by_name.get(node.func.id, ()):
+                        if cand not in reach:
+                            reach.add(cand)
+                            changed = True
+    return reach
+
+
+def _base_name(node):
+    """Root Name of an attribute/subscript/call chain: `a.b[c].d()` -> a."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        else:
+            break
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _own_scope(fn):
+    """Statements of `fn` without nested function/class bodies."""
+    todo = list(fn.body)
+    while todo:
+        n = todo.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            todo.extend(ast.iter_child_nodes(n))
+
+
+def _params(fn):
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _locals_of(fn):
+    """Flow-insensitive local bindings of fn's own scope (params, assigns,
+    for/with/except targets, comprehension vars, nested def names)."""
+    bound = _params(fn)
+
+    def add_target(t):
+        # only BINDING targets introduce locals: `x = ...`, `a, b = ...`.
+        # `obj.attr = ...` / `d[k] = ...` mutate an existing object and
+        # must NOT shadow the closed-over name.
+        if isinstance(t, ast.Name):
+            bound.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                add_target(e)
+        elif isinstance(t, ast.Starred):
+            add_target(t.value)
+
+    for node in _own_scope(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                add_target(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            add_target(node.target)
+        elif isinstance(node, ast.For):
+            add_target(node.target)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            add_target(node.optional_vars)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            bound.add(node.name)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                add_target(gen.target)
+    return bound
+
+
+def _closure_derived(fn, local_names):
+    """For-loop targets whose iterable mentions a non-local name: the loop
+    variable walks closed-over state, so mutating it mutates the closure."""
+    derived = set()
+    for node in _own_scope(fn):
+        if isinstance(node, ast.For):
+            free = {n.id for n in ast.walk(node.iter)
+                    if isinstance(n, ast.Name)} - local_names
+            if free:
+                for t in ast.walk(node.target):
+                    if isinstance(t, ast.Name):
+                        derived.add(t.id)
+    return derived
+
+
+def _mentions(node, names):
+    return any(isinstance(n, ast.Name) and n.id in names
+               for n in ast.walk(node))
+
+
+def _resolve(base, aliases, from_names):
+    """What a base name refers to: (top_module, None) for plain imports /
+    unknown names, (source_module, member) for from-imports — so
+    `random` after `from jax import random` resolves to ('jax', 'random')
+    and is NOT the stdlib, while `now` after `from time import time as
+    now` resolves to ('time', 'time') and IS."""
+    if base in from_names:
+        return from_names[base]
+    return aliases.get(base, base), None
+
+
+def run(modules):
+    findings = []
+    for mod in modules:
+        aliases, from_names = _import_aliases(mod.tree)
+        fns = _collect_functions(mod.tree)
+        by_name = {}
+        for fid, info in fns.items():
+            by_name.setdefault(info.node.name, []).append(fid)
+        roots = _jit_roots(mod.tree, aliases, by_name)
+        if not roots:
+            continue
+        reach = _reachable(fns, roots)
+        for fid in reach:
+            info = fns[fid]
+            findings.extend(_check_fn(mod, info, aliases, from_names))
+    return findings
+
+
+def _check_fn(mod, info, aliases, from_names):
+    fn = info.node
+    out = []
+    params = _params(fn)
+    local_names = _locals_of(fn)
+    derived = _closure_derived(fn, local_names)
+    globals_declared = set()
+    for node in _own_scope(fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            globals_declared.update(node.names)
+
+    def emit(rule, node, msg, symbol):
+        if not mod.suppressed(rule, node.lineno):
+            out.append(Finding(rule, mod.relpath, node.lineno, msg,
+                               scope=info.qualname, symbol=symbol))
+
+    for node in _own_scope(fn):
+        # ---- trace-host-capture -------------------------------------
+        if isinstance(node, ast.Call):
+            cname = call_name(node)
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in _HOST_CONVERT_BUILTINS \
+                    and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in params:
+                emit("trace-host-capture", node,
+                     f"{node.func.id}() on parameter "
+                     f"'{node.args[0].id}' forces a traced value to a "
+                     f"host scalar inside a jit-reachable function",
+                     f"{node.func.id}({node.args[0].id})")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _HOST_CONVERT_ATTRS \
+                    and not node.args:
+                emit("trace-host-capture", node,
+                     f".{node.func.attr}() inside a jit-reachable "
+                     f"function pulls the value back to host",
+                     f".{node.func.attr}")
+            elif cname and "." in cname:
+                base, last = cname.split(".")[0], cname.split(".")[-1]
+                bmod, _orig = _resolve(base, aliases, from_names)
+                if last in _NP_CONVERT and bmod.lstrip("_") == "numpy" \
+                        and node.args \
+                        and _mentions(node.args[0], params):
+                    emit("trace-host-capture", node,
+                         f"{cname}() on a parameter-derived value "
+                         f"materializes it on host under trace",
+                         cname)
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in from_names \
+                    and from_names[node.func.id][0].lstrip("_") == "numpy" \
+                    and from_names[node.func.id][1] in _NP_CONVERT \
+                    and node.args and _mentions(node.args[0], params):
+                orig = from_names[node.func.id][1]
+                emit("trace-host-capture", node,
+                     f"{node.func.id}() (= numpy.{orig}) on a "
+                     f"parameter-derived value materializes it on host "
+                     f"under trace", f"numpy.{orig}")
+            # ---- trace-impure-host ----------------------------------
+            if cname:
+                base = cname.split(".")[0]
+                bmod, orig = _resolve(base, aliases, from_names)
+                if "." in cname:
+                    # `random.x()` is stdlib only when `random` is bound by
+                    # `import random`, NOT by `from jax import random`
+                    if orig is None and bmod in _IMPURE_STDLIB:
+                        emit("trace-impure-host", node,
+                             f"{cname}() inside a jit-reachable function "
+                             f"runs at TRACE time only; its value is baked "
+                             f"into the compiled program", cname)
+                    elif ((orig is None and bmod == "os"
+                           and cname.split(".")[1] in ("getenv", "environ"))
+                          or (bmod == "os" and orig == "environ")):
+                        emit("trace-impure-host", node,
+                             f"{cname}() read inside a kernel is frozen at "
+                             f"trace time", cname)
+                else:
+                    # bare from-imports: `from time import time as now`
+                    if orig is not None and bmod in _IMPURE_STDLIB:
+                        emit("trace-impure-host", node,
+                             f"{cname}() (= {bmod}.{orig}) inside a "
+                             f"jit-reachable function runs at TRACE time "
+                             f"only; its value is baked into the compiled "
+                             f"program", f"{bmod}.{orig}")
+                    elif orig == "getenv" and bmod == "os":
+                        emit("trace-impure-host", node,
+                             f"{cname}() read inside a kernel is frozen "
+                             f"at trace time", "os.getenv")
+                if cname.split(".")[-1] == "get_env":
+                    tgt = str_const(node.args[0]) if node.args else None
+                    emit("trace-impure-host", node,
+                         f"environment read ({tgt or 'get_env'}) inside a "
+                         f"jit-reachable function is frozen at trace time",
+                         tgt or "get_env")
+            # mutating method call on closed-over state
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                base = _base_name(node.func.value)
+                if base is not None and (
+                        base in globals_declared
+                        or base in derived
+                        or (base not in local_names
+                            and base not in aliases)):
+                    emit("trace-closure-mutation", node,
+                         f"mutating call .{node.func.attr}() on "
+                         f"closed-over '{base}' runs once at trace time, "
+                         f"never per execution",
+                         f"{base}.{node.func.attr}")
+        # ---- trace-closure-mutation (assignments) -------------------
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    base = _base_name(t)
+                    if base is None:
+                        continue
+                    if base in globals_declared or base in derived or (
+                            base not in local_names
+                            and base not in aliases):
+                        kind = "attribute" if isinstance(t, ast.Attribute) \
+                            else "item"
+                        emit("trace-closure-mutation", t,
+                             f"{kind} assignment on closed-over '{base}' "
+                             f"inside a jit-reachable function bakes into "
+                             f"the trace (runs once, not per call)", base)
+                elif isinstance(t, ast.Name) and t.id in globals_declared:
+                    emit("trace-closure-mutation", t,
+                         f"rebinding global/nonlocal '{t.id}' inside a "
+                         f"jit-reachable function happens at trace time "
+                         f"only", t.id)
+    return out

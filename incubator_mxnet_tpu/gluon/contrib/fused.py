@@ -107,7 +107,9 @@ class FusedInferStep:
                 logits = out._arr
             finally:
                 for p, old in zip(params, saved):
-                    p.data()._data = old
+                    # deliberate trace-time buffer swap: params point at the
+                    # jit args during net(x), restored before tracing ends
+                    p.data()._data = old  # mxlint: disable=trace-closure-mutation
             x_next = x + (eps * jnp.mean(logits)).astype(x.dtype)
             return logits, x_next
 
@@ -269,11 +271,13 @@ class FusedTrainStep:
                         if cur is not buf:
                             mutated[i] = cur
                     if meta["aux_idx"] is None:
-                        meta["aux_idx"] = tuple(sorted(mutated))
+                        # trace-time memo by design (see comment above)
+                        meta["aux_idx"] = tuple(sorted(mutated))  # mxlint: disable=trace-closure-mutation
                     aux_bufs = tuple(mutated[i] for i in sorted(mutated))
                 finally:
                     for p, old in zip(params, saved):
-                        p.data()._data = old
+                        # deliberate trace-time buffer swap (see ChainStep)
+                        p.data()._data = old  # mxlint: disable=trace-closure-mutation
                 return loss_raw, (extras_raw, aux_bufs)
 
             # prevent_cse=False: we are always under jit (and under scan
@@ -300,7 +304,9 @@ class FusedTrainStep:
                 grads = [g * scale.astype(g.dtype) for g in grads]
 
             prev = opt.rescale_grad
-            opt.rescale_grad = rescale  # traced; inner kernels key on it
+            # deliberate trace-time swap (inner kernels key on the traced
+            # rescale), restored in finally below
+            opt.rescale_grad = rescale  # mxlint: disable=trace-closure-mutation
             try:
                 new_w, new_s = [], []
                 for k, i in enumerate(train_idx):
@@ -314,7 +320,7 @@ class FusedTrainStep:
                     new_w.append(w._arr)
                     new_s.append(_state_bufs(st))
             finally:
-                opt.rescale_grad = prev
+                opt.rescale_grad = prev  # mxlint: disable=trace-closure-mutation -- restore of the trace-time swap
             # fold BN-stat updates back into the frozen set so a scanned
             # call carries them step to step
             new_frozen = list(frozen_bufs)

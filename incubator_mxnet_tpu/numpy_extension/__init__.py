@@ -121,7 +121,8 @@ def rnn(data, parameters, state, state_cell=None, mode="lstm",
     if mode not in gates:
         raise MXNetError(f"unknown rnn mode {mode!r}")
     G = gates[mode]
-    H, L = int(state_size), int(num_layers)
+    # static layer-config ints, never traced values
+    H, L = int(state_size), int(num_layers)  # mxlint: disable=trace-host-capture
     D = 2 if bidirectional else 1
     C = int(data.shape[-1])
     training = _autograd.is_training()
@@ -137,9 +138,11 @@ def rnn(data, parameters, state, state_cell=None, mode="lstm",
         off = 0
 
         def take(n, shape):
+            # static unpack offset over the flat param vector: advances
+            # during tracing only, reset per run() call — by design
             nonlocal off
             w = flat[off:off + n].reshape(shape)
-            off += n
+            off += n  # mxlint: disable=trace-closure-mutation
             return w
 
         params = {}

@@ -438,7 +438,9 @@ def _multibox_detection_body(jnp, jax, cls_prob, loc_pred, anchor, clip,
 def _generate_base_anchors(base_size, ratios, scales):
     """(≙ utils::GenerateAnchors, proposal.cc) ratio then scale enumeration
     around a base_size x base_size window, area-preserving with rounding."""
-    base = _np.array([0, 0, base_size - 1, base_size - 1], _np.float32)
+    # host-side anchor precompute on static config ints (reference idiom);
+    # base_size is never a traced value
+    base = _np.array([0, 0, base_size - 1, base_size - 1], _np.float32)  # mxlint: disable=trace-host-capture
     w = base[2] - base[0] + 1
     h = base[3] - base[1] + 1
     cx = base[0] + 0.5 * (w - 1)

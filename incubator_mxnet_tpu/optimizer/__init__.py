@@ -260,7 +260,9 @@ class Optimizer:
             rescale = flat[2 * nw + ns + 2]
             t_args = flat[2 * nw + ns + 3] if takes_t else None
             prev = opt.rescale_grad
-            opt.rescale_grad = rescale
+            # deliberate trace-time swap (exposes the traced rescale to
+            # step_one's _preprocess), restored in finally below
+            opt.rescale_grad = rescale  # mxlint: disable=trace-closure-mutation
             try:
                 new_w, new_s = [], []
                 for k, idx in enumerate(indices):
@@ -275,7 +277,7 @@ class Optimizer:
                     new_w.append(w._arr)
                     new_s.append(_state_bufs(st))
             finally:
-                opt.rescale_grad = prev
+                opt.rescale_grad = prev  # mxlint: disable=trace-closure-mutation -- restore of the trace-time swap
             return tuple(new_w) + tuple(jtu.tree_leaves(new_s))
 
         key = ("fused_all_bulk", self, indices, self.clip_gradient,
@@ -352,7 +354,8 @@ class Optimizer:
                 # expose the traced rescale to step_one's _preprocess; the
                 # inner kernel cache detects the tracer and keys on "traced"
                 prev = opt.rescale_grad
-                opt.rescale_grad = rescale
+                # deliberate trace-time swap, restored in finally below
+                opt.rescale_grad = rescale  # mxlint: disable=trace-closure-mutation
                 try:
                     new_w, new_s = [], []
                     for k, (idx, wb, gb, sb, lr, wd) in enumerate(zip(
@@ -368,7 +371,7 @@ class Optimizer:
                         new_s.append(_state_bufs(st))
                     return new_w, new_s
                 finally:
-                    opt.rescale_grad = prev
+                    opt.rescale_grad = prev  # mxlint: disable=trace-closure-mutation -- restore of the trace-time swap
 
             cached = jax.jit(f, donate_argnums=(0, 2))
             self._jitted[key] = cached

@@ -189,7 +189,8 @@ def world_size():
 def _bound_axes():
     s = getattr(_tls, "axes", None)
     if s is None:
-        s = _tls.axes = []
+        # lazy thread-local init; axis bindings are static per trace
+        s = _tls.axes = []  # mxlint: disable=trace-closure-mutation
     return s
 
 

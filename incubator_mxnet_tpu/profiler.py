@@ -306,11 +306,13 @@ class MemoryMonitor:
 
     def __enter__(self):
         import threading
-        self._stop = threading.Event()
+        # handoff ordered by Thread start/join, not a lock: _stop and
+        # samples are written before start() and read after join()
+        self._stop = threading.Event()  # mxlint: disable=lock-shared-mutation
 
         def loop():
             while not self._stop.is_set():
-                self.samples.append((_now_us(), self._read()))
+                self.samples.append((_now_us(), self._read()))  # mxlint: disable=lock-shared-mutation
                 self._stop.wait(self.interval)
 
         self._thread = threading.Thread(target=loop, daemon=True)

@@ -288,8 +288,7 @@ class Server:
         if warmup:
             self.model.warmup()
             for b in self.model.batch_sizes:
-                if b not in self._warm:
-                    self._warm.add(b)
+                if self._mark_warm(b):
                     self.metrics.count("programs_compiled")
         self._started = True
         self._thread.start()
@@ -318,6 +317,16 @@ class Server:
 
     def __exit__(self, *exc):
         self.close()
+
+    def _mark_warm(self, bucket):
+        """Record `bucket`'s program as compiled; True on first sighting.
+        `_warm` is touched from both start() (caller thread) and _execute
+        (batcher thread), so the check-and-add runs under _cv."""
+        with self._cv:
+            if bucket in self._warm:
+                return False
+            self._warm.add(bucket)
+            return True
 
     # -- submission --------------------------------------------------------
     def _check_row(self, inputs):
@@ -435,8 +444,7 @@ class Server:
         bucket = pick_bucket(n, self.model.batch_sizes)
         if bucket is None:       # can't happen: assembly caps at max bucket
             bucket = self.model.batch_sizes[-1]
-        if bucket not in self._warm:
-            self._warm.add(bucket)
+        if self._mark_warm(bucket):
             self.metrics.count("programs_compiled")
         t0 = time.perf_counter()
         try:

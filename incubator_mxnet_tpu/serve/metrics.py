@@ -7,7 +7,12 @@ DISPATCH_STATS):
   * SERVE_STATS — one flat module-level counter dict aggregated across every
     Server in the process, readable via `profiler.serve_stats()` (the
     profiler-counter surface the reference exposes through MXProfile*
-    counters). Plain int += under the GIL: diagnostics, not accounting.
+    counters). Increments take the module _STATS_LOCK: `dict[k] += n` is a
+    read-modify-write, and submit() runs on every client thread at once —
+    under contention the GIL does NOT make it atomic, so the lock-free
+    version dropped counts (mxlint: lock-shared-mutation). The lock also
+    makes `serve_stats(reset=True)`'s snapshot+zero atomic: a reset can no
+    longer eat increments that land between the copy and the zeroing.
   * ServeMetrics — per-Server instance metrics with the derived views the
     counters cannot carry: latency p50/p95/p99 over a bounded reservoir,
     a batch-occupancy histogram keyed by bucket, live queue depth, and
@@ -43,14 +48,20 @@ SERVE_STATS = {
     "programs_compiled": 0,
 }
 
+# Guards every SERVE_STATS mutation (all Server instances, all threads).
+_STATS_LOCK = threading.Lock()
+
 
 def serve_stats(reset=False):
     """Snapshot of the process-wide serving counters (read via
-    `profiler.serve_stats()` or `mx.serve.stats()`)."""
-    snap = dict(SERVE_STATS)
-    if reset:
-        for k in SERVE_STATS:
-            SERVE_STATS[k] = 0
+    `profiler.serve_stats()` or `mx.serve.stats()`). The snapshot and the
+    optional reset are one atomic step, so no increment is ever lost
+    between them."""
+    with _STATS_LOCK:
+        snap = dict(SERVE_STATS)
+        if reset:
+            for k in SERVE_STATS:
+                SERVE_STATS[k] = 0
     return snap
 
 
@@ -83,7 +94,8 @@ class ServeMetrics:
     def count(self, key, n=1):
         with self._lock:
             self.counters[key] += n
-        SERVE_STATS[key] += n
+        with _STATS_LOCK:
+            SERVE_STATS[key] += n
 
     def set_queue_depth(self, depth):
         with self._lock:
@@ -104,8 +116,9 @@ class ServeMetrics:
             self.queue_depth = queue_depth
             if queue_depth > self.queue_depth_max:
                 self.queue_depth_max = queue_depth
-        SERVE_STATS["batches"] += 1
-        SERVE_STATS["padded_rows"] += pad
+        with _STATS_LOCK:
+            SERVE_STATS["batches"] += 1
+            SERVE_STATS["padded_rows"] += pad
         # Chrome-trace lane (no-op unless the profiler is running)
         from .. import profiler
         profiler.record_event(

@@ -1,0 +1,51 @@
+"""mxlint fixture: seeded lock-discipline violations. NEVER imported."""
+import threading
+
+WORK_STATS = {"items": 0, "drops": 0}
+
+_LOCK_A = threading.Lock()
+_LOCK_B = threading.Lock()
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._results = []
+        self._count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            if self._count < 0:
+                break
+            self._results.append(1)          # lock-shared-mutation (thread)
+            WORK_STATS["items"] += 1         # lock-shared-mutation (global)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._results), self._count
+
+    def reset(self):
+        self._results.clear()                # lock-shared-mutation (consumer)
+        with self._lock:
+            self._count = 0                  # locked: clean
+
+    def bump(self):
+        self._count += 1                     # lock-shared-mutation (consumer)
+
+    def drop(self):
+        self._results.append(2)  # mxlint: disable=lock-shared-mutation -- seeded suppression
+        with self._lock:
+            WORK_STATS["drops"] += 1         # locked: clean
+
+
+def path_ab():
+    with _LOCK_A:
+        with _LOCK_B:                        # edge A -> B
+            return 1
+
+
+def path_ba():
+    with _LOCK_B:
+        with _LOCK_A:                        # edge B -> A: lock-order-cycle
+            return 2

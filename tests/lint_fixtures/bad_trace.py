@@ -1,0 +1,61 @@
+"""mxlint fixture: seeded trace-safety violations. NEVER imported — the
+analyzer parses it; tests/test_lint.py asserts each rule fires exactly
+where expected and that suppressions silence them."""
+import os
+import random
+import time
+from time import time as now
+
+import jax
+import numpy as np
+from jax import random as jxrandom
+from numpy import asarray as as_np
+
+STATE = {"calls": 0}
+ACC = []
+
+
+def helper(x):
+    # reached transitively from kernel(): still flagged
+    return np.asarray(x)                              # trace-host-capture
+
+
+def kernel(x, scale):
+    bad = float(scale)                                # trace-host-capture
+    host = x.item()                                   # trace-host-capture
+    now = time.time()                                 # trace-impure-host
+    noise = random.random()                           # trace-impure-host
+    flag = os.environ.get("MXNET_FIXTURE_FLAG")       # trace-impure-host
+    later = now()                                     # trace-impure-host (from-import)
+    arr2 = as_np(x)                                   # trace-host-capture (from-import)
+    key = jxrandom.PRNGKey(0)                         # clean: jax.random, NOT stdlib
+    STATE["calls"] += 1                               # trace-closure-mutation
+    ACC.append(bad)                                   # trace-closure-mutation
+    time.sleep(0)  # mxlint: disable=trace-impure-host -- suppressed on purpose
+    return helper(x) * (now + noise + (1 if flag else 0))
+
+
+jitted = jax.jit(kernel)
+
+
+def make_step(buffers):
+    def step(x):
+        total = 0.0
+
+        def add(v):
+            nonlocal total
+            total += v                                # trace-closure-mutation
+            return v
+
+        buffers.append(x)                             # trace-closure-mutation
+        return add(x)
+
+    return jax.jit(step)
+
+
+def clean_host_code(x):
+    # NOT jit-reachable: none of these may be flagged
+    _ = float(x)
+    _ = time.time()
+    STATE["calls"] += 1
+    return x

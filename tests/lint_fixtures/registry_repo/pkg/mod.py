@@ -1,0 +1,28 @@
+"""mxlint fixture: a miniature package for the registry-consistency pass.
+NEVER imported — parsed only."""
+
+POINTS = {
+    "alpha.save": "wired and documented: clean",
+    "beta.load": "registered but never injected -> fault-point-unwired "
+                 "(and undocumented)",
+    "gamma.run": "wired but missing from RESILIENCE.md -> "
+                 "fault-point-undocumented",
+}
+
+PIPE_STATS = {"hits": 0, "misses": 0}
+
+
+def get_env(name, default=None):
+    return default
+
+
+def inject(point, value=None):
+    return value
+
+
+def f():
+    get_env("MXNET_FIXTURE_DOCUMENTED")
+    get_env("MXNET_FIXTURE_SECRET")      # env-undocumented
+    inject("alpha.save")
+    inject("gamma.run")
+    inject("delta.crash")                # fault-point-unregistered

@@ -1,0 +1,5 @@
+"""Fixture test file: exercises one PIPE_STATS key but not the other."""
+
+
+def check_hits():
+    assert "hits"

@@ -1,0 +1,289 @@
+"""mxlint (ISSUE 5): the analyzer gates tier-1.
+
+Three layers:
+  1. the REPO IS CLEAN — `run_all` over the live package with the
+     committed baseline yields zero new findings and zero stale baseline
+     entries, so any new violation fails the build;
+  2. each pass family detects its seeded fixture violations
+     (tests/lint_fixtures/) exactly where expected, and suppressions /
+     the baseline silence them;
+  3. the CLI contract: `python -m tools.mxlint --quick --json` emits
+     machine-readable findings and exit status 0 on the clean tree.
+
+The analyzer is import-light (stdlib ast only), so these tests cost
+parse time, not jax time.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from incubator_mxnet_tpu import analysis
+from incubator_mxnet_tpu.analysis import (lock_discipline,
+                                          registry_consistency,
+                                          trace_safety)
+from incubator_mxnet_tpu.analysis.core import Baseline, Module
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+
+def _fixture_module(name):
+    path = os.path.join(FIXTURES, name)
+    with open(path) as f:
+        src = f.read()
+    return Module(path, os.path.join("tests", "lint_fixtures", name), src)
+
+
+def _by_rule(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.rule, []).append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 1. the live repo is clean under the committed baseline
+# ---------------------------------------------------------------------------
+def test_repo_is_clean_under_baseline():
+    new, baselined, stale = analysis.run_all(
+        root=REPO,
+        baseline=os.path.join(REPO, analysis.DEFAULT_BASELINE))
+    assert not new, "new mxlint findings:\n" + "\n".join(
+        f"  {f.path}:{f.line}: [{f.rule}] {f.message}" for f in new)
+    assert not stale, ("baseline entries whose finding no longer exists — "
+                       "delete them from tools/mxlint_baseline.json:\n"
+                       + "\n".join(f"  {s}" for s in stale))
+    # the baseline documents intentional patterns; it must stay small
+    assert len(baselined) < 30
+
+
+def test_every_rule_name_is_registered():
+    for fam in analysis.PASS_FAMILIES.values():
+        for rule in fam.RULES:
+            assert rule in analysis.ALL_RULES
+    assert len(set(analysis.ALL_RULES)) == len(analysis.ALL_RULES)
+
+
+# ---------------------------------------------------------------------------
+# 2a. trace-safety fixtures
+# ---------------------------------------------------------------------------
+def test_trace_safety_fixture_findings():
+    mod = _fixture_module("bad_trace.py")
+    by = _by_rule(trace_safety.run([mod]))
+
+    cap = {(f.scope, f.symbol) for f in by["trace-host-capture"]}
+    assert ("kernel", "float(scale)") in cap
+    assert ("kernel", ".item") in cap
+    assert ("helper", "np.asarray") in cap     # transitive reachability
+
+    imp = {(f.scope, f.symbol) for f in by["trace-impure-host"]}
+    assert ("kernel", "time.time") in imp
+    assert ("kernel", "random.random") in imp
+    assert ("kernel", "os.environ.get") in imp
+    # `from time import time as now` resolves to the stdlib and fires ...
+    assert ("kernel", "numpy.asarray") in \
+        {(f.scope, f.symbol) for f in by["trace-host-capture"]}
+    assert any(f.symbol == "time.time" and "now()" in f.message
+               for f in by["trace-impure-host"])
+    # ... while `from jax import random as jxrandom` is NOT the stdlib
+    assert not any("jxrandom" in f.symbol or "PRNGKey" in f.symbol
+                   for fs in by.values() for f in fs)
+    # the suppressed time.sleep(0) must NOT appear
+    assert not any(f.symbol == "time.sleep"
+                   for f in by["trace-impure-host"])
+
+    mut = {(f.scope, f.symbol) for f in by["trace-closure-mutation"]}
+    assert ("kernel", "STATE") in mut
+    assert ("kernel", "ACC.append") in mut
+    assert ("make_step.step", "buffers.append") in mut
+    assert ("make_step.step.add", "total") in mut   # nonlocal rebind
+
+    # nothing in the non-jit function may fire
+    assert not any(f.scope == "clean_host_code"
+                   for fs in by.values() for f in fs)
+
+
+def test_trace_safety_line_anchoring():
+    mod = _fixture_module("bad_trace.py")
+    findings = trace_safety.run([mod])
+    for f in findings:
+        line = mod.lines[f.line - 1]
+        # every finding points at a line that names its symbol — either
+        # the canonical token or the local alias quoted in the message
+        # (`now() (= time.time) ...`)
+        token = f.symbol.split("(")[0].split(".")[-1] or f.symbol
+        local = f.message.split("(")[0].strip()
+        assert token in line or (local and local in line), (f, line)
+
+
+# ---------------------------------------------------------------------------
+# 2b. lock-discipline fixtures
+# ---------------------------------------------------------------------------
+def test_lock_discipline_fixture_findings():
+    mod = _fixture_module("bad_locks.py")
+    by = _by_rule(lock_discipline.run([mod]))
+
+    shared = {(f.scope, f.symbol) for f in by["lock-shared-mutation"]}
+    assert ("Worker._run", "self._results") in shared      # thread side
+    assert ("Worker.reset", "self._results") in shared     # consumer side
+    assert ("Worker.bump", "self._count") in shared        # off-lock
+    assert ("Worker._run", "WORK_STATS") in shared         # stats global
+    # locked mutations are clean
+    assert ("Worker.reset", "self._count") not in shared
+    assert ("Worker.drop", "WORK_STATS") not in shared
+    # the suppressed append in drop() must not fire
+    assert ("Worker.drop", "self._results") not in shared
+    # __init__ is exempt
+    assert not any(s.endswith(".__init__") for s, _ in shared)
+
+    cycles = by.get("lock-order-cycle", [])
+    assert len(cycles) == 1
+    assert "_LOCK_A" in cycles[0].message and "_LOCK_B" in cycles[0].message
+
+
+def test_lock_discipline_no_cycle_without_opposite_order():
+    mod = _fixture_module("bad_locks.py")
+    # drop the B->A function: the cycle disappears, shared findings stay
+    src = mod.source[:mod.source.index("def path_ba")]
+    clipped = Module(mod.path, mod.relpath, src)
+    by = _by_rule(lock_discipline.run([clipped]))
+    assert "lock-order-cycle" not in by
+    assert by["lock-shared-mutation"]
+
+
+# ---------------------------------------------------------------------------
+# 2c. registry-consistency fixtures (miniature repo tree)
+# ---------------------------------------------------------------------------
+def test_registry_consistency_fixture_findings():
+    root = os.path.join(FIXTURES, "registry_repo")
+    mods = analysis.load_modules(root, files=["pkg/mod.py"])
+    by = _by_rule(registry_consistency.run(mods, root))
+
+    assert {f.symbol for f in by["env-undocumented"]} == \
+        {"MXNET_FIXTURE_SECRET"}
+    assert {f.symbol for f in by["env-doc-stale"]} == {"MXNET_FIXTURE_GONE"}
+    assert {f.symbol for f in by["fault-point-unwired"]} == {"beta.load"}
+    assert {f.symbol for f in by["fault-point-undocumented"]} == \
+        {"beta.load", "gamma.run"}
+    assert {f.symbol for f in by["fault-point-unregistered"]} == \
+        {"delta.crash"}
+    assert {f.symbol for f in by["fault-doc-stale"]} == {"old.gone"}
+    assert {f.symbol for f in by["stats-key-untested"]} == {"misses"}
+
+
+# ---------------------------------------------------------------------------
+# 2d. baseline workflow
+# ---------------------------------------------------------------------------
+def test_baseline_partitions_and_detects_stale():
+    mod = _fixture_module("bad_locks.py")
+    findings = lock_discipline.run([mod])
+    target = next(f for f in findings if f.scope == "Worker.bump")
+    bl = Baseline({target.ident: "intentional for the test",
+                   "lock-shared-mutation:gone.py:X.y:self._z": "stale"})
+    new, baselined, stale = bl.split(findings)
+    assert target not in new and target in baselined
+    assert stale == ["lock-shared-mutation:gone.py:X.y:self._z"]
+    assert len(new) == len(findings) - 1
+
+
+def test_baseline_ident_is_line_number_free():
+    mod = _fixture_module("bad_trace.py")
+    f = trace_safety.run([mod])[0]
+    assert str(f.line) not in f.ident.split(":")  # stable across line drift
+    # prepending a comment shifts every line; idents must not change
+    shifted = Module(mod.path, mod.relpath, "# shim\n# shim\n" + mod.source)
+    idents = {x.ident for x in trace_safety.run([mod])}
+    idents_shifted = {x.ident for x in trace_safety.run([shifted])}
+    assert idents == idents_shifted
+
+
+def test_suppression_must_start_the_comment():
+    """Prose that merely mentions the syntax is not a suppression."""
+    mod = _fixture_module("bad_trace.py")
+    src = mod.source.replace(
+        "now = time.time()",
+        "now = time.time()  # TODO: maybe mxlint: disable=trace-impure-host")
+    assert src != mod.source
+    prosey = Module(mod.path, mod.relpath, src)
+    by = _by_rule(trace_safety.run([prosey]))
+    assert ("kernel", "time.time") in \
+        {(f.scope, f.symbol) for f in by["trace-impure-host"]}
+
+
+def test_file_level_suppression():
+    mod = _fixture_module("bad_trace.py")
+    src = ("# mxlint: disable-file=trace-impure-host\n" + mod.source)
+    silenced = Module(mod.path, mod.relpath, src)
+    by = _by_rule(trace_safety.run([silenced]))
+    assert "trace-impure-host" not in by
+    assert "trace-host-capture" in by      # other rules unaffected
+
+
+# ---------------------------------------------------------------------------
+# 3. CLI contract
+# ---------------------------------------------------------------------------
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", *args],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+
+
+def test_cli_quick_json_smoke():
+    r = _run_cli("--quick", "--json")
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    data = json.loads(r.stdout)
+    assert data["counts"]["new"] == 0
+    assert data["scope"] == "quick"
+    assert set(data["passes"]) == set(analysis.PASS_FAMILIES)
+    for f in data["baselined"]:
+        assert {"rule", "path", "line", "message", "ident"} <= set(f)
+
+
+def test_cli_full_run_is_clean():
+    r = _run_cli("--json")
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    data = json.loads(r.stdout)
+    assert data["counts"]["new"] == 0
+    assert data["counts"]["stale_baseline"] == 0
+    assert data["scope"] == "full"
+
+
+def test_cli_changed_mode_runs():
+    r = _run_cli("--changed", "--json")
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    data = json.loads(r.stdout)
+    # registry passes always run repo-wide, even with no changed files
+    assert data["scope"] == "changed"
+
+
+def test_partial_scope_never_reports_stale_baseline():
+    """A --quick/--changed scope skips files whose baselined findings
+    therefore aren't produced — that must NOT read as 'finding fixed'."""
+    new, baselined, stale = analysis.run_all(
+        root=REPO, files=["incubator_mxnet_tpu/serve/metrics.py"],
+        baseline=os.path.join(REPO, analysis.DEFAULT_BASELINE))
+    assert not new
+    assert stale == []
+
+    r = _run_cli("--quick", "--write-baseline")
+    assert r.returncode == 2     # partial scope must refuse to rewrite
+
+
+def test_cli_exit_one_on_violation(tmp_path):
+    # a synthetic repo with one seeded violation: exit status must be 1
+    pkg = tmp_path / "incubator_mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "bad.py").write_text(
+        "import jax\nimport time\n\n"
+        "def k(x):\n    return x + time.time()\n\n"
+        "j = jax.jit(k)\n")
+    (tmp_path / "docs").mkdir()
+    r = _run_cli("--root", str(tmp_path), "--no-baseline", "--json")
+    assert r.returncode == 1, f"stdout={r.stdout}\nstderr={r.stderr}"
+    data = json.loads(r.stdout)
+    assert data["counts"]["new"] == 1
+    assert data["findings"][0]["rule"] == "trace-impure-host"

@@ -375,3 +375,77 @@ def test_serve_bench_quick_smoke(tmp_path):
     # steady state stayed on the warmed bucket programs
     assert (data["batched"]["compile_cache_size_final"]
             == data["batched"]["compile_cache_size_after_warmup"])
+
+
+# ---------------------------------------------------------------------------
+# regression (mxlint lock-shared-mutation): SERVE_STATS increments are a
+# read-modify-write — off-lock they lose counts under thread contention,
+# and serve_stats(reset=True) could eat increments landing between its
+# snapshot and its zeroing. Both now run under metrics._STATS_LOCK.
+# ---------------------------------------------------------------------------
+def test_serve_stats_counters_exact_under_contention():
+    from incubator_mxnet_tpu.serve.metrics import ServeMetrics
+
+    n_threads, n_iter = 8, 500
+    before = profiler.serve_stats()
+    m = ServeMetrics()
+    errs = []
+
+    def hammer():
+        try:
+            for _ in range(n_iter):
+                m.count("requests")
+                m.observe_batch(bucket=2, occupancy=1, exec_ms=0.0,
+                                queue_depth=0)
+        except BaseException as e:   # pragma: no cover - diagnostics
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errs, errs
+
+    total = n_threads * n_iter
+    snap = m.snapshot()
+    assert snap["requests"] == total
+    assert snap["batches"] == total
+    assert snap["padded_rows"] == total          # one pad row per batch
+    after = profiler.serve_stats()
+    assert after["requests"] - before["requests"] == total
+    assert after["batches"] - before["batches"] == total
+    assert after["padded_rows"] - before["padded_rows"] == total
+
+
+def test_serve_stats_reset_is_atomic_with_snapshot():
+    from incubator_mxnet_tpu.serve import metrics as sm
+
+    profiler.serve_stats(reset=True)
+    stop = threading.Event()
+    sent = [0]
+
+    def incrementer():
+        m = sm.ServeMetrics()
+        n = 0
+        while not stop.is_set():
+            m.count("replies")
+            n += 1
+        sent[0] = n
+
+    t = threading.Thread(target=incrementer)
+    t.start()
+    try:
+        # snapshot+zero is one atomic step, so every increment lands in
+        # EXACTLY one reset window: the windowed sums must add up to the
+        # incrementer's own call count — the pre-fix racy reset lost the
+        # increments that arrived between its copy and its zeroing
+        collected = 0
+        for _ in range(200):
+            collected += profiler.serve_stats(reset=True)["replies"]
+    finally:
+        stop.set()
+        t.join(timeout=60)
+    collected += profiler.serve_stats(reset=True)["replies"]
+    assert collected == sent[0]
+    assert profiler.serve_stats()["replies"] == 0
