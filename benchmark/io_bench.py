@@ -278,6 +278,19 @@ def bench_overlap(quick=False, depth=2, trials=None, steps=None,
     return out
 
 
+def _finalize(out):
+    """Every io_bench artifact reports through the telemetry registry: the
+    feed/dispatch counter groups and span aggregates ride along, plus the
+    preflight verdict (backend_ok) benchdiff keys on."""
+    out["backend_ok"] = True
+    try:
+        from incubator_mxnet_tpu import telemetry
+        out["telemetry"] = telemetry.scalar_snapshot()
+    except Exception:
+        pass
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=768)
@@ -295,6 +308,18 @@ def main():
                     help="overlap mode: write <prefix>_before.json / "
                          "<prefix>_after.json artifact pair")
     args = ap.parse_args()
+
+    # backend preflight (io_bench forces the CPU backend, but even that can
+    # wedge): the artifact must say backend_ok=false, never crash silently
+    try:
+        import jax.numpy as _jnp
+        _jnp.zeros((2,)).block_until_ready()
+    except Exception as e:
+        print(json.dumps({"metric": "image_pipeline_images_per_sec",
+                          "backend_ok": False,
+                          "error": f"backend preflight failed: "
+                                   f"{type(e).__name__}: {e}"}))
+        return 1
 
     if args.overlap:
         pinned = not args.no_pin
@@ -347,7 +372,7 @@ def main():
             for suffix, payload in (("_before", before), ("_after", after)):
                 with open(args.pair_out + suffix + ".json", "w") as f:
                     json.dump(payload, f, indent=1)
-        print(json.dumps(out))
+        print(json.dumps(_finalize(out)))
         return
 
     if args.rec is None:
@@ -382,8 +407,8 @@ def main():
         # stage, given the measured per-core decode cost
         out["decode_only_ceiling_img_s_per_core"] = round(1000.0 / dec_ms, 1)
         out["decode_share"] = round(dec_ms / (dec_ms + aug_ms), 3)
-    print(json.dumps(out))
+    print(json.dumps(_finalize(out)))
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

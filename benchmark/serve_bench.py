@@ -196,6 +196,23 @@ def main():
     duration = args.duration or (2.0 if args.quick else 10.0)
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
 
+    # backend preflight: a dead backend must produce an artifact that SAYS
+    # so (backend_ok=false), never a crash or a fantasy-zero row
+    try:
+        import jax
+        import jax.numpy as jnp
+        jnp.zeros((2,)).block_until_ready()
+    except Exception as e:
+        out = {"meta": {"bench": "serve_bench"}, "backend_ok": False,
+               "error": f"backend preflight failed: "
+                        f"{type(e).__name__}: {e}"}
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps(out))
+        return 1
+
     with tempfile.TemporaryDirectory(prefix="serve_bench_") as d:
         model, sample, buckets = _build_and_export(args.quick, d)
         out = {"meta": {"bench": "serve_bench", "quick": bool(args.quick),
@@ -227,6 +244,14 @@ def main():
                 out["batched"]["requests_per_sec"] / base, 2) if base else None
             print(f"dynamic batching speedup: {out['speedup_vs_serial']}x")
 
+    # the artifact reports through the telemetry registry: serving counters
+    # (`serve.*`), span aggregates, and the preflight verdict ride along
+    out["backend_ok"] = True
+    try:
+        from incubator_mxnet_tpu import telemetry
+        out["telemetry"] = telemetry.scalar_snapshot()
+    except Exception:
+        pass
     os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)

@@ -66,14 +66,17 @@ def _module_locks(tree):
 
 def _module_stats_globals(tree):
     """Module-level `_UPPER_CASE` names (the stats-dict convention) —
-    including aliases like `_STATS = other.DICT`."""
+    including aliases like `_STATS = other.DICT` and telemetry-registry
+    adoptions like `X_STATS = stats_group("x", {...})` (the adopted group
+    IS the mutable dict; off-lock mutation rules apply unchanged)."""
     names = set()
     for node in tree.body:
         if isinstance(node, ast.Assign):
             for t in node.targets:
                 if isinstance(t, ast.Name) and _STATS_GLOBAL_RE.match(t.id):
                     if isinstance(node.value, (ast.Dict, ast.List,
-                                               ast.Attribute, ast.Name)):
+                                               ast.Attribute, ast.Name,
+                                               ast.Call)):
                         names.add(t.id)
     return names
 

@@ -23,11 +23,29 @@ Three symbol families, six rules:
     fault-doc-stale           a RESILIENCE.md table point not in POINTS
 
   profiler stats keys — module-level dict literals named `*_STATS`
-  (DISPATCH_STATS / SERVE_STATS / FEED_STATS) are the
-  `profiler.*_stats()` key surface.
+  (DISPATCH_STATS / SERVE_STATS / FEED_STATS / KV_STATS), whether assigned
+  bare or wrapped in a `stats_group("family", {...})` adoption call, are
+  the `profiler.*_stats()` / telemetry-group key surface.
 
     stats-key-untested  a stats key never appears in any tests/*.py —
                         nothing would notice the counter going dead
+
+  telemetry metric names — the registered surface is (a) every
+  `stats_group("family", {keys...})` adoption, contributing
+  `family.key` names, and (b) every literal-named object metric:
+  `REGISTRY.counter("a.b")` / `telemetry.histogram("a.b")` / bare
+  `counter|gauge|histogram("a.b", ...)` calls with a dotted lowercase
+  string first arg. The doc surface is the metric-catalog table in
+  docs/OBSERVABILITY.md.
+
+    telemetry-metric-undocumented  a registered metric name missing from
+                                   the OBSERVABILITY.md catalog
+    telemetry-doc-stale            a catalog row naming a metric that is
+                                   not registered anywhere
+    telemetry-metric-untested      an OBJECT metric's dotted name never
+                                   appears in tests (group keys are
+                                   already covered per-key by
+                                   stats-key-untested)
 
 All comparisons are literal-based on purpose: a knob that only exists
 behind computed strings is unauditable and should be rewritten, not
@@ -45,7 +63,9 @@ __all__ = ["run"]
 
 RULES = ("env-undocumented", "env-doc-stale", "fault-point-unwired",
          "fault-point-unregistered", "fault-point-undocumented",
-         "fault-doc-stale", "stats-key-untested")
+         "fault-doc-stale", "stats-key-untested",
+         "telemetry-metric-undocumented", "telemetry-doc-stale",
+         "telemetry-metric-untested")
 
 _ENV_RE = re.compile(r"MXNET_[A-Z0-9_]+")
 _STATS_NAME_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*_STATS$")
@@ -150,23 +170,89 @@ def _doc_points(doc_path):
     return text, table
 
 
+_METRIC_CTORS = {"counter", "gauge", "histogram"}
+_METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+
+
+def _stats_value_dict(value):
+    """The key-declaring Dict of a *_STATS assignment: a bare dict literal,
+    or the dict argument of a `stats_group("family", {...})` adoption call.
+    Returns (dict_node, family_or_None)."""
+    if isinstance(value, ast.Dict):
+        return value, None
+    if isinstance(value, ast.Call):
+        cname = call_name(value)
+        last = cname.split(".")[-1] if cname else None
+        if last and last.lstrip("_") == "stats_group":
+            family = str_const(value.args[0]) if value.args else None
+            for a in value.args:
+                if isinstance(a, ast.Dict):
+                    return a, family
+    return None, None
+
+
 def _stats_dicts(modules):
-    """[(dict_name, {key: line}, relpath, line)] for *_STATS literals."""
+    """[(dict_name, {key: line}, relpath, line, family)] for *_STATS
+    literals — bare dicts and stats_group-adopted dicts alike."""
     out = []
     for mod in modules:
         for node in mod.tree.body:
-            if isinstance(node, ast.Assign) \
-                    and isinstance(node.value, ast.Dict):
-                for t in node.targets:
-                    if isinstance(t, ast.Name) \
-                            and _STATS_NAME_RE.match(t.id):
-                        keys = {}
-                        for k in node.value.keys:
-                            s = str_const(k)
-                            if s:
-                                keys[s] = k.lineno
-                        out.append((t.id, keys, mod.relpath, node.lineno))
+            if not isinstance(node, ast.Assign):
+                continue
+            dct, family = _stats_value_dict(node.value)
+            if dct is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name) \
+                        and _STATS_NAME_RE.match(t.id):
+                    keys = {}
+                    for k in dct.keys:
+                        s = str_const(k)
+                        if s:
+                            keys[s] = k.lineno
+                    out.append((t.id, keys, mod.relpath, node.lineno,
+                                family))
     return out
+
+
+def _object_metrics(modules):
+    """{dotted_name: (relpath, line)} for literal-named object-metric
+    registrations: counter/gauge/histogram calls (any receiver — the
+    constructors only exist on the telemetry registry) whose first arg is
+    a dotted lowercase string literal."""
+    metrics = {}
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            cname = call_name(node)
+            last = cname.split(".")[-1] if cname else None
+            if last not in _METRIC_CTORS:
+                continue
+            lit = str_const(node.args[0])
+            if lit and _METRIC_NAME_RE.match(lit) \
+                    and lit not in metrics:
+                metrics[lit] = (mod.relpath, node.lineno)
+    return metrics
+
+
+def _doc_metrics(doc_path):
+    """{metric_name: line} for dotted names in the OBSERVABILITY.md metric
+    catalog (backticked dotted names in the first cell of table rows)."""
+    doc = {}
+    if not os.path.exists(doc_path):
+        return doc
+    with open(doc_path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            stripped = line.strip()
+            if not stripped.startswith("|"):
+                continue
+            first_cell = stripped.split("|")[1] if "|" in stripped[1:] \
+                else ""
+            for m in re.finditer(r"`([a-z0-9_.]+)`", first_cell):
+                if _METRIC_NAME_RE.match(m.group(1)):
+                    doc.setdefault(m.group(1), i)
+    return doc
 
 
 def _tests_text(tests_dir):
@@ -185,10 +271,11 @@ def _tests_text(tests_dir):
 
 def run(modules, root,
         env_doc="docs/ENV_VARS.md", resilience_doc="docs/RESILIENCE.md",
-        tests_dir="tests"):
+        obs_doc="docs/OBSERVABILITY.md", tests_dir="tests"):
     findings = []
     env_doc_path = os.path.join(root, env_doc)
     res_doc_path = os.path.join(root, resilience_doc)
+    obs_doc_path = os.path.join(root, obs_doc)
     tests_path = os.path.join(root, tests_dir)
 
     # ---- env vars ------------------------------------------------------
@@ -240,8 +327,9 @@ def run(modules, root,
 
     # ---- stats keys ----------------------------------------------------
     tests_text = _tests_text(tests_path)
+    stats = _stats_dicts(modules)
     if tests_text:
-        for dname, keys, relpath, dline in _stats_dicts(modules):
+        for dname, keys, relpath, dline, _family in stats:
             for key, line in sorted(keys.items()):
                 if f'"{key}"' in tests_text or f"'{key}'" in tests_text:
                     continue
@@ -250,4 +338,41 @@ def run(modules, root,
                     f"stats key `{dname}[{key!r}]` never appears in any "
                     f"test — nothing notices if the counter goes dead",
                     scope=dname, symbol=key))
+
+    # ---- telemetry metric names ---------------------------------------
+    # registered surface: stats_group families ({family}.{key}) + literal
+    # object metrics; doc surface: the OBSERVABILITY.md metric catalog
+    registered = {}
+    for dname, keys, relpath, dline, family in stats:
+        if family:
+            for key, line in keys.items():
+                registered.setdefault(f"{family}.{key}", (relpath, line))
+    objects = _object_metrics(modules)
+    registered.update(
+        {k: v for k, v in objects.items() if k not in registered})
+    doc_metrics = _doc_metrics(obs_doc_path)
+    if registered:
+        for name, (relpath, line) in sorted(registered.items()):
+            if name not in doc_metrics:
+                findings.append(Finding(
+                    "telemetry-metric-undocumented", relpath, line,
+                    f"telemetry metric `{name}` is registered here but "
+                    f"missing from the {obs_doc} catalog",
+                    scope="telemetry", symbol=name))
+        for name, line in sorted(doc_metrics.items()):
+            if name not in registered:
+                findings.append(Finding(
+                    "telemetry-doc-stale", obs_doc, line,
+                    f"{obs_doc} catalogs metric `{name}` which is not "
+                    f"registered anywhere — delete the row or register "
+                    f"the metric", scope="doc", symbol=name))
+    if tests_text:
+        for name, (relpath, line) in sorted(objects.items()):
+            if f'"{name}"' in tests_text or f"'{name}'" in tests_text:
+                continue
+            findings.append(Finding(
+                "telemetry-metric-untested", relpath, line,
+                f"telemetry metric `{name}` never appears (as a dotted "
+                f"literal) in any test — nothing notices it going dead",
+                scope="telemetry", symbol=name))
     return findings

@@ -21,7 +21,8 @@ from ..trainer import Trainer
 __all__ = ["Estimator", "EventHandler", "TrainBegin", "TrainEnd",
            "EpochBegin", "EpochEnd", "BatchBegin", "BatchEnd",
            "StoppingHandler", "MetricHandler", "ValidationHandler",
-           "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler"]
+           "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler",
+           "StepTimelineHandler"]
 
 
 class EventHandler:
@@ -275,6 +276,86 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         _write()
 
 
+class StepTimelineHandler(TrainBegin, BatchBegin, BatchEnd, TrainEnd):
+    """Per-step time attribution for a fit loop (telemetry.StepTimeline).
+
+    Every batch runs inside a `telemetry.span("train.step")`, diffing the
+    DeviceFeed stall clock and the kvstore allreduce clock around it, so
+    after (and during) the run `estimator.step_timeline` answers "where
+    did step time go" — data-stall vs compute vs (overlapped) H2D staging
+    vs allreduce — plus a live-counter MFU when FLOPs are known.
+
+    `flops_per_batch`: FLOPs of one train step. Default: on the first
+    batch, XLA-count the forward via `telemetry.block_fwd_flops` and use
+    the conventional 3x (fwd + 2x bwd) — the same numerator bench.py
+    uses. Pass `flops_per_batch=None, auto_flops=False` to skip MFU.
+    `peak_flops`: denominator; default `telemetry.device_peak_flops()`
+    (None on CPU — MFU is then omitted rather than wrong).
+
+    Attached automatically by `Estimator.fit` when `MXNET_TELEMETRY` is on
+    (the default) unless the caller already passed one."""
+
+    def __init__(self, flops_per_batch=None, peak_flops=None,
+                 auto_flops=True, priority=-2000):
+        self.flops_per_batch = flops_per_batch
+        self.peak_flops = peak_flops
+        self.auto_flops = auto_flops
+        self.priority = priority
+        self._tl = None
+        self._step_cm = None
+        # deferred-shape nets resolve on the FIRST forward, so the first
+        # batch_begin can't cost-count yet — retry a few batches before
+        # giving up on MFU for the run
+        self._flops_tries = 3
+
+    def train_begin(self, estimator, *args, **kwargs):
+        from ... import telemetry
+        self._close_step()       # a prior fit's exception-leaked step
+        self._tl = telemetry.StepTimeline(
+            flops_per_step=self.flops_per_batch,
+            peak_flops=self.peak_flops)
+        estimator.step_timeline = None
+
+    def _close_step(self):
+        if self._step_cm is not None:
+            self._step_cm.__exit__(None, None, None)
+            self._step_cm = None
+
+    def batch_begin(self, estimator, batch=None, **kwargs):
+        if self._tl is None:
+            return
+        # a step left open by an exception mid-batch (fit propagates, so
+        # batch_end never fired) is closed here — the failed batch's time
+        # is attributed and the span stack stays balanced
+        self._close_step()
+        if self.auto_flops and self._tl.flops_per_step is None \
+                and batch is not None:
+            # one XLA cost analysis per fit: the forward's compile lands
+            # in jax's jit cache, so the real loop does not re-pay it
+            try:
+                from ... import telemetry
+                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                self._tl.flops_per_step = 3.0 * telemetry.block_fwd_flops(
+                    estimator.net, x)
+            except Exception:
+                self._flops_tries -= 1
+                if self._flops_tries <= 0:
+                    self.auto_flops = False   # bounded: stop retrying
+        self._step_cm = self._tl.step()
+        self._step_cm.__enter__()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self._close_step()
+        estimator.step_timeline = self._tl.report()
+
+    def train_end(self, estimator, *args, **kwargs):
+        self._close_step()
+        if self._tl is not None and self._tl.steps:
+            estimator.step_timeline = self._tl.report()
+            estimator.logger.info("step timeline: %s",
+                                  estimator.step_timeline)
+
+
 class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
     """≙ event_handler.EarlyStoppingHandler."""
 
@@ -331,6 +412,9 @@ class Estimator:
         self.stop_training = False
         self.logger = logging.getLogger("mxnet.estimator")
         self._resume_epoch = 0
+        # written by StepTimelineHandler: per-step time attribution +
+        # (when FLOPs are known) live-counter MFU for the last fit()
+        self.step_timeline = None
 
     # ------------------------------------------------------------------
     def evaluate(self, val_data):
@@ -364,6 +448,15 @@ class Estimator:
         handlers = list(event_handlers or [])
         handlers.append(StoppingHandler(epochs, batches))
         handlers.append(MetricHandler(self.train_metrics))
+        # step-timeline attribution (MXNET_TELEMETRY, default on): spans +
+        # stall/compute split are near-free; MFU needs FLOPs, so the
+        # auto-attached handler skips the extra cost-analysis compile —
+        # pass StepTimelineHandler(auto_flops=True) (or flops_per_batch=)
+        # to get mfu in estimator.step_timeline
+        if get_env("MXNET_TELEMETRY", True, typ=bool) and \
+                not any(isinstance(h, StepTimelineHandler)
+                        for h in handlers):
+            handlers.append(StepTimelineHandler(auto_flops=False))
         if val_data is not None:
             handlers.append(ValidationHandler(
                 val_data, self.evaluate))
@@ -386,7 +479,7 @@ class Estimator:
                 if self.stop_training:
                     break
                 x, y = batch[0], batch[1]
-                emit("batch_begin")
+                emit("batch_begin", batch=batch)
                 with autograd.record():
                     pred = self.net(x)
                     loss = self.loss(pred, y)

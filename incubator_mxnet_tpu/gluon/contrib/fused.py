@@ -364,6 +364,46 @@ class FusedTrainStep:
         return jax.jit(step, donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------
+    def flops_per_call(self, *inputs):
+        """XLA-counted FLOPs of ONE compiled step call (cost analysis of
+        the lowered fwd+loss+bwd+update program, MAC=2 — the same
+        convention as chip peak specs). With `steps_per_call=K` this is
+        the K-step program's total; divide by K for per-step. The lowering
+        compiles into jax's jit cache, so a subsequent real `step(...)`
+        with the same shapes does not re-pay it. This is the MFU
+        numerator `telemetry.StepTimeline(flops_per_step=...)` wants —
+        live-counter MFU instead of hand-math."""
+        import jax
+        from ...ndarray import NDArray
+        from ...optimizer import _state_bufs
+        from ...telemetry import cost_flops
+
+        self._ensure_states()
+        if self._jit is None:
+            self._jit = self._build()
+        opt = self._opt
+        lrs = _np.asarray([opt._get_lr(i) for i in self._train_idx],
+                          _np.float32)
+        wds = _np.asarray([opt._get_wd(i) for i in self._train_idx],
+                          _np.float32)
+        ts = (_np.asarray([1.0] * len(self._train_idx), _np.float32)
+              if type(opt)._step_takes_t() else None)
+        # fixed key: only shapes matter for lowering, and consuming the
+        # global RNG stream here would silently change training
+        # reproducibility for callers that cost-count before training
+        key = jax.random.PRNGKey(0)
+        train_bufs = [self._params[i].data()._arr for i in self._train_idx]
+        frozen_bufs = [self._params[i].data()._arr
+                       for i in self._frozen_idx]
+        sbufs = [_state_bufs(s) for s in self._states]
+        in_raw = tuple(
+            _stage_raw(a._arr if isinstance(a, NDArray) else a)
+            for a in inputs)
+        lowered = self._jit.lower(
+            train_bufs, sbufs, frozen_bufs, key, lrs, wds,
+            _np.float32(opt.rescale_grad), ts, *in_raw)
+        return cost_flops(lowered, what="the fused step")
+
     def __call__(self, *inputs):
         from ... import random as _random
         from ...ndarray import NDArray, _wrap

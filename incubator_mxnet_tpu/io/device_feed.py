@@ -41,17 +41,20 @@ import time
 import numpy as _np
 
 from ..base import MXNetError, get_env
+from ..telemetry.registry import stats_group as _stats_group
 
 __all__ = ["DeviceFeed", "prefetch_to_device", "feed_stats",
            "maybe_device_put", "FEED_STATS"]
 
 
 # ---------------------------------------------------------------------------
-# counters (always on — plain increments under one lock, like DISPATCH_STATS)
+# counters (always on — plain increments under one lock, like DISPATCH_STATS;
+# adopted into the telemetry registry as the `feed` stats group, so they
+# surface in telemetry.snapshot()/prometheus_text() too)
 # ---------------------------------------------------------------------------
 _STATS_LOCK = threading.Lock()
 
-FEED_STATS = {
+FEED_STATS = _stats_group("feed", {
     "batches_fed": 0,          # staged + buffered by feeder threads
     "batches_consumed": 0,     # delivered to the consumer
     "epochs": 0,               # completed feed iterations
@@ -60,11 +63,14 @@ FEED_STATS = {
     "device_put_skipped": 0,   # already committed + right sharding: no copy
     "stall_data_us": 0.0,      # consumer waited on an EMPTY buffer
     "stall_compute_us": 0.0,   # feeder waited on a FULL buffer
+    "stage_us": 0.0,           # feeder staging time (decode handoff + async
+    #                            H2D dispatch) — overlaps compute by design
     "occupancy_sum": 0,        # buffer depth seen at each consume (incl. the
     "occupancy_samples": 0,    # batch being taken)
     "restarts": 0,             # transient feeder errors retried in place
     "failures": 0,             # terminal feeder failures re-raised downstream
-}
+}, lock=_STATS_LOCK,
+    help="device-feed input-pipeline counters (profiler.feed_stats)")
 
 
 def _bump(key, delta=1):
@@ -75,12 +81,10 @@ def _bump(key, delta=1):
 def feed_stats(reset=False):
     """Snapshot of the device-feed counters (plus derived
     `occupancy_mean`). `reset=True` zeroes the counters after the
-    snapshot. Exposed as `profiler.feed_stats()`."""
-    with _STATS_LOCK:
-        snap = dict(FEED_STATS)
-        if reset:
-            for k, v in FEED_STATS.items():
-                FEED_STATS[k] = type(v)()
+    snapshot (atomically — no increment is lost between copy and zero).
+    Exposed as `profiler.feed_stats()`; the same counters surface in
+    `telemetry.snapshot()` as `feed.*`."""
+    snap = FEED_STATS.snapshot(reset=reset)
     snap["occupancy_mean"] = (
         snap["occupancy_sum"] / snap["occupancy_samples"]
         if snap["occupancy_samples"] else 0.0)
@@ -275,11 +279,9 @@ class DeviceFeed:
             FEED_STATS["occupancy_sum"] += self._queue.qsize() + 1
             FEED_STATS["occupancy_samples"] += 1
             FEED_STATS["batches_consumed"] += 1
-        from .. import profiler
-        if profiler.is_running():
-            profiler.record_event(
-                "io.feed", "io", waited_us, ts_us=t0 * 1e6,
-                args={"buffer": self._queue.qsize()})
+        from ..telemetry import record_span
+        record_span("io.feed", waited_us, ts_us=t0 * 1e6, cat="io",
+                    buffer=self._queue.qsize())
         return item
 
     next = __next__
@@ -336,7 +338,7 @@ class DeviceFeed:
 
     # -- feeder thread --------------------------------------------------
     def _worker(self, q, stop):
-        from .. import profiler
+        from ..telemetry import record_span
         fetch = _fetch_with_restarts(self._source, "io.device_feed",
                                      self._max_restarts,
                                      on_restart=lambda: _bump("restarts"))
@@ -358,9 +360,8 @@ class DeviceFeed:
                 _bump("failures")
                 self._put(q, stop, _FeedFailure(e))
                 return
-            if profiler.is_running():
-                profiler.record_event("feed.stage", "io", stage_us,
-                                      ts_us=t0 * 1e6)
+            _bump("stage_us", stage_us)
+            record_span("feed.stage", stage_us, ts_us=t0 * 1e6, cat="io")
             if not self._put(q, stop, staged):
                 return
             _bump("batches_fed")

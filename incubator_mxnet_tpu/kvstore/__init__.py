@@ -26,13 +26,46 @@ decision logic.
 from __future__ import annotations
 
 import pickle
+import threading
+import time as _time
 
 import numpy as _np
 
 from ..base import MXNetError, get_env
 from .. import fault as _fault
+from ..telemetry.registry import stats_group as _stats_group
 
-__all__ = ["KVStore", "KVStoreBase", "create"]
+__all__ = ["KVStore", "KVStoreBase", "create", "KV_STATS"]
+
+# Collective timings for step-timeline attribution (telemetry.StepTimeline
+# diffs allreduce_us around each train step — the distributed analog of the
+# DeviceFeed stall clock). Increments under _KV_STATS_LOCK; `allreduce_us`
+# is DISPATCH-side wall time of the bucketed collective (concatenate +
+# collective issue + result split) — buckets dispatch asynchronously, so
+# device-side reduction overlap is measured by benchmark/overlap_bench.py,
+# not here.
+_KV_STATS_LOCK = threading.Lock()
+
+KV_STATS = _stats_group("kvstore", {
+    "allreduce_us": 0.0,       # wall time inside bucketed-collective calls
+    "allreduce_buckets": 0,    # collective buckets dispatched
+    "allreduce_bytes": 0,      # payload bytes across those buckets
+}, lock=_KV_STATS_LOCK,
+    help="kvstore collective timings (telemetry step-timeline attribution)")
+
+
+def _note_allreduce(t0, nbytes, keys):
+    """One collective bucket dispatched at perf_counter seconds `t0`:
+    advance the KV_STATS clocks and record the `kv.allreduce` span lane —
+    the single implementation both collective paths share."""
+    from ..telemetry import record_span
+    dur_us = (_time.perf_counter() - t0) * 1e6
+    with _KV_STATS_LOCK:
+        KV_STATS["allreduce_us"] += dur_us
+        KV_STATS["allreduce_buckets"] += 1
+        KV_STATS["allreduce_bytes"] += nbytes
+    record_span("kv.allreduce", dur_us, ts_us=t0 * 1e6, cat="kv",
+                nbytes=nbytes, keys=keys)
 
 
 class KVStoreBase:
@@ -144,8 +177,12 @@ class KVStore(KVStoreBase):
         from ..ndarray import NDArray, array
         _fault.inject("kvstore.collective")
         raw = agg._arr if isinstance(agg, NDArray) else agg
+        t0 = _time.perf_counter()
         gathered = multihost_utils.process_allgather(raw)  # (P, *shape)
-        return array(_np.asarray(gathered).sum(axis=0))
+        out = array(_np.asarray(gathered).sum(axis=0))
+        _note_allreduce(t0, nbytes=int(getattr(raw, "size", 0)) * getattr(
+            getattr(raw, "dtype", None), "itemsize", 4), keys=1)
+        return out
 
     _BUCKET_BYTES = 4 << 20   # ≙ kvstore_dist key-sharding granularity
 
@@ -195,9 +232,12 @@ class KVStore(KVStoreBase):
                 pending.append(bucket)
             reduced = []
             for bucket in pending:   # async dispatch: transfers overlap
+                t0 = _time.perf_counter()
                 flat = jnp.concatenate([raws[i].reshape(-1)
                                         for i in bucket])
                 reduced.append((bucket, reduce_flat(flat)))
+                _note_allreduce(t0, nbytes=int(flat.size)
+                                * flat.dtype.itemsize, keys=len(bucket))
             for bucket, red in reduced:
                 off = 0
                 for i in bucket:

@@ -85,12 +85,10 @@ def set_dispatch_jit(flag):
 def dispatch_stats(reset=False):
     """Snapshot of the dispatch counters (dispatch count, fast-path hits,
     key/jit/vjp-cache hits, bulking-cache hits, flush count). Observable via
-    profiler.dispatch_stats() and engine.stats()."""
-    snap = dict(_STATS)
-    if reset:
-        for k in _STATS:
-            _STATS[k] = 0
-    return snap
+    profiler.dispatch_stats() and engine.stats(); the same counters surface
+    in telemetry.snapshot() as `dispatch.*` (the dict is a registry-adopted
+    StatsGroup). snapshot+zero is one atomic step."""
+    return _STATS.snapshot(reset=reset)
 
 
 class OpInfo:

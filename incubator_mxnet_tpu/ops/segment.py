@@ -43,6 +43,7 @@ from collections import OrderedDict
 import numpy as _np
 
 from ..base import get_env
+from ..telemetry.registry import stats_group as _stats_group
 
 __all__ = ["enabled", "enqueue", "derive_key", "derive_key_cached",
            "flush_all", "current_size", "Reject", "canon", "DISPATCH_STATS"]
@@ -53,8 +54,11 @@ __all__ = ["enabled", "enqueue", "derive_key", "derive_key_cached",
 # counters) and this module (bulking-cache counters) both increment it, and
 # profiler.dispatch_stats() / engine.stats() read it. Plain int += under the
 # GIL: the counters are diagnostics, exact cross-thread interleaving does
-# not matter.
-DISPATCH_STATS = {
+# not matter. Adopted into the telemetry registry as the `dispatch` stats
+# group (telemetry/registry.py StatsGroup): the hot path is still a native
+# dict write — the group only adds atomic snapshot(reset) and membership in
+# telemetry.snapshot()/prometheus_text().
+DISPATCH_STATS = _stats_group("dispatch", {
     "dispatch": 0,            # total ops.registry.invoke() calls
     "bulked": 0,              # invokes deferred into a Segment
     "fast_path": 0,           # immediate invokes served by a cached compiled kernel
@@ -67,7 +71,7 @@ DISPATCH_STATS = {
     "replay_cache_hit": 0, "replay_cache_miss": 0,  # bulked-segment replays
     "aval_cache_hit": 0, "aval_cache_miss": 0,      # eval_shape memo
     "segment_flush": 0,
-}
+}, help="eager-dispatch counters (profiler.dispatch_stats)")
 
 _MAX_OPS_DEFAULT = 4096
 # Replay entries hold a jitted callable whose closure carries no array
@@ -217,7 +221,10 @@ def derive_key_cached(fn):
     k = derive_key(fn)
     if _key_memoizable(fn):
         try:
-            _KEY_MEMO[fn] = _NO_KEY if k is None else k
+            # memo write is idempotent (same fn -> same key) and the hot
+            # path tolerates a lost race: GIL-atomic dict store by design,
+            # like the DISPATCH_STATS increments around it
+            _KEY_MEMO[fn] = _NO_KEY if k is None else k  # mxlint: disable=lock-shared-mutation -- idempotent GIL-atomic memo store on the per-op hot path
         except TypeError:
             pass
     return k

@@ -32,7 +32,16 @@ _state = {"running": False, "config": {}, "jax_trace_dir": None,
 
 
 def _now_us():
-    return time.perf_counter() * 1e6
+    """Event timestamp in microseconds on ONE process-wide monotonic clock.
+
+    `perf_counter_ns` is CLOCK_MONOTONIC(_RAW): a single epoch shared by
+    every thread in the process (unlike per-thread CPU clocks), so events
+    recorded from threaded feeders/batchers interleave in true
+    happens-before order in the Chrome trace, and never go backwards on
+    NTP steps the way wall-clock timestamps would. Integer nanoseconds
+    avoid the float precision loss `perf_counter()*1e6` accumulates after
+    long uptimes (floats lose sub-µs resolution past ~2**33 µs)."""
+    return time.perf_counter_ns() // 1000
 
 
 def set_config(**kwargs):
@@ -101,10 +110,18 @@ def record_event(name, category, dur_us, ts_us=None, args=None):
 
 
 def dump(finished=True, profile_process="worker", filename=None):
-    """Write Chrome tracing JSON (≙ profiler.dump)."""
+    """Write Chrome tracing JSON (≙ profiler.dump). The telemetry registry
+    snapshot rides along under `otherData.telemetry` (trace viewers ignore
+    unknown top-level keys), so one artifact carries both the timeline and
+    the counter state at dump time."""
     fname = filename or _state["config"].get("filename", "profile.json")
     with _lock:
         payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    try:
+        from . import telemetry
+        payload["otherData"] = {"telemetry": telemetry.snapshot()}
+    except Exception:
+        pass
     with open(fname, "w") as f:
         json.dump(payload, f)
     return fname
@@ -151,7 +168,14 @@ def feed_stats(reset=False):
 
 
 def dumps(reset=False, format="table"):
-    """Aggregate stats table (≙ profiler.dumps / aggregate_stats.cc)."""
+    """Aggregate stats table (≙ profiler.dumps / aggregate_stats.cc).
+
+    The table carries three sections: the Chrome-trace event aggregate,
+    the telemetry span aggregate (`span.duration_us` histogram per span
+    name — populated even when the event profiler never ran), and the
+    full telemetry registry snapshot (dispatch/serve/feed/kvstore counter
+    groups + every registered metric). `format="json"` returns the same
+    content as a JSON string."""
     with _lock:
         agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
         for e in _events:
@@ -162,6 +186,21 @@ def dumps(reset=False, format="table"):
             a[3] = max(a[3], e["dur"])
         if reset:
             _events.clear()
+    try:
+        from . import telemetry
+        snap = telemetry.snapshot()
+    except Exception:
+        snap = {}
+    spans = {k: v for k, v in snap.items()
+             if k.startswith("span.duration_us")}
+    scalars = {k: v for k, v in snap.items() if not isinstance(v, dict)}
+    if format == "json":
+        return json.dumps({
+            "events": {name: {"calls": a[0], "total_us": a[1],
+                              "min_us": (0.0 if a[0] == 0 else a[2]),
+                              "max_us": a[3]}
+                       for name, a in agg.items()},
+            "telemetry": snap}, sort_keys=True)
     lines = [f"{'Name':<40}{'Calls':>8}{'Total(us)':>14}{'Min(us)':>12}"
              f"{'Max(us)':>12}",
              "-" * 86]
@@ -169,6 +208,21 @@ def dumps(reset=False, format="table"):
                                                key=lambda kv: -kv[1][1]):
         lines.append(f"{name:<40}{calls:>8}{total:>14.1f}{mn:>12.1f}"
                      f"{mx:>12.1f}")
+    if spans:
+        lines.append("")
+        lines.append(f"{'Span (telemetry)':<40}{'Count':>8}"
+                     f"{'Total(us)':>14}{'Min(us)':>12}{'Max(us)':>12}")
+        lines.append("-" * 86)
+        for name, h in sorted(spans.items(), key=lambda kv: -kv[1]["sum"]):
+            lines.append(f"{name:<40}{h['count']:>8}{h['sum']:>14.1f}"
+                         f"{h['min']:>12.1f}{h['max']:>12.1f}")
+    if scalars:
+        lines.append("")
+        lines.append(f"{'Telemetry metric':<56}{'Value':>20}")
+        lines.append("-" * 86)
+        for name, v in sorted(scalars.items()):
+            vv = f"{v:.1f}" if isinstance(v, float) else str(v)
+            lines.append(f"{name:<56}{vv:>20}")
     return "\n".join(lines)
 
 

@@ -16,7 +16,13 @@ layer above it:
   serve.stats()         process-wide serving counters (also
                         `profiler.serve_stats()`); per-server metrics —
                         requests/s, p50/p95/p99 latency, batch-occupancy
-                        histogram, queue depth — via `Server.stats()`
+                        histogram, queue depth, request-timeline
+                        queue-wait vs execute split — via `Server.stats()`
+  serve.metrics_text()  Prometheus text of the telemetry registry;
+                        `Server.metrics_text()` appends per-server gauges
+                        and `serve.start_metrics_server(port)` (or
+                        MXNET_METRICS_PORT at `Server.start()`) serves it
+                        at `/metrics`
 
 Overload behavior is explicit, not emergent: admission control bounds the
 queue (`MXNET_SERVE_MAX_QUEUE`), the overload policy picks reject-newest
@@ -29,6 +35,7 @@ docs/SERVING.md.
 from __future__ import annotations
 
 from ..base import _register_env
+from ..telemetry import metrics_text, start_metrics_server
 from .batcher import (ServeError, QueueFullError, RequestTimeout,
                       ServerClosed, BucketedModel, CallableModel, Server,
                       pick_bucket)
@@ -38,6 +45,7 @@ __all__ = [
     "Server", "BucketedModel", "CallableModel", "pick_bucket",
     "ServeError", "QueueFullError", "RequestTimeout", "ServerClosed",
     "ServeMetrics", "SERVE_STATS", "stats",
+    "metrics_text", "start_metrics_server",
 ]
 
 _register_env("MXNET_SERVE_MAX_QUEUE", int, 256,

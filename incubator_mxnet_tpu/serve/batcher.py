@@ -290,6 +290,21 @@ class Server:
             for b in self.model.batch_sizes:
                 if self._mark_warm(b):
                     self.metrics.count("programs_compiled")
+        port = get_env("MXNET_METRICS_PORT", typ=int)
+        if port is not None:
+            # process-wide singleton: a second Server must not rebind the
+            # port, and closing one Server must not tear the endpoint down
+            # under the others — it lives until process exit. A bind
+            # failure (port held by ANOTHER process) must not abort
+            # serving: observability is optional, inference is not.
+            from .. import telemetry
+            try:
+                telemetry.ensure_metrics_server(port)
+            except OSError as e:
+                import logging
+                logging.getLogger("mx.serve").warning(
+                    "metrics endpoint on port %s unavailable (%s); "
+                    "serving continues without /metrics", port, e)
         self._started = True
         self._thread.start()
         return self
@@ -391,11 +406,26 @@ class Server:
 
     def stats(self):
         """Metrics snapshot + compile accounting for the zero-retrace
-        assertion."""
+        assertion. `out["timeline"]` carries the request-time attribution
+        (queue-wait vs execute — the serving data-stall/compute split)."""
         out = self.metrics.snapshot()
         out["buckets"] = list(self.model.batch_sizes)
         out["compile_cache_size"] = self.model.compile_cache_size()
         return out
+
+    def timeline(self):
+        """Request-timeline attribution only: where request time went."""
+        return self.metrics.snapshot()["timeline"]
+
+    def metrics_text(self):
+        """Prometheus text: the process-wide telemetry registry (includes
+        the `serve` counter group and the `serve.batch` span histogram)
+        plus this server's per-instance gauges — what the `/metrics`
+        endpoint (`telemetry.start_metrics_server` / MXNET_METRICS_PORT)
+        serves, with the per-server lines appended."""
+        from .. import telemetry
+        return telemetry.metrics_text() + "\n".join(
+            self.metrics.prometheus_lines(server=self.name)) + "\n"
 
     # -- batcher thread ----------------------------------------------------
     def _assemble(self):
@@ -464,7 +494,11 @@ class Server:
                 _fail(req, err)
             return
         exec_ms = (time.perf_counter() - t0) * 1e3
-        self.metrics.observe_batch(bucket, n, exec_ms, depth)
+        # queue wait summed over the batch's requests: the request-timeline
+        # split (queued vs executing) Server.stats()["timeline"] reports
+        wait_ms = sum((t0 - req.t_submit) * 1e3 for req in batch)
+        self.metrics.observe_batch(bucket, n, exec_ms, depth,
+                                   queue_wait_ms=wait_ms)
         try:
             _fault.inject("serve.reply")
         except BaseException as e:

@@ -29,6 +29,8 @@ import threading
 import time
 from collections import deque
 
+from ..telemetry.registry import stats_group as _stats_group
+
 __all__ = ["SERVE_STATS", "ServeMetrics", "serve_stats", "percentile"]
 
 # Process-wide aggregate (all Server instances). Field meanings:
@@ -42,14 +44,19 @@ __all__ = ["SERVE_STATS", "ServeMetrics", "serve_stats", "percentile"]
 #   padded_rows     pad rows added to round batches up to their bucket
 #   programs_compiled  first-execution compiles (bucket warmups); steady
 #                      state MUST hold this flat (zero-retrace contract)
-SERVE_STATS = {
+# Guards every SERVE_STATS mutation (all Server instances, all threads).
+_STATS_LOCK = threading.Lock()
+
+# Adopted into the telemetry registry as the `serve` stats group: increments
+# stay `SERVE_STATS[k] += n` under _STATS_LOCK (the group's owner lock is
+# THE SAME lock object, so snapshot+zero excludes concurrent increments),
+# and the counters surface in telemetry.snapshot()/prometheus_text().
+SERVE_STATS = _stats_group("serve", {
     "requests": 0, "replies": 0, "rejected": 0, "shed": 0,
     "timeouts": 0, "errors": 0, "batches": 0, "padded_rows": 0,
     "programs_compiled": 0,
-}
-
-# Guards every SERVE_STATS mutation (all Server instances, all threads).
-_STATS_LOCK = threading.Lock()
+}, lock=_STATS_LOCK,
+    help="process-wide serving counters (profiler.serve_stats)")
 
 
 def serve_stats(reset=False):
@@ -57,12 +64,7 @@ def serve_stats(reset=False):
     `profiler.serve_stats()` or `mx.serve.stats()`). The snapshot and the
     optional reset are one atomic step, so no increment is ever lost
     between them."""
-    with _STATS_LOCK:
-        snap = dict(SERVE_STATS)
-        if reset:
-            for k in SERVE_STATS:
-                SERVE_STATS[k] = 0
-    return snap
+    return SERVE_STATS.snapshot(reset=reset)
 
 
 def percentile(sorted_vals, q):
@@ -90,6 +92,11 @@ class ServeMetrics:
         self.counters = {k: 0 for k in SERVE_STATS}
         self.queue_depth = 0
         self.queue_depth_max = 0
+        # request-timeline attribution: total time requests spent QUEUED
+        # (waiting for a batch slot — the serving analog of data-stall)
+        # vs total batch EXECUTION time (the compute side)
+        self.queue_wait_ms_total = 0.0
+        self.exec_ms_total = 0.0
 
     def count(self, key, n=1):
         with self._lock:
@@ -103,8 +110,11 @@ class ServeMetrics:
             if depth > self.queue_depth_max:
                 self.queue_depth_max = depth
 
-    def observe_batch(self, bucket, occupancy, exec_ms, queue_depth):
-        """One executed batch: occupancy rows served out of `bucket` slots."""
+    def observe_batch(self, bucket, occupancy, exec_ms, queue_depth,
+                      queue_wait_ms=0.0):
+        """One executed batch: occupancy rows served out of `bucket` slots.
+        `queue_wait_ms` is the SUM over the batch's requests of their time
+        spent queued (request-timeline attribution: wait vs compute)."""
         pad = bucket - occupancy
         with self._lock:
             self.counters["batches"] += 1
@@ -116,15 +126,17 @@ class ServeMetrics:
             self.queue_depth = queue_depth
             if queue_depth > self.queue_depth_max:
                 self.queue_depth_max = queue_depth
+            self.queue_wait_ms_total += queue_wait_ms
+            self.exec_ms_total += exec_ms
         with _STATS_LOCK:
             SERVE_STATS["batches"] += 1
             SERVE_STATS["padded_rows"] += pad
-        # Chrome-trace lane (no-op unless the profiler is running)
-        from .. import profiler
-        profiler.record_event(
-            "serve.batch", "serve", exec_ms * 1000.0,
-            args={"bucket": bucket, "occupancy": occupancy,
-                  "queue_depth": queue_depth})
+        # unified span lane: `span.duration_us{name="serve.batch"}` in the
+        # registry + a "serve.batch" Chrome-trace event while profiling
+        from ..telemetry import record_span
+        record_span("serve.batch", exec_ms * 1000.0, cat="serve",
+                    bucket=bucket, occupancy=occupancy,
+                    queue_depth=queue_depth)
 
     def observe_latency(self, ms):
         with self._lock:
@@ -139,6 +151,8 @@ class ServeMetrics:
                        "mean_occupancy": round(r[1] / (r[0] * b), 4)}
                    for b, r in sorted(self._occupancy.items())}
             depth, depth_max = self.queue_depth, self.queue_depth_max
+            wait_ms = self.queue_wait_ms_total
+            exec_ms = self.exec_ms_total
         out = dict(counters)
         out["queue_depth"] = depth
         out["queue_depth_max"] = depth_max
@@ -149,4 +163,36 @@ class ServeMetrics:
         for q in (50, 95, 99):
             v = percentile(lat, q)
             out[f"p{q}_ms"] = round(v, 3) if v is not None else None
+        # request timeline: where did request time go — queued (the serving
+        # data-stall) vs executing (compute)? wait is summed PER REQUEST,
+        # exec per batch, so wait can exceed exec under deep queues.
+        busy = wait_ms + exec_ms
+        out["timeline"] = {
+            "queue_wait_ms": round(wait_ms, 3),
+            "exec_ms": round(exec_ms, 3),
+            "queue_wait_pct": round(100.0 * wait_ms / busy, 2) if busy
+            else 0.0,
+            "exec_pct": round(100.0 * exec_ms / busy, 2) if busy else 0.0,
+        }
         return out
+
+    def prometheus_lines(self, server="serve"):
+        """Per-server gauges in Prometheus text form — appended to the
+        process registry text by `Server.metrics_text()` (per-instance
+        state lives here, not in the process-wide SERVE_STATS group)."""
+        from ..telemetry.registry import _prom_label_value
+        snap = self.snapshot()
+        lab = f'{{server="{_prom_label_value(server)}"}}'
+        lines = []
+        for k in ("queue_depth", "queue_depth_max", "requests_per_sec",
+                  "elapsed_s"):
+            lines.append(f"mx_server_{k}{lab} {snap[k]}")
+        for q in (50, 95, 99):
+            v = snap[f"p{q}_ms"]
+            if v is not None:
+                lines.append(f"mx_server_latency_p{q}_ms{lab} {v}")
+        tl = snap["timeline"]
+        lines.append(f"mx_server_queue_wait_ms_total{lab} "
+                     f"{tl['queue_wait_ms']}")
+        lines.append(f"mx_server_exec_ms_total{lab} {tl['exec_ms']}")
+        return lines

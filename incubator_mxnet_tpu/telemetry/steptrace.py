@@ -1,0 +1,441 @@
+"""Step-timeline attribution: spans, per-step breakdown, live-counter MFU.
+
+Before this module the Chrome-trace lanes (`serve.batch`, `io.feed`,
+`feed.stage`) each hand-rolled their `profiler.record_event` call and no
+single object could answer "where did this step's time go?". Now:
+
+  * `span(name, **attrs)` — nesting-aware tracer. Every span lands in the
+    profiler's Chrome-trace buffer (cat "span", with its parent's name in
+    args so the tree reconstructs) AND in the registry histogram
+    `span.duration_us{name=...}`, so `profiler.dump()` shows the lane and
+    `telemetry.snapshot()` shows the aggregate without re-parsing traces.
+
+  * `StepTimeline` — the per-step breakdown a train loop or server wants:
+    wall time split into data-stall vs compute vs H2D-staging vs allreduce,
+    pulled from live counters (DeviceFeed stall/staging counters, kvstore
+    bucket timings) around each step, not hand-math after the fact. With
+    `flops_per_step` it also reports MFU against a peak.
+
+  * `model_flops` / `block_fwd_flops` — the MFU numerator computed ONCE
+    from XLA's own cost analysis (`jax.jit(...).lower().cost_analysis()`),
+    the same MAC=2 convention as the chip spec, so every reporter
+    (bench.py, estimator.fit) shares one number instead of three copies of
+    3.86-GMAC hand-math.
+
+Attribution semantics (documented, not magic): `data_stall_us` and
+`allreduce_us` are time the CONSUMER thread provably spent inside the step
+window waiting (empty feed buffer; collective dispatch). `h2d_stage_us` is
+feeder-thread staging time — it overlaps compute by design, so it is
+reported alongside, never subtracted. `compute_us` is the remainder:
+`total - data_stall - allreduce`.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+
+from ..base import MXNetError, get_env
+from .registry import REGISTRY
+
+__all__ = ["span", "current_span", "record_span", "StepTimeline",
+           "model_flops", "block_fwd_flops", "cost_flops",
+           "device_peak_flops", "SPAN_DURATION", "SPAN_COUNT"]
+
+# one histogram family for every span name: static registration (lint +
+# docs cover it), dynamic span names become label values, not new metrics
+SPAN_DURATION = REGISTRY.histogram(
+    "span.duration_us", help="telemetry.span durations by span name",
+    labels=("name",))
+SPAN_COUNT = REGISTRY.counter(
+    "span.count", help="telemetry.span completions by span name",
+    labels=("name",))
+
+_tls = threading.local()
+
+
+def _enabled():
+    return get_env("MXNET_TELEMETRY", True, typ=bool)
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_span():
+    """Name of the innermost open span on this thread, or None."""
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
+def record_span(name, dur_us, ts_us=None, cat="span", **attrs):
+    """Record an externally-timed span: the one implementation behind
+    every Chrome-trace lane (`serve.batch`, `io.feed`, `feed.stage`, and
+    `with span(...)` itself). Feeds the `span.duration_us{name=...}`
+    histogram always (when telemetry is on) and the profiler's
+    Chrome-trace buffer when the profiler is running."""
+    if not _enabled():
+        return
+    SPAN_DURATION.labels(name=name).observe(dur_us)
+    SPAN_COUNT.labels(name=name).inc()
+    from .. import profiler
+    if profiler.is_running():
+        profiler.record_event(name, cat, dur_us, ts_us=ts_us, args=attrs)
+
+
+class span:
+    """`with telemetry.span("train.step", step=n):` — time a region.
+
+    Nesting is tracked per thread: the Chrome-trace event carries the
+    enclosing span's name in `args["parent"]` and the registry histogram
+    `span.duration_us{name=...}` aggregates durations. A span is cheap
+    when `MXNET_TELEMETRY=0` (no clock reads, no records) and never
+    touches jax. Reentrant and exception-safe (the span closes on the
+    error path too, so traces stay balanced)."""
+
+    __slots__ = ("name", "attrs", "_t0", "_parent", "_armed", "_dur")
+
+    def __init__(self, name, **attrs):
+        self.name = name
+        self.attrs = attrs
+        self._t0 = None
+        self._parent = None
+        self._armed = False
+        self._dur = None
+
+    def __enter__(self):
+        self._armed = _enabled()
+        if not self._armed:
+            return self
+        from .. import profiler
+        st = _stack()
+        self._parent = st[-1] if st else None
+        st.append(self.name)
+        self._t0 = profiler._now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if not self._armed:
+            return False
+        from .. import profiler
+        t1 = profiler._now_us()
+        st = _stack()
+        if self.name in st:
+            # normally st[-1] == self.name; popping through deeper names
+            # self-heals the stack when an inner span leaked open on an
+            # exception path, so nesting stays sane for the rest of the
+            # thread's life
+            while st and st.pop() != self.name:
+                pass
+        attrs = dict(self.attrs)
+        if self._parent is not None:
+            attrs["parent"] = self._parent
+        self._dur = t1 - self._t0
+        record_span(self.name, self._dur, ts_us=self._t0, **attrs)
+        return False
+
+    @property
+    def duration_us(self):
+        """Set only after exit (None while open or telemetry disabled)."""
+        return self._dur
+
+
+def _stall_counters():
+    """One consistent read of the cross-subsystem counters a step window
+    diffs: (feed stall/staging, kvstore allreduce). Missing subsystems
+    read as zeros so a loop with no feed or no kvstore still reports."""
+    out = {"data_stall_us": 0.0, "h2d_stage_us": 0.0, "host_transfers": 0,
+           "allreduce_us": 0.0, "allreduce_buckets": 0}
+    try:
+        from ..io.device_feed import feed_stats
+        f = feed_stats()
+        out["data_stall_us"] = f.get("stall_data_us", 0.0)
+        out["h2d_stage_us"] = f.get("stage_us", 0.0)
+        out["host_transfers"] = f.get("host_transfers", 0)
+    except Exception:
+        pass
+    try:
+        from ..kvstore import KV_STATS
+        out["allreduce_us"] = KV_STATS.get("allreduce_us", 0.0)
+        out["allreduce_buckets"] = KV_STATS.get("allreduce_buckets", 0)
+    except Exception:
+        pass
+    return out
+
+
+class StepTimeline:
+    """Per-step time attribution over a training (or serving) loop.
+
+    ::
+
+        tl = telemetry.StepTimeline(flops_per_step=fl, peak_flops=peak)
+        for batch in feed:
+            with tl.step():
+                loss = train_step(*batch)
+        report = tl.report()
+        # {'steps', 'total_us', 'data_stall_us', 'compute_us',
+        #  'h2d_stage_us', 'allreduce_us', 'stall_pct', 'compute_pct',
+        #  'mfu', 'achieved_flops_per_sec', ...}
+
+    Attribution runs over the LOOP WINDOW — first `step()` entry to the
+    latest `step()` exit — with the cross-subsystem counters (DeviceFeed
+    stall clock, kvstore allreduce clock) diffed continuously across it.
+    That window deliberately includes the time BETWEEN steps, because that
+    is where a `for batch in feed:` loop blocks on data — a per-step-only
+    window would attribute an input-bound loop as pure compute. The report
+    divides:
+
+      data_stall_us   consumer blocked on an empty feed buffer (input-bound)
+      allreduce_us    gradient-collective dispatch time inside the window
+      compute_us      total - data_stall - allreduce (the XLA side)
+      h2d_stage_us    feeder staging incl. async H2D dispatch — overlapped
+                      work, reported for visibility, never subtracted
+      step_time_us    sum of the in-step spans (loop-body time only)
+
+    MFU = flops_per_step * steps / total_seconds / peak_flops — the same
+    live-counter number bench.py reports, available to any fit loop.
+    """
+
+    def __init__(self, flops_per_step=None, peak_flops=None,
+                 name="train.step"):
+        self.name = name
+        self.flops_per_step = flops_per_step
+        self.peak_flops = (peak_flops if peak_flops is not None
+                           else device_peak_flops())
+        self.steps = 0
+        self.step_time_us = 0.0
+        self.deltas = {"data_stall_us": 0.0, "h2d_stage_us": 0.0,
+                       "allreduce_us": 0.0, "host_transfers": 0,
+                       "allreduce_buckets": 0}
+        self._base = None        # counters at first step entry
+        self._t_first = None
+        self._t_last = None
+
+    class _Step:
+        __slots__ = ("tl", "span")
+
+        def __init__(self, tl):
+            self.tl = tl
+            self.span = span(tl.name, step=tl.steps)
+
+        def __enter__(self):
+            from .. import profiler
+            tl = self.tl
+            if tl._base is None:
+                tl._base = _stall_counters()
+                tl._t_first = profiler._now_us()
+            self.span.__enter__()
+            return self
+
+        def __exit__(self, *exc):
+            from .. import profiler
+            t0 = self.span._t0
+            self.span.__exit__(*exc)
+            tl = self.tl
+            tl.steps += 1
+            if t0 is None:       # telemetry disabled: count steps only
+                return False
+            now = profiler._now_us()
+            tl._t_last = now
+            tl.step_time_us += now - t0
+            after = _stall_counters()
+            for k in tl.deltas:
+                tl.deltas[k] = after[k] - tl._base[k]
+            return False
+
+    def step(self):
+        """Context manager for one step of the loop."""
+        return self._Step(self)
+
+    @property
+    def total_us(self):
+        """The loop window: first step entry to latest step exit."""
+        if self._t_first is None or self._t_last is None:
+            return 0.0
+        return float(self._t_last - self._t_first)
+
+    def report(self):
+        """Plain-data breakdown; safe to json.dumps."""
+        total = self.total_us
+        stall = self.deltas["data_stall_us"]
+        allred = self.deltas["allreduce_us"]
+        compute = max(0.0, total - stall - allred)
+        out = {
+            "name": self.name,
+            "steps": self.steps,
+            "total_us": round(total, 1),
+            "step_time_us": round(self.step_time_us, 1),
+            "step_mean_us": round(self.step_time_us / self.steps, 1)
+            if self.steps else 0.0,
+            "data_stall_us": round(stall, 1),
+            "allreduce_us": round(allred, 1),
+            "compute_us": round(compute, 1),
+            "h2d_stage_us": round(self.deltas["h2d_stage_us"], 1),
+            "host_transfers": self.deltas["host_transfers"],
+            "allreduce_buckets": self.deltas["allreduce_buckets"],
+            "stall_pct": round(100.0 * stall / total, 2) if total else 0.0,
+            "compute_pct": round(100.0 * compute / total, 2) if total
+            else 0.0,
+        }
+        if self.flops_per_step and total > 0:
+            achieved = self.flops_per_step * self.steps / (total * 1e-6)
+            out["achieved_flops_per_sec"] = achieved
+            if self.peak_flops:
+                # 6 decimals: tiny test-scale MFUs must not round to 0.0
+                out["mfu"] = round(achieved / self.peak_flops, 6)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# MFU numerator: XLA-counted model FLOPs, computed once per (fn, shapes)
+# ---------------------------------------------------------------------------
+# key -> (fn, flops): the cached callable is held STRONGLY so its id can
+# never be recycled by a different function while the entry lives; BOUNDED
+# (FIFO) so per-call closures (block_fwd_flops' `fwd`) cannot pin models
+# without limit
+_flops_cache = OrderedDict()
+_FLOPS_CACHE_CAP = 128
+
+
+def _sig(a):
+    """Structural signature of one argument: shapes/dtypes recurse through
+    lists/tuples/dicts (a param-buffer list must contribute its shapes to
+    the memo key, not collapse to 'list')."""
+    if hasattr(a, "shape") and hasattr(a, "dtype"):
+        return (tuple(a.shape), str(a.dtype))
+    if isinstance(a, (list, tuple)):
+        return (type(a).__name__, tuple(_sig(v) for v in a))
+    if isinstance(a, dict):
+        return ("dict", tuple((k, _sig(v)) for k, v in sorted(a.items())))
+    return ("scalar", type(a).__name__, a if isinstance(
+        a, (int, float, bool, str, type(None))) else None)
+
+
+def cost_flops(lowered, what="program"):
+    """FLOPs from a lowered jit program's XLA cost analysis (MAC = 2 —
+    the same convention as accelerator peak specs), with the older-jax
+    quirks handled once for every MFU numerator (`model_flops`,
+    `FusedTrainStep.flops_per_call`): pre-compile fallback when
+    `.compile().cost_analysis()` raises, list-wrapped results unwrapped."""
+    import numpy as _np
+    try:
+        ca = lowered.compile().cost_analysis()
+    except Exception:
+        ca = lowered.cost_analysis()
+    if isinstance(ca, (list, tuple)):   # older jax returns [dict]
+        ca = ca[0]
+    if not ca or "flops" not in ca:
+        raise MXNetError(
+            f"XLA cost analysis returned no flops for {what}")
+    return float(_np.asarray(ca["flops"]))
+
+
+def model_flops(fn, *args):
+    """FLOPs of ONE execution of `fn(*args)` per XLA's cost analysis of the
+    compiled program (`jax.jit(fn).lower(...).compile().cost_analysis()`),
+    MAC = 2 flops — the same convention as accelerator peak specs. Memoized
+    on (fn identity, structural arg signature); the entry holds `fn`
+    strongly so a recycled id can never alias another function. The
+    lowering+compile lands in jax's jit cache, so a subsequent real
+    `jax.jit(fn)` call with the same avals does not recompile."""
+    import jax
+
+    key = (id(fn), tuple(_sig(a) for a in args))
+    hit = _flops_cache.get(key)
+    if hit is not None and hit[0] is fn:
+        return hit[1]
+    flops = cost_flops(jax.jit(fn).lower(*args), what=repr(fn))
+    _flops_cache[key] = (fn, flops)
+    while len(_flops_cache) > _FLOPS_CACHE_CAP:
+        _flops_cache.popitem(last=False)
+    return flops
+
+
+# net (weak) -> {(param shapes, input sig): flops} — repeat calls on the
+# SAME net skip the relowering entirely, and a dead net drops its entries
+_block_flops_memo = weakref.WeakKeyDictionary()
+
+
+def block_fwd_flops(net, x):
+    """FLOPs of one compiled FORWARD of an initialized HybridBlock on batch
+    `x` (total for the batch, MAC=2). Train-step flops are conventionally
+    ~3x this (fwd + 2x bwd). Uses the same parameter buffer-swap trick the
+    fused paths use so the traced function is pure in its buffers.
+    Memoized per net (weakly — the model is never pinned): the cost
+    analysis runs once per (net, param shapes, batch signature)."""
+    import jax
+    from .. import autograd, random as _random
+    from ..ndarray import NDArray, _wrap
+
+    params = [p for _, p in sorted(net.collect_params().items())]
+    for p in params:
+        if p._data is None:
+            raise MXNetError("block_fwd_flops needs an initialized net: "
+                             "run one forward first")
+    raw0 = x._arr if isinstance(x, NDArray) else x
+    memo_key = (tuple(_sig(p.data()._arr) for p in params), _sig(raw0))
+    try:
+        memo = _block_flops_memo.setdefault(net, {})
+    except TypeError:          # unweakrefable net: skip the memo
+        memo = {}
+    if memo_key in memo:
+        return memo[memo_key]
+
+    def fwd(pbufs, xr):
+        saved = []
+        for p, b in zip(params, pbufs):
+            nd = p.data()
+            saved.append(nd._data)
+            nd._data = b
+            nd._version += 1
+        try:
+            key = jax.random.PRNGKey(0)
+            with autograd._Scope(recording=False, training=False), \
+                    _random.trace_key_scope(key):
+                out = net(_wrap(xr))
+        finally:
+            for p, old in zip(params, saved):
+                # trace-time buffer swap, restored before tracing ends
+                p.data()._data = old  # mxlint: disable=trace-closure-mutation
+        return out._arr
+
+    pbufs = [p.data()._arr for p in params]
+    # cost_flops directly, NOT model_flops: the per-call `fwd` closure can
+    # never hit model_flops' id-keyed cache again, and caching it there
+    # would strongly pin `net` and its buffers until FIFO eviction — the
+    # weak per-net memo above is the only cache this path needs
+    flops = cost_flops(jax.jit(fwd).lower(pbufs, raw0),
+                       what=f"forward of {type(net).__name__}")
+    memo[memo_key] = flops
+    return flops
+
+
+# (platform, device-kind substring) -> advertised bf16 peak FLOP/s (MAC=2).
+# The honest denominator is still a measured attainable (bench.py calib
+# phase); these are the spec fallbacks when no calibration ran.
+_PEAKS = (
+    ("tpu", "v5 lite", 197e12),
+    ("tpu", "v5e", 197e12),
+    ("tpu", "v4", 275e12),
+    ("tpu", "v3", 123e12),
+    ("tpu", "v2", 45e12),
+)
+
+
+def device_peak_flops(device=None):
+    """Spec bf16 peak FLOP/s for the attached accelerator, or None when
+    unknown (CPU, exotic chips): MFU is then omitted rather than wrong."""
+    try:
+        import jax
+        d = device or jax.devices()[0]
+        plat = d.platform.lower()
+        kind = getattr(d, "device_kind", "").lower()
+        for p, sub, peak in _PEAKS:
+            if plat == p and sub in kind:
+                return peak
+    except Exception:
+        pass
+    return None

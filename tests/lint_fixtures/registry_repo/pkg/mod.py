@@ -26,3 +26,19 @@ def f():
     inject("alpha.save")
     inject("gamma.run")
     inject("delta.crash")                # fault-point-unregistered
+
+
+def stats_group(family, initial, lock=None):
+    return initial
+
+
+def counter(name, help=""):
+    return name
+
+
+TELE_STATS = stats_group("tele", {"good": 0, "lonely": 0})
+
+
+def g():
+    counter("tele.obj_documented")
+    counter("tele.obj_untested")     # documented, never in tests

@@ -1,5 +1,11 @@
-"""Fixture test file: exercises one PIPE_STATS key but not the other."""
+"""Fixture test file: exercises one PIPE_STATS key but not the other, both
+TELE_STATS keys, and the documented object metric."""
 
 
 def check_hits():
     assert "hits"
+
+
+def check_tele():
+    assert "good" and "lonely"
+    assert "tele.obj_documented"

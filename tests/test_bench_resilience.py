@@ -80,6 +80,21 @@ def test_orchestrator_emits_diagnostic_json_when_backend_dead(monkeypatch,
     assert line["cpu_smoke"] == "ok"
 
 
+def test_isolated_runner_resumes_from_partial(tmp_path):
+    """run_phases_isolated skips phases already recorded in the partial
+    file (an orchestrator death loses at most the in-flight phase) and
+    reports unknown phase names as errors instead of dying."""
+    path = str(tmp_path / "partial.json")
+    with open(path, "w") as f:
+        json.dump({"_phases_done": [n for n, _ in bench.PHASES],
+                   "metric_a": 1}, f)
+    partial, errors = bench.run_phases_isolated(
+        names=["dispatch", "bogus"], partial_path=path)
+    assert partial["metric_a"] == 1          # cached, no subprocess spawned
+    assert "unknown phase" in errors["bogus"]
+    assert "dispatch" not in errors
+
+
 def test_phase_list_ordering_is_loadbearing():
     # eager before the big fused programs, calibration last (device-session
     # residue slows subsequent eager-class programs; bisected in r3)

@@ -357,3 +357,6 @@ def test_io_bench_overlap_quick_smoke():
     assert out["data_ms"] > 0 and out["compute_ms"] > 0
     assert 0.0 <= out["hidden_input_fraction"] <= 1.0
     assert len(out["trials"]) >= 1
+    # the artifact carries the backend preflight verdict + registry state
+    assert out["backend_ok"] is True
+    assert out["telemetry"]["feed.batches_consumed"] > 0

@@ -172,6 +172,27 @@ def test_registry_consistency_fixture_findings():
         {"delta.crash"}
     assert {f.symbol for f in by["fault-doc-stale"]} == {"old.gone"}
     assert {f.symbol for f in by["stats-key-untested"]} == {"misses"}
+    # telemetry surface: stats_group adoptions + literal object metrics vs
+    # the OBSERVABILITY.md catalog (both directions) and tests
+    assert {f.symbol for f in by["telemetry-metric-undocumented"]} == \
+        {"tele.lonely"}
+    assert {f.symbol for f in by["telemetry-doc-stale"]} == {"tele.ghost"}
+    assert {f.symbol for f in by["telemetry-metric-untested"]} == \
+        {"tele.obj_untested"}
+
+
+def test_stats_group_adoption_still_yields_stats_keys():
+    """A `X_STATS = stats_group("x", {...})` adoption declares the same
+    key surface as a bare dict literal: stats-key-untested still fires on
+    unexercised keys (regression for the telemetry migration)."""
+    root = os.path.join(FIXTURES, "registry_repo")
+    mods = analysis.load_modules(root, files=["pkg/mod.py"])
+    dicts = registry_consistency._stats_dicts(mods)
+    by_name = {d[0]: d for d in dicts}
+    assert "TELE_STATS" in by_name and "PIPE_STATS" in by_name
+    assert set(by_name["TELE_STATS"][1]) == {"good", "lonely"}
+    assert by_name["TELE_STATS"][4] == "tele"      # adopted family name
+    assert by_name["PIPE_STATS"][4] is None        # bare dict: no family
 
 
 # ---------------------------------------------------------------------------

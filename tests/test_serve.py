@@ -375,6 +375,10 @@ def test_serve_bench_quick_smoke(tmp_path):
     # steady state stayed on the warmed bucket programs
     assert (data["batched"]["compile_cache_size_final"]
             == data["batched"]["compile_cache_size_after_warmup"])
+    # the artifact reports through the telemetry registry and carries the
+    # backend preflight verdict benchdiff keys on
+    assert data["backend_ok"] is True
+    assert data["telemetry"]["serve.batches"] > 0
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,251 @@
+"""benchdiff — compare the last two BENCH_r*.json and fail on regressions.
+
+The bench trend is only useful if someone LOOKS at it; this is the looker.
+It finds the two newest `BENCH_r<NN>.json` rounds (by round number), diffs
+the trend keys, and exits 1 when any higher-is-better key dropped — or any
+lower-is-better key rose — by more than the threshold (default 10%).
+
+Backend sanity comes first: a round whose `backend_ok` is false (or that
+carries the pre-preflight signature `error` + `value == 0`) is a DEAD
+BACKEND, not a regression — the diff reports `skipped: backend_dead` and
+exits 0, because failing CI for a wedged chip buries real regressions
+(exactly the BENCH_r05 false-zero this tool exists to prevent).
+
+Exit codes:  0 ok (or skipped: backend dead / nothing comparable)
+             1 regression beyond threshold
+             2 missing/invalid input files
+
+Usage:
+    python tools/benchdiff.py                    # repo-root BENCH_r*.json
+    python tools/benchdiff.py --dir path --threshold 0.15
+    python tools/benchdiff.py --old a.json --new b.json
+    python tools/benchdiff.py --self-test        # synthetic behavior check
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# trend keys -> direction. Keys missing from either round are skipped (a
+# phase that crashed or never ran must not read as a regression — the
+# phase_errors block already reports it).
+TREND_KEYS = {
+    "value": "higher",                            # headline train bs32
+    "train_bs32_images_per_sec_default": "higher",
+    "train_bs128_images_per_sec": "higher",
+    "eager_tape_images_per_sec_bs32": "higher",
+    "infer_images_per_sec_bs32_bf16": "higher",
+    "io_pipeline_images_per_sec": "higher",
+    "input_pipeline_speedup": "higher",
+    "serve_requests_per_sec_c32": "higher",
+    "mfu_bs32": "higher",
+    "per_dispatch_latency_us_sync": "lower",
+    "per_dispatch_latency_us_chained": "lower",
+    "serve_p99_ms_c32": "lower",
+}
+
+DEFAULT_THRESHOLD = 0.10
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def find_rounds(directory):
+    """[(round_no, path)] sorted ascending by round number."""
+    rounds = []
+    for p in glob.glob(os.path.join(directory, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(p))
+        if m:
+            rounds.append((int(m.group(1)), p))
+    return sorted(rounds)
+
+
+def load_round(path):
+    """Load one round. The driver wraps bench.py's line as
+    {"n", "cmd", "rc", "tail", "parsed": {...}} — unwrap `parsed` when
+    present. A wrapper whose `parsed` is null (the run died before
+    emitting ANY JSON — the BENCH_r04 mode this PR's phase isolation
+    removes) reads as a dead run: {"value": 0, "error": ...}."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and "parsed" in data and "cmd" in data:
+        parsed = data["parsed"]
+        if parsed is None:
+            return {"value": 0.0,
+                    "error": f"run produced no JSON (rc={data.get('rc')})"}
+        return parsed
+    return data
+
+
+def backend_dead(run):
+    """True when the round's numbers reflect a dead/absent accelerator,
+    not the code. New rounds carry `backend_ok` explicitly — but a round
+    that silently fell back to the CPU backend (bench.py stamps the
+    'no accelerator visible' warning) is ALSO not trend-comparable
+    against accelerator rounds: CPU img/s would read as a catastrophic
+    code regression. Older rounds (pre-preflight) are inferred from the
+    `error` + zero-value signature."""
+    if str(run.get("warning", "")).startswith("no accelerator"):
+        return True
+    if "backend_ok" in run:
+        return not run["backend_ok"]
+    return bool(run.get("error")) and not run.get("value")
+
+
+def compare(old, new, threshold=DEFAULT_THRESHOLD):
+    """Diff `old` -> `new` over TREND_KEYS. Returns a report dict:
+    {"status": "ok"|"regression"|"skipped", "regressions": [...],
+     "improvements": [...], "compared": n, ...}."""
+    for label, run in (("old", old), ("new", new)):
+        if backend_dead(run):
+            return {"status": "skipped",
+                    "reason": f"backend_dead_{label}",
+                    "detail": run.get("error", "backend_ok false"),
+                    "compared": 0, "regressions": [], "improvements": []}
+    regressions, improvements, compared = [], [], 0
+    for key, direction in TREND_KEYS.items():
+        a, b = old.get(key), new.get(key)
+        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
+            continue
+        if a <= 0:     # a zero/negative old value makes ratios meaningless
+            continue
+        compared += 1
+        change = (b - a) / a
+        worse = -change if direction == "higher" else change
+        row = {"key": key, "old": a, "new": b,
+               "change_pct": round(change * 100.0, 2),
+               "direction": direction}
+        if worse > threshold:
+            regressions.append(row)
+        elif worse < -threshold:
+            improvements.append(row)
+    return {"status": "regression" if regressions else "ok",
+            "compared": compared,
+            "regressions": regressions,
+            "improvements": improvements}
+
+
+def run_diff(old_path, new_path, threshold, json_out=False):
+    try:
+        old = load_round(old_path)
+        new = load_round(new_path)
+    except (OSError, ValueError) as e:
+        print(f"benchdiff: cannot load rounds: {e}", file=sys.stderr)
+        return 2
+    report = compare(old, new, threshold)
+    report["old_file"] = os.path.basename(old_path)
+    report["new_file"] = os.path.basename(new_path)
+    if json_out:
+        print(json.dumps(report, indent=1))
+    else:
+        _print_human(report, threshold)
+    return 1 if report["status"] == "regression" else 0
+
+
+def _print_human(report, threshold):
+    print(f"benchdiff {report['old_file']} -> {report['new_file']} "
+          f"(threshold {threshold * 100:.0f}%)")
+    if report["status"] == "skipped":
+        print(f"  SKIPPED: {report['reason']} — {report['detail']}")
+        print("  (a dead backend is not a regression; fix the chip, "
+          "rerun the round)")
+        return
+    for row in report["regressions"]:
+        print(f"  REGRESSION {row['key']}: {row['old']} -> {row['new']} "
+              f"({row['change_pct']:+.1f}%, want {row['direction']})")
+    for row in report["improvements"]:
+        print(f"  improved   {row['key']}: {row['old']} -> {row['new']} "
+              f"({row['change_pct']:+.1f}%)")
+    print(f"  {report['compared']} trend keys compared, "
+          f"{len(report['regressions'])} regression(s)")
+
+
+def self_test():
+    """Synthetic behavior check (CI smoke, no files needed): ok pair,
+    >10% regression pair, lower-is-better direction, dead-backend skip,
+    and the missing-file exit. Prints PASS/FAIL lines; exit 0 iff all
+    pass."""
+    failures = []
+
+    def check(name, cond):
+        print(f"  {'PASS' if cond else 'FAIL'}: {name}")
+        if not cond:
+            failures.append(name)
+
+    base = {"backend_ok": True, "value": 1000.0,
+            "serve_requests_per_sec_c32": 50.0,
+            "per_dispatch_latency_us_sync": 100.0}
+    ok_new = dict(base, value=980.0)
+    check("within-threshold drift is ok",
+          compare(base, ok_new)["status"] == "ok")
+    bad_new = dict(base, value=850.0)            # -15% on higher-is-better
+    rep = compare(base, bad_new)
+    check(">10% drop on higher-is-better is a regression",
+          rep["status"] == "regression"
+          and rep["regressions"][0]["key"] == "value")
+    slow_new = dict(base, per_dispatch_latency_us_sync=150.0)   # +50%
+    rep = compare(base, slow_new)
+    check(">10% rise on lower-is-better is a regression",
+          rep["status"] == "regression"
+          and rep["regressions"][0]["key"]
+          == "per_dispatch_latency_us_sync")
+    dead = dict(base, backend_ok=False, value=0.0)
+    check("dead-backend new round is skipped, not a regression",
+          compare(base, dead)["status"] == "skipped")
+    legacy_dead = {"value": 0.0, "error": "accelerator unavailable"}
+    check("legacy error+zero round reads as dead backend",
+          compare(base, legacy_dead)["status"] == "skipped")
+    cpu_fallback = dict(base, value=1.5,
+                        warning="no accelerator visible — these are "
+                                "CPU-backend numbers")
+    check("silent CPU-fallback round is skipped, not a regression",
+          compare(base, cpu_fallback)["status"] == "skipped")
+    missing_only_new = {"backend_ok": True,
+                        "io_pipeline_images_per_sec": 700.0}
+    check("keys missing from one side are skipped, not regressions",
+          compare(base, missing_only_new)["status"] == "ok")
+    check("missing file exits 2",
+          run_diff("/nonexistent/a.json", "/nonexistent/b.json",
+                   DEFAULT_THRESHOLD) == 2)
+    improved = dict(base, value=1500.0)
+    rep = compare(base, improved)
+    check("improvements are reported, not failed",
+          rep["status"] == "ok" and rep["improvements"])
+    print(f"benchdiff --self-test: {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="benchdiff", description=__doc__)
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--old", help="explicit old round file")
+    ap.add_argument("--new", help="explicit new round file")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="relative regression threshold (default 0.10)")
+    ap.add_argument("--json", action="store_true", help="JSON report")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the synthetic behavior check and exit")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        return self_test()
+    if args.old or args.new:
+        if not (args.old and args.new):
+            ap.error("--old and --new go together")
+        return run_diff(args.old, args.new, args.threshold, args.json)
+    rounds = find_rounds(args.dir)
+    if len(rounds) < 2:
+        print(f"benchdiff: need at least two BENCH_r*.json in {args.dir}, "
+              f"found {len(rounds)}", file=sys.stderr)
+        return 2
+    (_, old_path), (_, new_path) = rounds[-2], rounds[-1]
+    return run_diff(old_path, new_path, args.threshold, args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
