@@ -582,6 +582,40 @@ def _phase_serve():
     return out
 
 
+def _phase_offenders(model="resnet18", batch_size=32):
+    """Fusion-level roofline attribution of the compiled train step
+    (mx.inspect): the ranked offender work-list for the kernel tier, and
+    the trend scalars benchdiff gates — est_step_mfu_ceiling (what the
+    CURRENT fusion structure could reach), offender_top1_share, and
+    memory_bound_byte_share. Lower+compile only; nothing executes."""
+    import importlib.util
+    here = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "offenders", os.path.join(here, "tools", "offenders.py"))
+    offenders = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(offenders)
+    from incubator_mxnet_tpu import inspect as mxinspect
+
+    step, inputs, _execute = offenders.build_step(
+        model, batch_size, "NHWC", "train")
+    report = mxinspect.inspect_step(
+        step, *inputs, name=f"{model}_train_bs{batch_size}")
+    return {
+        "offender_top1_share": report["offender_top1_share"],
+        "memory_bound_byte_share": report["memory_bound_byte_share"],
+        "est_step_mfu_ceiling": report["est_step_mfu_ceiling"],
+        "offenders_n_units": report["n_units"],
+        "offenders_n_groups": report["n_groups"],
+        "offenders_top10_byte_coverage": report["top10_byte_coverage"],
+        "offenders_ranking": report["ranking"],
+        "offenders_model": report["name"],
+        "offenders_top3": [
+            {k: g[k] for k in ("class", "opcode", "count", "bound",
+                               "time_share")}
+            for g in report["offender_groups"][:3]],
+    }
+
+
 def _phase_calib():
     tflops, probes = measure_attainable_tflops()
     return {"calib_attainable_bf16_tflops": tflops,
@@ -601,6 +635,7 @@ PHASES = [
     ("io", _phase_io),
     ("input_pipeline", _phase_input_pipeline),
     ("serve", _phase_serve),
+    ("offenders", _phase_offenders),
     ("calib", _phase_calib),
     ("xla_flops", _phase_xla_flops),
 ]
@@ -624,10 +659,17 @@ def _phase_infer_quick():
             round(bench_resnet50_infer(iters=16, warmup=16), 2)}
 
 
+def _phase_offenders_quick():
+    # same keys, tiny net: the trend gate exercises the whole
+    # lower+parse+rank path without a ResNet compile
+    return _phase_offenders(model="tiny", batch_size=4)
+
+
 QUICK_PHASES = {
     "dispatch": _phase_dispatch_quick,
     "train32": _phase_train32_quick,
     "infer": _phase_infer_quick,
+    "offenders": _phase_offenders_quick,
 }
 
 # Per-phase subprocess timeouts, seconds. MXNET_BENCH_PHASE_TIMEOUT (one
@@ -635,7 +677,7 @@ QUICK_PHASES = {
 PHASE_TIMEOUTS = {
     "dispatch": 300, "eager": 900, "train32": 1500, "train128": 1500,
     "infer": 900, "io": 700, "input_pipeline": 700, "serve": 700,
-    "calib": 900, "xla_flops": 600,
+    "offenders": 700, "calib": 900, "xla_flops": 600,
 }
 PHASE_TIMEOUT_DEFAULT_S = 900
 

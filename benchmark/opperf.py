@@ -7,6 +7,14 @@ TPU-native design: each op times three ways —
                  graph pays, minus fusion wins)
   * bwd (jit)  — value_and_grad of the op compiled alone
 
+and carries its roofline coordinates (`mx.inspect.roofline.callable_cost`):
+estimated flops, bytes moved, arithmetic intensity (FLOP/B), and the
+compute- vs memory-bound class against the calibrated ridge point
+(`benchmark/results/roofline_calib.json`, see `tools/bandwidth.py --calib`)
+— so the latency table doubles as the offender work-list's per-op ground
+truth. Backends whose cost analysis lacks bytes-accessed keys degrade to
+the HLO shape model, and to flops-only rows when that fails too.
+
 Measurements synchronize with block_until_ready and report median-of-N.
 Categories mirror the reference's nd_operations modules: unary, binary
 (broadcast + elementwise), gemm, reduction, sorting/searching, random,
@@ -16,6 +24,7 @@ Usage:
   python benchmark/opperf.py                       # all categories, table
   python benchmark/opperf.py --categories unary gemm --json out.json
   python benchmark/opperf.py --platform cpu        # force host platform
+  python benchmark/opperf.py --quick --json out.json   # CI smoke
 """
 import argparse
 import json
@@ -41,23 +50,50 @@ def _time_fn(fn, args, warmup=3, iters=10):
     return statistics.median(ts)
 
 
-def _bench_one(name, fn, arg_arrays, grad_idx=0):
-    """Returns dict with eager/jit/bwd median microseconds."""
+_CALIB = {"c": None}
+
+
+def _calib():
+    if _CALIB["c"] is None:
+        from incubator_mxnet_tpu.inspect import roofline
+        _CALIB["c"] = roofline.load_calibration()
+    return _CALIB["c"]
+
+
+def _roofline_cols(fn, dev_args):
+    """est_flops / est_bytes / intensity / bound columns for one op row
+    (cost-analysis first, HLO shape model fallback; a totally opaque op
+    yields nulls rather than killing the table)."""
+    from incubator_mxnet_tpu.inspect import roofline
+    try:
+        cost = roofline.callable_cost(fn, *dev_args, calib=_calib())
+    except Exception as e:
+        return {"est_flops": None, "est_bytes": None, "intensity": None,
+                "bound": None, "cost_error": str(e)[:120]}
+    return {"est_flops": cost["est_flops"], "est_bytes": cost["est_bytes"],
+            "intensity": cost["intensity"], "bound": cost["bound"],
+            "bytes_estimated": cost["bytes_estimated"]}
+
+
+def _bench_one(name, fn, arg_arrays, grad_idx=0, warmup=3, iters=10):
+    """Returns dict with eager/jit/bwd median microseconds + roofline
+    coordinates."""
     import jax
     import jax.numpy as jnp
 
     dev_args = [jax.device_put(a) for a in arg_arrays]
     row = {"op": name}
-    row["eager_us"] = round(_time_fn(fn, dev_args), 1)
+    row["eager_us"] = round(_time_fn(fn, dev_args, warmup, iters), 1)
     jfn = jax.jit(fn)
-    row["jit_us"] = round(_time_fn(jfn, dev_args), 1)
+    row["jit_us"] = round(_time_fn(jfn, dev_args, warmup, iters), 1)
     try:
         def loss(*xs):
             return jnp.sum(jnp.abs(fn(*xs)))
         gfn = jax.jit(jax.grad(loss, argnums=grad_idx))
-        row["bwd_us"] = round(_time_fn(gfn, dev_args), 1)
+        row["bwd_us"] = round(_time_fn(gfn, dev_args, warmup, iters), 1)
     except Exception:
         row["bwd_us"] = None  # non-differentiable op
+    row.update(_roofline_cols(jfn, dev_args))   # reuses the timed compile
     return row
 
 
@@ -258,40 +294,59 @@ CATEGORIES = {
 }
 
 
-def run(categories=None, as_json=None):
+QUICK_CATEGORIES = ("gemm", "norm")      # a compute and a memory class
+
+
+def run(categories=None, as_json=None, quick=False):
     import jax
     import jax.numpy as jnp
     from incubator_mxnet_tpu import npx
 
     platform = jax.devices()[0].platform
+    warmup, iters = (1, 3) if quick else (3, 10)
+    if categories is None:
+        categories = QUICK_CATEGORIES if quick else list(CATEGORIES)
     results = {}
-    for cat in (categories or CATEGORIES):
+    for cat in categories:
         specs = CATEGORIES[cat](jnp, npx)
         rows = []
         for name, fn, args in specs:
             try:
-                rows.append(_bench_one(name, fn, args))
+                rows.append(_bench_one(name, fn, args,
+                                       warmup=warmup, iters=iters))
             except Exception as e:  # keep the table going
                 rows.append({"op": name, "error": str(e)[:120]})
         results[cat] = rows
 
     if as_json:
         with open(as_json, "w") as f:
-            json.dump({"platform": platform, "results": results}, f,
+            json.dump({"platform": platform, "quick": quick,
+                       "calibration": _calib(), "results": results}, f,
                       indent=1)
     # render table
-    print(f"# opperf ({platform})")
-    print(f"{'op':32s} {'eager_us':>10s} {'jit_us':>10s} {'bwd_us':>10s}")
+    cal = _calib()
+    print(f"# opperf ({platform}; roofline ridge "
+          f"{cal['ridge_flop_per_byte']:.1f} FLOP/B from "
+          f"{cal.get('source', 'unknown')})")
+    print(f"{'op':32s} {'eager_us':>10s} {'jit_us':>10s} {'bwd_us':>10s} "
+          f"{'GFLOP':>8s} {'MB':>8s} {'FLOP/B':>8s} {'bound':>8s}")
     for cat, rows in results.items():
-        print(f"-- {cat} " + "-" * 58)
+        print(f"-- {cat} " + "-" * 94)
         for r in rows:
             if "error" in r:
                 print(f"{r['op']:32s} ERROR {r['error']}")
                 continue
             bwd = f"{r['bwd_us']:10.1f}" if r["bwd_us"] is not None \
                 else "       n/a"
+            gf = (f"{r['est_flops'] / 1e9:8.3f}"
+                  if r.get("est_flops") is not None else "     n/a")
+            mb = (f"{r['est_bytes'] / 1e6:8.3f}"
+                  if r.get("est_bytes") is not None else "     n/a")
+            ai = (f"{r['intensity']:8.2f}"
+                  if r.get("intensity") is not None else "     n/a")
+            bound = r.get("bound") or "n/a"
             print(f"{r['op']:32s} {r['eager_us']:10.1f} "
-                  f"{r['jit_us']:10.1f} {bwd}")
+                  f"{r['jit_us']:10.1f} {bwd} {gf} {mb} {ai} {bound:>8s}")
     return results
 
 
@@ -302,11 +357,13 @@ def main():
     ap.add_argument("--json", default=None)
     ap.add_argument("--platform", default=None,
                     help="force a platform (e.g. cpu)")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI smoke: gemm+norm categories, 3 timed iters")
     args = ap.parse_args()
     if args.platform:
         import jax
         jax.config.update("jax_platforms", args.platform)
-    run(args.categories, args.json)
+    run(args.categories, args.json, quick=args.quick)
 
 
 if __name__ == "__main__":

@@ -136,6 +136,34 @@ class ExportedModel:
                    for s, d in self.input_specs])
         return self
 
+    def lowered(self, *inputs):
+        """The bucket program lowered at its exported shapes WITHOUT
+        executing it: the inspection surface for
+        `mx.inspect.inspect_step(model)` — fusion-level offender
+        attribution of exactly the program `run()` dispatches. The
+        lowering lands in the jit cache, so a later `run()`/`warmup()`
+        does not recompile. `inputs` are optional (the exported shapes
+        are fixed); when given they must match `input_specs` — lowering
+        at any other shape would inspect a program `run()` never uses."""
+        if inputs:
+            if len(inputs) != len(self.input_specs):
+                raise MXNetError(
+                    f"ExportedModel.lowered got {len(inputs)} inputs, "
+                    f"artifact expects {len(self.input_specs)}")
+            arrs = []
+            for a, (s, d) in zip(inputs, self.input_specs):
+                a = _np.asarray(a)
+                if tuple(a.shape) != tuple(s):
+                    raise MXNetError(
+                        f"ExportedModel.lowered input shape {a.shape} "
+                        f"does not match the exported spec {tuple(s)} — "
+                        f"the artifact's program is fixed-shape")
+                arrs.append(a.astype(_np_dtype(d), copy=False))
+        else:
+            arrs = [_np.zeros(s, dtype=_np_dtype(d))
+                    for s, d in self.input_specs]
+        return self._call.lower(self._pbufs, self._key, *arrs)
+
     def compile_cache_size(self):
         """Entries in the jitted call's compile cache (1 after warmup; any
         growth in steady state is a retrace). -1 when the running jax

@@ -127,6 +127,25 @@ class FusedInferStep:
 
         return jax.jit(step, donate_argnums=(1,))
 
+    def lowered(self, x=None):
+        """The chained-inference program lowered for inspection
+        (`mx.inspect.inspect_step(step, x0)`) without executing or
+        consuming the chain state. `x` may be omitted once the chain is
+        seeded."""
+        from ...ndarray import NDArray
+        if self._jit is None:
+            self._jit = self._build()
+            self._pnds = [p.data() for p in self._params]
+        if x is not None:
+            raw = x._arr if isinstance(x, NDArray) else x
+        elif self._x is not None:
+            raw = self._x
+        else:
+            raise MXNetError("FusedInferStep.lowered needs an input: "
+                             "pass x or seed the chain with step(x0)")
+        pbufs = [nd._arr for nd in self._pnds]
+        return self._jit.lower(pbufs, raw)
+
     def __call__(self, x=None):
         import jax.numpy as jnp
         from ...ndarray import NDArray, _wrap
@@ -364,19 +383,18 @@ class FusedTrainStep:
         return jax.jit(step, donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------
-    def flops_per_call(self, *inputs):
-        """XLA-counted FLOPs of ONE compiled step call (cost analysis of
-        the lowered fwd+loss+bwd+update program, MAC=2 — the same
-        convention as chip peak specs). With `steps_per_call=K` this is
-        the K-step program's total; divide by K for per-step. The lowering
-        compiles into jax's jit cache, so a subsequent real `step(...)`
-        with the same shapes does not re-pay it. This is the MFU
-        numerator `telemetry.StepTimeline(flops_per_step=...)` wants —
-        live-counter MFU instead of hand-math."""
+    def lowered(self, *inputs):
+        """The fused step lowered for these input shapes WITHOUT running
+        it: a `jax.stages.Lowered` whose `.compile()` yields the exact
+        program `step(*inputs)` would execute. This is the inspection
+        surface — `mx.inspect.inspect_step(step, x, y)` walks its
+        compiled HLO for fusion-level offender attribution, and
+        `flops_per_call` cost-counts it. The lowering lands in jax's jit
+        cache, so a subsequent real `step(...)` with the same shapes does
+        not re-pay compilation."""
         import jax
         from ...ndarray import NDArray
         from ...optimizer import _state_bufs
-        from ...telemetry import cost_flops
 
         self._ensure_states()
         if self._jit is None:
@@ -399,10 +417,19 @@ class FusedTrainStep:
         in_raw = tuple(
             _stage_raw(a._arr if isinstance(a, NDArray) else a)
             for a in inputs)
-        lowered = self._jit.lower(
+        return self._jit.lower(
             train_bufs, sbufs, frozen_bufs, key, lrs, wds,
             _np.float32(opt.rescale_grad), ts, *in_raw)
-        return cost_flops(lowered, what="the fused step")
+
+    def flops_per_call(self, *inputs):
+        """XLA-counted FLOPs of ONE compiled step call (cost analysis of
+        the lowered fwd+loss+bwd+update program, MAC=2 — the same
+        convention as chip peak specs). With `steps_per_call=K` this is
+        the K-step program's total; divide by K for per-step. This is the
+        MFU numerator `telemetry.StepTimeline(flops_per_step=...)` wants —
+        live-counter MFU instead of hand-math."""
+        from ...telemetry import cost_flops
+        return cost_flops(self.lowered(*inputs), what="the fused step")
 
     def __call__(self, *inputs):
         from ... import random as _random

@@ -1,0 +1,38 @@
+"""mx.inspect — HLO roofline profiler and fusion-level offender attribution.
+
+The XLA-era answer to the reference profiler's per-engine-op attribution
+(PAPER.md layers 4-6): lower+compile any jitted step — `FusedTrainStep`,
+`deploy.ExportedModel` bucket programs, bare `jax.jit` functions — walk the
+optimized module's fusions, model each one's flops / bytes / arithmetic
+intensity, classify compute- vs memory-bound against calibrated peaks, and
+rank offenders by estimated time share:
+
+    from incubator_mxnet_tpu import inspect as mxinspect
+    report = mxinspect.inspect_step(step, x, y)   # FusedTrainStep + batch
+    print(mxinspect.render_markdown(report))
+
+CLI: `python tools/offenders.py --model resnet18 --json out.json`.
+Calibration: `python tools/bandwidth.py --calib` writes
+`benchmark/results/roofline_calib.json` (see docs/PERF.md). Knobs:
+`MXNET_INSPECT_TOP_K`, `MXNET_INSPECT_MEASURED`, `MXNET_INSPECT_CALIB`.
+Catalog of the `inspect.*` registry metrics: docs/OBSERVABILITY.md.
+"""
+from __future__ import annotations
+
+from .hlo import (HloInstruction, HloComputation, HloModule, parse_module,
+                  parse_shape, shape_bytes)
+from .roofline import (analyze_compiled, analyze_module, callable_cost,
+                       classify, cost_analysis_summary, instr_flops,
+                       kernel_units, load_calibration, unit_cost)
+from .report import (inspect_step, inspect_compiled, inspect_hlo_text,
+                     render_markdown, lower_any, class_name, dump_json)
+
+__all__ = [
+    "HloInstruction", "HloComputation", "HloModule", "parse_module",
+    "parse_shape", "shape_bytes",
+    "analyze_compiled", "analyze_module", "callable_cost", "classify",
+    "cost_analysis_summary", "instr_flops", "kernel_units",
+    "load_calibration", "unit_cost",
+    "inspect_step", "inspect_compiled", "inspect_hlo_text",
+    "render_markdown", "lower_any", "class_name", "dump_json",
+]

@@ -10,6 +10,19 @@ for a sweep of tensor sizes. Prints a table and optional JSON.
     python tools/bandwidth.py [--sizes-mb 1 4 16 64] [--json out.json]
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python tools/bandwidth.py            # virtual 8-device mesh
+
+Roofline calibration (`--calib`): measures the DEVICE-LOCAL memory
+bandwidth (a jitted streaming triad — the roofline's byte ceiling, distinct
+from the interconnect numbers above) plus a dense-compute probe, and writes
+the machine-readable artifact `benchmark/results/roofline_calib.json` that
+`mx.inspect.roofline` consumes for compute- vs memory-bound classification.
+Re-run it whenever the attached hardware changes (workflow: docs/PERF.md
+"Roofline calibration"). On TPU the compute ceiling should instead come
+from bench.py's calib phase sweep (pass --peak-tflops to pin it); the
+triad bandwidth is measured either way.
+
+    python tools/bandwidth.py --calib                    # default path
+    python tools/bandwidth.py --calib --peak-tflops 22.4 # pin compute peak
 """
 import argparse
 import json
@@ -103,13 +116,125 @@ def measure(sizes_mb, reps):
     return rows
 
 
+def measure_membw(size_mb=256, reps=5):
+    """Device-local memory bandwidth: a jitted streaming triad
+    (`out = a + b * c`, 3 reads + 1 write counted as 4 streams) over a
+    buffer big enough to spill every cache tier. This is the roofline
+    byte ceiling — what a memory-bound fusion can at best sustain —
+    distinct from the interconnect/transfer numbers `measure()` reports."""
+    import jax
+    import jax.numpy as jnp
+
+    elems = int(size_mb * (1 << 20) // 4)
+    a = jnp.arange(elems, dtype=jnp.float32) * 1e-9
+    b = a * 1.000001
+    c = b * 0.999999
+    triad = jax.jit(lambda x, y, z: x + y * z)
+    out = {"y": None}
+
+    def run():
+        out["y"] = triad(a, b, c)
+
+    def sync():
+        jax.block_until_ready(out["y"])
+
+    t = _time(run, sync, reps)
+    streams = 4 * elems * 4          # 3 operand reads + 1 result write
+    return {"triad_gbps": round(streams / t / 1e9, 2),
+            "bytes_per_sec": streams / t, "size_mb": size_mb}
+
+
+def measure_compute_peak(reps=4):
+    """Cheap dense-compute probe for the roofline flop ceiling: a chained
+    f32 matmul (bf16 on accelerators) sized to amortize dispatch. On TPU
+    prefer bench.py's full calib-phase sweep and pass --peak-tflops; this
+    probe exists so a CPU-only environment still gets a measured, if
+    modest, ceiling."""
+    import jax
+    import jax.numpy as jnp
+
+    plat = jax.devices()[0].platform
+    n = 4096 if plat != "cpu" else 1024
+    dt = jnp.bfloat16 if plat != "cpu" else jnp.float32
+    x = jnp.ones((n, n), dt)
+    f = jax.jit(lambda c: (c @ c) * dt(1.0 / n))
+    out = {"y": None}
+
+    def run():
+        y = x
+        for _ in range(4):           # 4 chained matmuls per timed rep
+            y = f(y)
+        out["y"] = y
+
+    def sync():
+        jax.block_until_ready(out["y"])
+
+    t = _time(run, sync, reps) / 4
+    flops = 2.0 * n ** 3
+    return {"matmul_tflops": round(flops / t / 1e12, 3),
+            "flops_per_sec": flops / t, "n": n, "dtype": str(dt.__name__)}
+
+
+DEFAULT_CALIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmark", "results", "roofline_calib.json")
+
+
+def write_calibration(path=None, peak_tflops=None, size_mb=256, reps=5):
+    """Measure and write the roofline calibration artifact that
+    `mx.inspect.roofline.load_calibration()` consumes."""
+    import jax
+    path = path or DEFAULT_CALIB_PATH
+    dev = jax.devices()[0]
+    bw = measure_membw(size_mb=size_mb, reps=reps)
+    if peak_tflops is not None:
+        compute = {"pinned_tflops": float(peak_tflops),
+                   "flops_per_sec": float(peak_tflops) * 1e12}
+    else:
+        compute = measure_compute_peak(reps=reps)
+    calib = {
+        "format_version": 1,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "peak_flops": compute["flops_per_sec"],
+        "peak_bytes_per_sec": bw["bytes_per_sec"],
+        "ridge_flop_per_byte": round(
+            compute["flops_per_sec"] / bw["bytes_per_sec"], 3),
+        "probes": {"membw": bw, "compute": compute},
+        "source": "tools/bandwidth.py --calib",
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(calib, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    print(f"wrote {path}: {bw['triad_gbps']} GB/s triad, "
+          f"{calib['peak_flops'] / 1e12:.3f} TFLOP/s, "
+          f"ridge {calib['ridge_flop_per_byte']} FLOP/B")
+    return calib
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sizes-mb", type=float, nargs="+",
                     default=[1, 4, 16, 64])
     ap.add_argument("--reps", type=int, default=5)
     ap.add_argument("--json", default=None)
+    ap.add_argument("--calib", nargs="?", const=DEFAULT_CALIB_PATH,
+                    default=None, metavar="PATH",
+                    help="measure device membw + compute peak and write "
+                         "the roofline calibration artifact (default "
+                         "benchmark/results/roofline_calib.json)")
+    ap.add_argument("--peak-tflops", type=float, default=None,
+                    help="pin the calibration's compute ceiling (TFLOP/s) "
+                         "instead of the quick matmul probe — use the "
+                         "bench.py calib-phase attainable on TPU")
+    ap.add_argument("--calib-size-mb", type=float, default=256,
+                    help="triad buffer size for --calib (default 256)")
     args = ap.parse_args()
+    if args.calib:
+        write_calibration(args.calib, peak_tflops=args.peak_tflops,
+                          size_mb=args.calib_size_mb, reps=args.reps)
+        return
     rows = measure(args.sizes_mb, args.reps)
     cols = sorted({k for r in rows for k in r})
     print("  ".join(f"{c:>16}" for c in cols))

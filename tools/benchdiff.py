@@ -43,6 +43,12 @@ TREND_KEYS = {
     "input_pipeline_speedup": "higher",
     "serve_requests_per_sec_c32": "higher",
     "mfu_bs32": "higher",
+    # offenders phase (mx.inspect roofline attribution): the structural
+    # MFU ceiling should only rise as fusions improve; the worst class's
+    # dominance and the memory-bound byte fraction should only fall
+    "est_step_mfu_ceiling": "higher",
+    "offender_top1_share": "lower",
+    "memory_bound_byte_share": "lower",
     "per_dispatch_latency_us_sync": "lower",
     "per_dispatch_latency_us_chained": "lower",
     "serve_p99_ms_c32": "lower",
@@ -203,6 +209,30 @@ def self_test():
                                 "CPU-backend numbers")
     check("silent CPU-fallback round is skipped, not a regression",
           compare(base, cpu_fallback)["status"] == "skipped")
+    # offenders-phase keys: falling MFU ceiling and a rising worst-class
+    # share / memory-bound byte fraction must gate the trend
+    offender_base = {"backend_ok": True, "est_step_mfu_ceiling": 0.50,
+                     "offender_top1_share": 0.30,
+                     "memory_bound_byte_share": 0.60}
+    rep = compare(offender_base,
+                  dict(offender_base, est_step_mfu_ceiling=0.40))
+    check(">10% drop in est_step_mfu_ceiling is a regression",
+          rep["status"] == "regression"
+          and rep["regressions"][0]["key"] == "est_step_mfu_ceiling")
+    rep = compare(offender_base,
+                  dict(offender_base, offender_top1_share=0.40,
+                       memory_bound_byte_share=0.75))
+    check(">10% rise in offender_top1_share/memory_bound_byte_share "
+          "is a regression",
+          rep["status"] == "regression"
+          and {r["key"] for r in rep["regressions"]}
+          == {"offender_top1_share", "memory_bound_byte_share"})
+    rep = compare(offender_base,
+                  dict(offender_base, offender_top1_share=0.20,
+                       memory_bound_byte_share=0.45,
+                       est_step_mfu_ceiling=0.60))
+    check("improving offender keys pass with improvements reported",
+          rep["status"] == "ok" and len(rep["improvements"]) == 3)
     missing_only_new = {"backend_ok": True,
                         "io_pipeline_images_per_sec": 700.0}
     check("keys missing from one side are skipped, not regressions",
