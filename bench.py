@@ -63,9 +63,9 @@ FLOPS_TRAIN_PER_IMG = 3 * FLOPS_FWD_PER_IMG
 TPU_V5E_BF16_PEAK = 197e12  # FLOP/s per chip (MAC = 2 flops)
 
 
-def _make_net(layout):
+def _make_net(layout, model="resnet50"):
     from incubator_mxnet_tpu.gluon.model_zoo import vision
-    net = vision.resnet50_v1(layout=layout)
+    net = getattr(vision, f"{model}_v1")(layout=layout)
     net.initialize()
     net.hybridize()
     return net
@@ -582,6 +582,156 @@ def _phase_serve():
     return out
 
 
+def bench_fused_train(model="resnet18", batch_size=32, iters=12, warmup=4,
+                      layout="NHWC", use_amp=True, remat=None, donate=True,
+                      use_fusion=True, tiny=False):
+    """One fused-step measurement for the kernel-tier policy sweep:
+    (ips, flops_per_step, retraces_after_warmup). Same elision-proof
+    donated-chain methodology as bench_resnet50_train; `tiny` swaps in the
+    offenders-phase tiny net for the --quick smoke."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import amp, gluon
+    from incubator_mxnet_tpu import optimizer as opt_mod
+    from incubator_mxnet_tpu.gluon.contrib import FusedTrainStep
+
+    if use_amp:
+        amp.init("bfloat16")
+    try:
+        if tiny:
+            net = gluon.nn.HybridSequential()
+            net.add(gluon.nn.Conv2D(8, 3, padding=1, layout="NHWC"),
+                    gluon.nn.BatchNorm(axis=3), gluon.nn.Activation("relu"),
+                    gluon.nn.GlobalAvgPool2D(layout="NHWC"),
+                    gluon.nn.Flatten(), gluon.nn.Dense(10))
+            net.initialize()
+            net.hybridize()
+            shape = (batch_size, 8, 8, 3)
+            n_classes = 10
+        else:
+            net = _make_net(layout, model=model)
+            shape = ((batch_size, 3, 224, 224) if layout == "NCHW"
+                     else (batch_size, 224, 224, 3))
+            n_classes = 1000
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        xs = [mx.np.array(np.random.uniform(-1, 1, shape)
+                          .astype(np.float32)) for _ in range(2)]
+        ys = [mx.np.array(np.random.randint(0, n_classes, (batch_size,)))
+              for _ in range(2)]
+        net(xs[0])                               # resolve deferred shapes
+        opt = opt_mod.create("sgd", learning_rate=0.05, momentum=0.9,
+                             rescale_grad=1.0 / batch_size)
+        step = FusedTrainStep(net, lambda n, a, b: loss_fn(n(a), b).sum(),
+                              opt, remat=remat, donate=donate,
+                              use_fusion=use_fusion)
+        flops = None
+        try:
+            flops = step.flops_per_call(xs[0], ys[0])
+        except Exception:
+            pass
+        first_param = list(net.collect_params().values())[0]
+        for i in range(warmup):
+            step(xs[i % 2], ys[i % 2])
+        first_param.data().asnumpy()             # sync the warmup chain
+        # private jax API: guard like deploy/serve do (-1 -> retraces 0)
+        cache_size = getattr(step._jit, "_cache_size", lambda: -1)
+        warm_cache = cache_size()
+        t0 = time.perf_counter()
+        for i in range(iters):
+            step(xs[i % 2], ys[i % 2])
+        first_param.data().asnumpy()             # forces the full chain
+        dt = time.perf_counter() - t0
+        retraces = cache_size() - warm_cache
+    finally:
+        if use_amp:
+            amp.uninit()
+    return batch_size * iters / dt, flops, retraces
+
+
+def _phase_fused_sweep(tiny=False):
+    """Kernel-tier policy sweep (ROADMAP item 2 close-out): ResNet-18
+    FusedTrainStep with the fused op tier ON, swept over the remat x
+    donation grid {None,dots,full} x {donate,no-donate}; an NHWC/NCHW
+    layout A-B under the winning policy (recorded next to the per-op
+    dispatch-record layouts); and an unfused (use_fusion=False) baseline
+    for the speedup row. Trend scalars `fused_step_images_per_sec` and
+    `fused_step_mfu` are gated by tools/benchdiff.py; the offenders phase
+    gates the structural side (memory_bound_byte_share down,
+    est_step_mfu_ceiling up)."""
+    from incubator_mxnet_tpu.ops import fused as fused_mod
+    from incubator_mxnet_tpu.ops.registry import get_op
+
+    remats = (None,) if tiny else (None, "dots", "full")
+    donates = (True, False)
+    kwargs = dict(tiny=True, batch_size=8, iters=6, warmup=2) if tiny \
+        else dict(batch_size=32, iters=12, warmup=4)
+
+    fused_mod.fused_stats(reset=True)
+    results, flops_by, retraces_by = {}, {}, {}
+    for remat in remats:
+        for donate in donates:
+            tag = f"{remat or 'none'}+{'donate' if donate else 'nodonate'}"
+            try:
+                ips, flops, retraces = bench_fused_train(
+                    remat=remat, donate=donate, use_fusion=True, **kwargs)
+            except Exception as e:   # one variant must not kill the row
+                _log(f"fused_sweep {tag} failed: {type(e).__name__}: {e}")
+                continue
+            results[tag] = round(ips, 2)
+            flops_by[tag] = flops
+            retraces_by[tag] = retraces
+            _log(f"fused_sweep {tag}: {ips:.1f} img/s")
+    if not results:
+        raise RuntimeError("all fused_sweep policy variants failed")
+    best = max(results, key=results.get)
+    stats = fused_mod.fused_stats()
+    out = {
+        "fused_step_images_per_sec": results[best],
+        "fused_sweep_policy_choice": best,
+        "fused_sweep_by_policy": results,
+        "fused_step_retraces_after_warmup": retraces_by[best],
+        # honesty marker: off-TPU the kernels fall back to the jnp
+        # composition — a CPU round's speedup is the REWIRING's, not the
+        # Pallas kernels', and must not be read as the TPU win
+        "fused_pallas_active": stats["pallas_calls"] > 0,
+    }
+    bs = kwargs["batch_size"]
+    if flops_by.get(best):
+        per_img = flops_by[best] / bs
+        out["fused_step_mfu"] = round(
+            results[best] * per_img / TPU_V5E_BF16_PEAK, 4)
+        out["fused_step_flops_per_img"] = round(per_img / 1e9, 2)
+    # unfused baseline under the winning policy -> the speedup row
+    remat_b, donate_b = best.split("+")
+    try:
+        base_ips, _, _ = bench_fused_train(
+            remat=None if remat_b == "none" else remat_b,
+            donate=donate_b == "donate", use_fusion=False, **kwargs)
+        out["fused_step_unfused_images_per_sec"] = round(base_ips, 2)
+        out["fused_step_speedup_vs_unfused"] = round(
+            results[best] / base_ips, 3)
+    except Exception as e:
+        _log(f"fused_sweep unfused baseline failed: {e}")
+    # layout A/B under the winning policy (tiny nets are NHWC-only)
+    if not tiny:
+        layouts = {"NHWC": results[best]}
+        # dispatch-record layout is last-writer-wins: read the WINNER's
+        # before the NCHW probe overwrites it with the loser's
+        conv_rec = get_op("npx.convolution")
+        if conv_rec.layout:
+            out["fused_conv_dispatch_layout"] = conv_rec.layout
+        try:
+            ips_nchw, _, _ = bench_fused_train(
+                layout="NCHW",
+                remat=None if remat_b == "none" else remat_b,
+                donate=donate_b == "donate", use_fusion=True, **kwargs)
+            layouts["NCHW"] = round(ips_nchw, 2)
+        except Exception as e:
+            _log(f"fused_sweep NCHW layout failed: {e}")
+        out["fused_layout_by"] = layouts
+        out["fused_layout_choice"] = max(layouts, key=layouts.get)
+    return out
+
+
 def _phase_offenders(model="resnet18", batch_size=32):
     """Fusion-level roofline attribution of the compiled train step
     (mx.inspect): the ranked offender work-list for the kernel tier, and
@@ -636,6 +786,7 @@ PHASES = [
     ("input_pipeline", _phase_input_pipeline),
     ("serve", _phase_serve),
     ("offenders", _phase_offenders),
+    ("fused_sweep", _phase_fused_sweep),
     ("calib", _phase_calib),
     ("xla_flops", _phase_xla_flops),
 ]
@@ -665,11 +816,18 @@ def _phase_offenders_quick():
     return _phase_offenders(model="tiny", batch_size=4)
 
 
+def _phase_fused_sweep_quick():
+    # same keys, tiny net, policy grid reduced to {None} x donate on/off:
+    # the tier-1 smoke exercises sweep + baseline + gate keys end to end
+    return _phase_fused_sweep(tiny=True)
+
+
 QUICK_PHASES = {
     "dispatch": _phase_dispatch_quick,
     "train32": _phase_train32_quick,
     "infer": _phase_infer_quick,
     "offenders": _phase_offenders_quick,
+    "fused_sweep": _phase_fused_sweep_quick,
 }
 
 # Per-phase subprocess timeouts, seconds. MXNET_BENCH_PHASE_TIMEOUT (one
@@ -677,7 +835,7 @@ QUICK_PHASES = {
 PHASE_TIMEOUTS = {
     "dispatch": 300, "eager": 900, "train32": 1500, "train128": 1500,
     "infer": 900, "io": 700, "input_pipeline": 700, "serve": 700,
-    "offenders": 700, "calib": 900, "xla_flops": 600,
+    "offenders": 700, "fused_sweep": 2000, "calib": 900, "xla_flops": 600,
 }
 PHASE_TIMEOUT_DEFAULT_S = 900
 

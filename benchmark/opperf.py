@@ -75,9 +75,13 @@ def _roofline_cols(fn, dev_args):
             "bytes_estimated": cost["bytes_estimated"]}
 
 
-def _bench_one(name, fn, arg_arrays, grad_idx=0, warmup=3, iters=10):
+def _bench_one(name, fn, arg_arrays, grad_idx=0, warmup=3, iters=10,
+               unfused_fn=None):
     """Returns dict with eager/jit/bwd median microseconds + roofline
-    coordinates."""
+    coordinates. When `unfused_fn` is given (the fused-tier rows), the
+    unfused composition is timed under jit too and the row carries a
+    fused-vs-unfused `speedup_vs_unfused` column (fwd) and
+    `bwd_speedup_vs_unfused`."""
     import jax
     import jax.numpy as jnp
 
@@ -93,6 +97,24 @@ def _bench_one(name, fn, arg_arrays, grad_idx=0, warmup=3, iters=10):
         row["bwd_us"] = round(_time_fn(gfn, dev_args, warmup, iters), 1)
     except Exception:
         row["bwd_us"] = None  # non-differentiable op
+    if unfused_fn is not None:
+        ujfn = jax.jit(unfused_fn)
+        row["unfused_jit_us"] = round(
+            _time_fn(ujfn, dev_args, warmup, iters), 1)
+        if row["jit_us"] > 0:
+            row["speedup_vs_unfused"] = round(
+                row["unfused_jit_us"] / row["jit_us"], 3)
+        try:
+            def uloss(*xs):
+                return jnp.sum(jnp.abs(unfused_fn(*xs)))
+            ugfn = jax.jit(jax.grad(uloss, argnums=grad_idx))
+            row["unfused_bwd_us"] = round(
+                _time_fn(ugfn, dev_args, warmup, iters), 1)
+            if row["bwd_us"]:
+                row["bwd_speedup_vs_unfused"] = round(
+                    row["unfused_bwd_us"] / row["bwd_us"], 3)
+        except Exception:
+            row["unfused_bwd_us"] = None
     row.update(_roofline_cols(jfn, dev_args))   # reuses the timed compile
     return row
 
@@ -280,6 +302,51 @@ def cat_optimizer(jnp, npx):
     ]
 
 
+def cat_fused(jnp, npx):
+    """The fused kernel tier (ops/fused.py + npx.flash_attention): each
+    row times the FUSED op against its UNFUSED composition under jit —
+    the per-op ground truth for the offender work-list's projected wins
+    (4-tuples: the extra element is the unfused fn)."""
+    import functools
+    from incubator_mxnet_tpu.ops import fused as F
+    from incubator_mxnet_tpu.ops import nn as NN
+    from incubator_mxnet_tpu.ops.pallas_attention import flash_attention
+
+    x = _rand((32 * 28 * 28, 256))
+    s = _rand((256,), positive=True)
+    b = _rand((256,))
+    r = _rand((32 * 28 * 28, 256))
+    m = _rand((256,))
+    v = _rand((256,), positive=True)
+    xp = _rand((16, 28, 28, 256))
+    q = _rand((8, 256, 64))
+
+    def unfused_pool(t):
+        return NN.pooling(t, (2, 2), "avg", stride=(2, 2), layout="NHWC")
+
+    def unfused_attn(a, b_, c):
+        return NN.scaled_dot_product_attention(a, b_, c)
+
+    return [
+        ("fused_bias_act_relu", functools.partial(F.bias_act,
+                                                  act_type="relu"),
+         functools.partial(F.bias_act_ref, act_type="relu"), (x, b)),
+        ("fused_norm_act_residual",
+         functools.partial(F.norm_act_residual, act_type="relu"),
+         functools.partial(F.norm_act_residual_ref, act_type="relu"),
+         (x, s, b, r)),
+        ("fused_bn_inference_relu",
+         functools.partial(F.bn_inference, act_type="relu"),
+         functools.partial(F.bn_inference_ref, act_type="relu"),
+         (x, s, b, m, v)),
+        ("fused_avg_pool2d_2x2",
+         functools.partial(F.avg_pool2d, pool_size=(2, 2)),
+         unfused_pool, (xp,)),
+        ("flash_attention_8x256x64", flash_attention, unfused_attn,
+         (q, q, q)),
+    ]
+
+
 CATEGORIES = {
     "unary": cat_unary,
     "binary": cat_binary,
@@ -291,10 +358,12 @@ CATEGORIES = {
     "conv": cat_conv,
     "norm": cat_norm,
     "optimizer": cat_optimizer,
+    "fused": cat_fused,
 }
 
 
-QUICK_CATEGORIES = ("gemm", "norm")      # a compute and a memory class
+# a compute class, a memory class, and the fused tier (speedup column)
+QUICK_CATEGORIES = ("gemm", "norm", "fused")
 
 
 def run(categories=None, as_json=None, quick=False):
@@ -310,10 +379,14 @@ def run(categories=None, as_json=None, quick=False):
     for cat in categories:
         specs = CATEGORIES[cat](jnp, npx)
         rows = []
-        for name, fn, args in specs:
+        for spec in specs:
+            if len(spec) == 4:          # fused rows: (name, fn, unfused, args)
+                name, fn, unfused_fn, args = spec
+            else:
+                (name, fn, args), unfused_fn = spec, None
             try:
-                rows.append(_bench_one(name, fn, args,
-                                       warmup=warmup, iters=iters))
+                rows.append(_bench_one(name, fn, args, warmup=warmup,
+                                       iters=iters, unfused_fn=unfused_fn))
             except Exception as e:  # keep the table going
                 rows.append({"op": name, "error": str(e)[:120]})
         results[cat] = rows
@@ -345,8 +418,13 @@ def run(categories=None, as_json=None, quick=False):
             ai = (f"{r['intensity']:8.2f}"
                   if r.get("intensity") is not None else "     n/a")
             bound = r.get("bound") or "n/a"
-            print(f"{r['op']:32s} {r['eager_us']:10.1f} "
-                  f"{r['jit_us']:10.1f} {bwd} {gf} {mb} {ai} {bound:>8s}")
+            line = (f"{r['op']:32s} {r['eager_us']:10.1f} "
+                    f"{r['jit_us']:10.1f} {bwd} {gf} {mb} {ai} {bound:>8s}")
+            if r.get("speedup_vs_unfused") is not None:
+                line += (f"  vs-unfused {r['speedup_vs_unfused']:.2f}x"
+                         + (f" (bwd {r['bwd_speedup_vs_unfused']:.2f}x)"
+                            if r.get("bwd_speedup_vs_unfused") else ""))
+            print(line)
     return results
 
 

@@ -33,6 +33,19 @@ Rules (all anchored at the hazard expression):
                        The mutation runs ONCE at trace time, then never
                        again — state silently stops updating after the
                        first call.
+
+                       Carve-out (Pallas kernel bodies): a SUBSCRIPT store
+                       through a name that is a PARAMETER of a lexically
+                       enclosing function (`@pl.when`-nested initializers
+                       writing `scratch_ref[:] = ...`) is a write through
+                       a per-call mutable argument — the Pallas ref idiom,
+                       not frozen trace state — and is not flagged, but
+                       ONLY when some lexical ancestor actually invokes
+                       `pallas_call` (the nest is a real kernel build).
+                       Ordinary closures mutating an enclosing parameter,
+                       mutator METHOD calls and writes to enclosing
+                       locals/globals still fire. Fixtures:
+                       tests/lint_fixtures/pallas_kernel.py.
 """
 from __future__ import annotations
 
@@ -301,11 +314,41 @@ def run(modules):
         reach = _reachable(fns, roots)
         for fid in reach:
             info = fns[fid]
-            findings.extend(_check_fn(mod, info, aliases, from_names))
+            # parameters of lexical ancestors: subscript stores through
+            # them are writes via a per-call argument (Pallas refs), not
+            # frozen closure state. The carve-out is anchored on the nest
+            # actually being a Pallas one — some lexical ancestor must
+            # invoke `pallas_call` — so an ordinary closure mutating an
+            # enclosing parameter (`history[0] = ...`) still fires.
+            outer_params = set()
+            chain = []
+            parent = info.parent
+            while parent is not None and id(parent) in fns:
+                chain.append(parent)
+                parent = fns[id(parent)].parent
+            if any(_pallas_host(p) for p in chain):
+                for p in chain:
+                    outer_params |= _params(p)
+            findings.extend(_check_fn(mod, info, aliases, from_names,
+                                      outer_params))
     return findings
 
 
-def _check_fn(mod, info, aliases, from_names):
+def _pallas_host(node):
+    """Does this function's body lexically contain a `pallas_call`
+    invocation (`pl.pallas_call(...)` or bare `pallas_call(...)`)?
+    Anchors the Pallas-ref carve-out to real kernel nests."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name == "pallas_call":
+                return True
+    return False
+
+
+def _check_fn(mod, info, aliases, from_names, outer_params=frozenset()):
     fn = info.node
     out = []
     params = _params(fn)
@@ -420,6 +463,14 @@ def _check_fn(mod, info, aliases, from_names):
                 if isinstance(t, (ast.Attribute, ast.Subscript)):
                     base = _base_name(t)
                     if base is None:
+                        continue
+                    if isinstance(t, ast.Subscript) \
+                            and base in outer_params \
+                            and base not in globals_declared:
+                        # store through an enclosing function's parameter
+                        # (Pallas `ref[:] = ...` under @pl.when): a write
+                        # via a per-call mutable argument, not trace-
+                        # frozen closure state
                         continue
                     if base in globals_declared or base in derived or (
                             base not in local_names

@@ -168,7 +168,12 @@ _register_env("MXNET_ENGINE_TYPE", str, "XLA",
 _register_env("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
               "Whether hybridized training steps fuse fwd+bwd+update into one XLA program")
 _register_env("MXNET_USE_FUSION", bool, True,
-              "Kept for API parity; XLA always fuses pointwise chains")
+              "Gate the fused kernel tier (ops/fused.py Pallas kernels + "
+              "gluon rewrites; default on for FusedTrainStep/FusedInferStep, "
+              "eager paths opt in via fused.fusion_scope)")
+_register_env("MXNET_FUSION_INTERPRET", bool, False,
+              "Run the fused tier's Pallas kernels in interpret mode on "
+              "any backend (CI kernel-path coverage on CPU)")
 _register_env("MXNET_SAFE_ACCUMULATION", bool, True,
               "Accumulate bf16/fp16 reductions in float32")
 _register_env("MXNET_PROFILER_AUTOSTART", bool, False, "Start profiler at import")

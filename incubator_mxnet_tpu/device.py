@@ -93,6 +93,15 @@ def _accelerator_devices():
     return accel
 
 
+def tpu_platform_available():
+    """Any local device on an actual TPU platform ('tpu', or 'axon'
+    tunneled chips)? The Pallas kernel tier keys on this: GPUs are
+    accelerators too, but must NOT take the TPU-shaped kernel path —
+    off-TPU the fused ops use their jnp composition fallback."""
+    import jax
+    return any(d.platform in ("tpu", "axon") for d in jax.local_devices())
+
+
 def cpu(device_id=0):
     return Device("cpu", device_id)
 

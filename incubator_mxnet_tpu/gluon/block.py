@@ -43,6 +43,14 @@ def _amp_fingerprint():
     return amp_mod._state["target_dtype"]
 
 
+def _fusion_fingerprint():
+    """Whether the fused kernel tier is active (part of the cached-op
+    cache key: a fusion-on trace bakes fused ops into the XLA program,
+    a fusion-off trace must not reuse it)."""
+    from ..ops import fused as _fused
+    return _fused.fusion_enabled()
+
+
 from contextlib import contextmanager as _contextmanager
 
 
@@ -428,9 +436,10 @@ class HybridBlock(Block):
                                    sorted(self.collect_params().items())]
         params = self._cached_params
         training = autograd.is_training()
-        # cache key includes the autocast state: an amp-on trace bakes bf16
-        # casts into the XLA program, an amp-off trace must not reuse it
-        amp_fp = _amp_fingerprint()
+        # cache key includes the autocast state (an amp-on trace bakes
+        # bf16 casts into the XLA program) and the fused-tier state (a
+        # fusion-on trace bakes fused ops) — neither may serve the other
+        amp_fp = (_amp_fingerprint(), _fusion_fingerprint())
         cached = self._cached_graph.get((training, amp_fp))
         if cached is None:
             cached = self._build_cache(training, amp_fp)
@@ -467,10 +476,13 @@ class HybridBlock(Block):
             hook(self, args, out)
         return out
 
-    def _build_cache(self, training, amp_fp=None):
+    def _build_cache(self, training, fp=None):
         """Construct + jit the pure function for this block (≙ _build_cache
-        block.py:1095 building the CachedOp)."""
+        block.py:1095 building the CachedOp). `fp` is the
+        (amp fingerprint, fusion fingerprint) pair from _call_cached."""
         import jax
+        from ..ops import fused as _fused
+        amp_fp, fusion_fp = fp if isinstance(fp, tuple) else (fp, False)
         params = self._cached_params
         block = self
         meta = {"n_out": None, "aux_indices": None, "treedef": None}
@@ -485,8 +497,12 @@ class HybridBlock(Block):
                 nd._version += 1
             mutated = {}
             try:
+                # pin the fingerprinted fused-tier state: jit may retrace
+                # this fn later (new shapes) under a different ambient
+                # scope, and the cache entry's routing must not flip
                 with autograd._Scope(recording=False, training=training), \
-                        _random.trace_key_scope(rng_key):
+                        _random.trace_key_scope(rng_key), \
+                        _fused.fusion_scope(fusion_fp):
                     wrapped = tuple(_wrap(x) for x in inputs)
                     out = block.forward(*wrapped)
                 single = not isinstance(out, (list, tuple))
@@ -542,10 +558,11 @@ class HybridBlock(Block):
                     outs, aux, _ = fwd(pbufs, key, *inputs)
                     return tuple(outs) + tuple(aux)
 
-                # replay the forward's autocast state: backward runs with
-                # amp suspended, but the recompute must bake the SAME bf16
-                # casts the forward trace did or cotangent dtypes mismatch
-                with _amp_scope(amp_fp):
+                # replay the forward's autocast AND fused-tier state:
+                # backward runs with amp suspended, but the recompute must
+                # bake the SAME bf16 casts and the SAME fused-op routing
+                # the forward trace did or cotangent dtypes/graphs mismatch
+                with _amp_scope(amp_fp), _fused.fusion_scope(fusion_fp):
                     _, vjp = jax.vjp(flat_fn, *flat_args)
                 grads = vjp(tuple(cts))
                 # None for the (integer) rng key slot + float0 -> None so

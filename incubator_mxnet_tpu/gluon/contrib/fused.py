@@ -28,6 +28,7 @@ from __future__ import annotations
 import numpy as _np
 
 from ...base import MXNetError
+from ...ops import fused as _fused_mod
 
 __all__ = ["FusedTrainStep", "FusedInferStep"]
 
@@ -68,7 +69,8 @@ class FusedInferStep:
     executable and consecutive calls pipeline through donated buffers.
     """
 
-    def __init__(self, net, perturb=1e-6, steps_per_call=1):
+    def __init__(self, net, perturb=1e-6, steps_per_call=1,
+                 use_fusion=None):
         params = [p for _, p in sorted(net.collect_params().items())]
         for p in params:
             if p._data is None:
@@ -79,6 +81,10 @@ class FusedInferStep:
         self._params = params
         self._perturb = perturb
         self._K = int(steps_per_call)   # K chained forwards per dispatch
+        # fused kernel tier (ops/fused.py): default on for the fused steps
+        # per MXNET_USE_FUSION; the scope engages at trace time
+        self._use_fusion = _fused_mod._env_use_fusion() \
+            if use_fusion is None else bool(use_fusion)
         self._jit = None
         self._x = None
         self._pnds = None
@@ -91,6 +97,7 @@ class FusedInferStep:
 
         net, params, eps, n_steps = (self._net, self._params, self._perturb,
                                      self._K)
+        use_fusion = self._use_fusion
 
         def one(pbufs, x):
             saved = []
@@ -102,7 +109,8 @@ class FusedInferStep:
             try:
                 key = jax.random.PRNGKey(0)  # inference: dropout inactive
                 with autograd._Scope(recording=False, training=False), \
-                        _random.trace_key_scope(key):
+                        _random.trace_key_scope(key), \
+                        _fused_mod.fusion_scope(use_fusion):
                     out = net(_wrap(x))
                 logits = out._arr
             finally:
@@ -175,7 +183,8 @@ class FusedTrainStep:
     trace constant, like the reference's update_on_kvstore batching)."""
 
     def __init__(self, net, fn, optimizer, clip_global_norm=None,
-                 steps_per_call=1, remat=None):
+                 steps_per_call=1, remat=None, donate=True,
+                 use_fusion=None):
         from ... import optimizer as opt_mod
         optimizer = opt_mod.create(optimizer)
         # same eligibility rules as the multi-tensor fused path
@@ -211,6 +220,16 @@ class FusedTrainStep:
         if remat not in (None, "full", "dots"):
             raise MXNetError(f"unknown remat policy {remat!r}")
         self._remat = remat
+        # donate: hand the trainable weight + optimizer-state buffers to
+        # XLA (in-place update, halves the peak weight footprint). The
+        # off switch is the other arm of the bench policy sweep — some
+        # program shapes schedule better without donation aliasing.
+        self._donate = bool(donate)
+        # fused kernel tier (ops/fused.py) — default ON for the fused
+        # step per MXNET_USE_FUSION; the scope engages around the
+        # forward trace so gluon blocks route through the fused ops
+        self._use_fusion = _fused_mod._env_use_fusion() \
+            if use_fusion is None else bool(use_fusion)
         self._K = int(steps_per_call)
         if self._K < 1:
             raise MXNetError("steps_per_call must be >= 1")
@@ -256,6 +275,7 @@ class FusedTrainStep:
 
         n_steps = self._K
         frozen_pos = {i: k for k, i in enumerate(frozen_idx)}
+        use_fusion = self._use_fusion
 
         def one_step(train_bufs, sbufs, frozen_bufs, key, lrs, wds, rescale,
                      ts, in_raw):
@@ -273,7 +293,8 @@ class FusedTrainStep:
                     nd._version += 1
                 try:
                     with autograd._Scope(recording=False, training=True), \
-                            _random.trace_key_scope(key):
+                            _random.trace_key_scope(key), \
+                            _fused_mod.fusion_scope(use_fusion):
                         out = fn(net, *[_wrap(r) for r in in_raw])
                     if isinstance(out, (tuple, list)):
                         loss, extras = out[0], tuple(out[1:])
@@ -379,8 +400,10 @@ class FusedTrainStep:
             return list(new_w), list(new_s), losses, extras, aux
 
         # donate only the trainable weight + optimizer-state buffers; frozen
-        # params keep their buffers live across calls
-        return jax.jit(step, donate_argnums=(0, 1))
+        # params keep their buffers live across calls. donate=False is the
+        # other arm of the bench policy sweep (docs/PERF.md "Kernel tier").
+        return jax.jit(step,
+                       donate_argnums=(0, 1) if self._donate else ())
 
     # ------------------------------------------------------------------
     def lowered(self, *inputs):

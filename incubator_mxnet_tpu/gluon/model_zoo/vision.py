@@ -73,11 +73,20 @@ class BasicBlockV1(HybridBlock):
             self.downsample = None
 
     def forward(self, x):
+        from ... import numpy_extension as npx
+        from ...ops import fused as _fused
         residual = x
-        x2 = self.body(x)
         if self.downsample is not None:
             residual = self.downsample(residual)
-        from ... import numpy_extension as npx
+        if _fused.fusion_enabled():
+            # kernel tier: each BN(+relu) is one fused pass, and the block
+            # tail (BN + residual add + relu — the top memory-bound
+            # offender class) is ONE op
+            conv1, bn1, _act, conv2, bn2 = list(self.body)
+            h = bn1.fused_forward(conv1(x), act_type="relu")
+            return bn2.fused_forward(conv2(h), act_type="relu",
+                                     residual=residual)
+        x2 = self.body(x)
         return npx.relu(x2 + residual)
 
 
@@ -108,11 +117,19 @@ class BottleneckV1(HybridBlock):
             self.downsample = None
 
     def forward(self, x):
+        from ... import numpy_extension as npx
+        from ...ops import fused as _fused
         residual = x
-        x2 = self.body(x)
         if self.downsample is not None:
             residual = self.downsample(residual)
-        from ... import numpy_extension as npx
+        if _fused.fusion_enabled():
+            (conv1, bn1, _a1, conv2, bn2, _a2,
+             conv3, bn3) = list(self.body)
+            h = bn1.fused_forward(conv1(x), act_type="relu")
+            h = bn2.fused_forward(conv2(h), act_type="relu")
+            return bn3.fused_forward(conv3(h), act_type="relu",
+                                     residual=residual)
+        x2 = self.body(x)
         return npx.relu(x2 + residual)
 
 
@@ -136,12 +153,19 @@ class BasicBlockV2(HybridBlock):
 
     def forward(self, x):
         from ... import numpy_extension as npx
+        from ...ops import fused as _fused
         residual = x
-        x = npx.relu(self.bn1(x))
+        if _fused.fusion_enabled():
+            x = self.bn1.fused_forward(x, act_type="relu")
+        else:
+            x = npx.relu(self.bn1(x))
         if self.downsample is not None:
             residual = self.downsample(x)
         x = self.conv1(x)
-        x = npx.relu(self.bn2(x))
+        if _fused.fusion_enabled():
+            x = self.bn2.fused_forward(x, act_type="relu")
+        else:
+            x = npx.relu(self.bn2(x))
         x = self.conv2(x)
         return x + residual
 
@@ -168,14 +192,21 @@ class BottleneckV2(HybridBlock):
 
     def forward(self, x):
         from ... import numpy_extension as npx
+        from ...ops import fused as _fused
+        fuse = _fused.fusion_enabled()
+
+        def bn_relu(bn, v):
+            return bn.fused_forward(v, act_type="relu") if fuse \
+                else npx.relu(bn(v))
+
         residual = x
-        x = npx.relu(self.bn1(x))
+        x = bn_relu(self.bn1, x)
         if self.downsample is not None:
             residual = self.downsample(x)
         x = self.conv1(x)
-        x = npx.relu(self.bn2(x))
+        x = bn_relu(self.bn2, x)
         x = self.conv2(x)
-        x = npx.relu(self.bn3(x))
+        x = bn_relu(self.bn3, x)
         x = self.conv3(x)
         return x + residual
 

@@ -16,8 +16,27 @@ import numpy as _np
 from ...base import MXNetError, name_to_dtype
 from ... import numpy_extension as npx
 from ... import numpy as mxnp
+from ...ops import fused as _fused
 from ..block import Block, HybridBlock
 from ..parameter import Parameter
+
+# activation strings the fused-tier GATE may engage on: the intersection
+# of the kernel contract (ops/fused.py FUSABLE_ACTS) and what
+# npx.activation serves — the tier must be a pure optimization, so a
+# block built with one of these must also run with fusion OFF
+# (MXNET_USE_FUSION=0 A/B). silu/gelu are fusable by the kernels but
+# have no unfused npx.activation, so the gate skips them.
+_NPX_ACTS = frozenset(("relu", "sigmoid", "tanh", "softrelu", "softsign",
+                       "log_sigmoid", "mish"))
+_FUSABLE_ACTS = frozenset(a for a in _fused.FUSABLE_ACTS if a) & _NPX_ACTS
+
+
+def _fusion_on():
+    """Route this forward through the fused kernel tier? True inside a
+    `fused.fusion_scope(True)` (FusedTrainStep/FusedInferStep enter one
+    automatically) or after `fused.set_fusion_default(True)`, unless
+    MXNET_USE_FUSION kills the tier. See docs/PERF.md 'Kernel tier'."""
+    return _fused.fusion_enabled()
 
 __all__ = [
     "Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
@@ -106,6 +125,13 @@ class Dense(HybridBlock):
             self.bias.shape = (self._units,)
 
     def forward(self, x):
+        if (self._act_type in _FUSABLE_ACTS and self.bias is not None
+                and _fusion_on()):
+            # kernel tier: bias + activation fold into one fused pass
+            y = npx.fully_connected(x, self.weight.data(), None,
+                                    no_bias=True, flatten=self._flatten)
+            return npx.fused_bias_act(y, self.bias.data(),
+                                      act_type=self._act_type, axis=-1)
         y = npx.fully_connected(
             x, self.weight.data(),
             None if self.bias is None else self.bias.data(),
@@ -177,7 +203,22 @@ class BatchNorm(HybridBlock):
         for p in (self.gamma, self.beta, self.running_mean, self.running_var):
             p.shape = (ch,)
 
+    def fused_forward(self, x, act_type=None, residual=None):
+        """BN + optional activation + optional pre-activation residual
+        add as ONE fused-tier op (npx.fused_batch_norm): the apply stage
+        runs as a single Pallas pass on TPU instead of the memory-bound
+        fusion chain. Numerics match forward() (+ activation, + add)
+        within float association; running stats update identically."""
+        return npx.fused_batch_norm(
+            x, self.gamma.data(), self.beta.data(),
+            self.running_mean.data(), self.running_var.data(),
+            eps=self._eps, momentum=self._momentum, axis=self._axis,
+            use_global_stats=self._use_global_stats, act_type=act_type,
+            residual=residual)
+
     def forward(self, x):
+        if _fusion_on():
+            return self.fused_forward(x)
         return npx.batch_norm(
             x, self.gamma.data(), self.beta.data(),
             self.running_mean.data(), self.running_var.data(),
@@ -190,10 +231,14 @@ class BatchNorm(HybridBlock):
 
 
 class BatchNormReLU(BatchNorm):
-    """Fused BN+ReLU (≙ basic_layers.py:478; XLA fuses these anyway)."""
+    """Fused BN+ReLU (≙ basic_layers.py:478). On the kernel tier
+    (`_fusion_on()`) the whole normalize+scale/shift+relu chain is one
+    fused pass; otherwise BN + relu as before (XLA fuses pointwise)."""
 
     def forward(self, x):
-        return npx.relu(super().forward(x))
+        if _fusion_on():
+            return self.fused_forward(x, act_type="relu")
+        return npx.relu(BatchNorm.forward(self, x))
 
 
 class SyncBatchNorm(BatchNorm):
@@ -562,6 +607,10 @@ class _Conv(HybridBlock):
 
     def forward(self, x):
         bias = None if self.bias is None else self.bias.data()
+        fuse_ba = (self._act_type in _FUSABLE_ACTS and bias is not None
+                   and _fusion_on())
+        if fuse_ba:
+            bias_arr, bias = bias, None   # bias folds into the fused act
         if self._op_name == "convolution":
             y = npx.convolution(x, self.weight.data(), bias,
                                 stride=self._strides, dilate=self._dilation,
@@ -573,6 +622,9 @@ class _Conv(HybridBlock):
                                   pad=self._padding, adj=self._adj or 0,
                                   num_group=self._groups,
                                   no_bias=bias is None, layout=self._layout)
+        if fuse_ba:
+            return npx.fused_bias_act(y, bias_arr, act_type=self._act_type,
+                                      axis=self._channel_axis())
         if self._act_type:
             y = npx.activation(y, act_type=self._act_type)
         return y
@@ -661,7 +713,32 @@ class _Pool(HybridBlock):
         self._count_include_pad = count_include_pad
         self._ceil_mode = ceil_mode
 
+    def _fused_pool_size(self, x):
+        """(ph, pw) when this pool can take the fused non-overlapping
+        NHWC kernel (VMEM-tiled Pallas backward), else None: avg type,
+        NHWC 2-D, zero padding, kernel == stride dividing the spatial
+        dims — which covers AvgPool2D(k, k) and GlobalAvgPool2D."""
+        if self._type != "avg" or self._layout != "NHWC" or x.ndim != 4:
+            return None
+        h, w = x.shape[1], x.shape[2]
+        if self._global:
+            return (h, w)
+        k = (self._kernel,) * 2 if isinstance(self._kernel, int) \
+            else tuple(self._kernel)
+        s = (self._stride,) * 2 if isinstance(self._stride, int) \
+            else tuple(self._stride)
+        p = (self._pad,) * 2 if isinstance(self._pad, int) \
+            else tuple(self._pad)
+        if len(k) == 2 and k == s and p == (0, 0) \
+                and h % k[0] == 0 and w % k[1] == 0:
+            return k
+        return None
+
     def forward(self, x):
+        if _fusion_on():
+            ps = self._fused_pool_size(x)
+            if ps is not None:
+                return npx.fused_avg_pool2d(x, ps, layout="NHWC")
         return npx.pooling(x, kernel=self._kernel, pool_type=self._type,
                            stride=self._stride, pad=self._pad,
                            global_pool=self._global,

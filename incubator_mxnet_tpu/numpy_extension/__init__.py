@@ -15,8 +15,10 @@ import numpy as _onp
 
 from ..base import MXNetError, name_to_dtype
 from ..ndarray import NDArray, _as_nd, _wrap
-from ..ops.registry import invoke, register_op, get_op, record_key
+from ..ops.registry import (invoke, register_op, get_op, record_key,
+                            note_layout)
 from ..ops import nn as _nn
+from ..ops import fused as _fused_ops
 from ..ops import segment as _segment
 from .. import random as _grandom
 from .. import autograd as _autograd
@@ -177,6 +179,152 @@ __all__.append("rnn")
 scaled_dot_product_attention = _make_nn("scaled_dot_product_attention")
 
 
+def _make_fused(fname, name=None):
+    """npx wrapper over an ops.fused kernel — same contract as _make_nn
+    (arrays positional, static config via kwargs, dispatch-record key from
+    the registration-precomputed base key)."""
+    f = getattr(_fused_ops, fname)
+    base_key = _segment.derive_key_cached(f)
+
+    def fn(*arrays, **kwargs):
+        arrs = tuple(_as_nd(a) if not isinstance(a, NDArray) else a
+                     for a in arrays)
+        # array-valued kwargs (e.g. bn_inference's residual=) close over
+        # as raw buffers, same contract as _make_nn
+        kwargs = {k: (v._arr if isinstance(v, NDArray) else v)
+                  for k, v in kwargs.items()}
+        # resolve the kernel-vs-fallback mode NOW so it enters the
+        # dispatch key: a set_interpret() toggle must not replay programs
+        # compiled for the other path
+        kwargs.setdefault("interpret", _fused_ops._interpret())
+        return invoke(functools.partial(f, **kwargs),
+                      arrs, name=name or fname, op=info,
+                      key=record_key(base_key, kwargs))
+    fn.__name__ = name or fname
+    register_op("npx." + (name or fname), fn,
+                amp=getattr(f, "_amp_class", "neutral"))
+    info = get_op("npx." + (name or fname))
+    return fn
+
+
+# fused kernel tier (ops/fused.py — Pallas on TPU, jnp composition
+# elsewhere). Gluon blocks route here when fused.fusion_enabled().
+fused_bias_act = _make_fused("bias_act", "fused_bias_act")
+fused_norm_act_residual = _make_fused("norm_act_residual",
+                                      "fused_norm_act_residual")
+fused_bn_inference = _make_fused("bn_inference", "fused_bn_inference")
+
+
+def fused_avg_pool2d(data, pool_size, layout="NHWC"):
+    """Fused non-overlapping NHWC average pool (kernel == stride, no
+    padding; GlobalAvgPool shapes included) with the VMEM-tiled Pallas
+    backward — see ops.fused.avg_pool2d."""
+    info = get_op("npx.fused_avg_pool2d")
+    note_layout(info, layout)
+    ps = (pool_size, pool_size) if isinstance(pool_size, int) \
+        else tuple(pool_size)
+    kw = {"pool_size": ps, "layout": layout,
+          "interpret": _fused_ops._interpret()}
+    return invoke(functools.partial(_fused_ops.avg_pool2d, **kw),
+                  (_as_nd(data),), name="fused_avg_pool2d", op=info,
+                  key=record_key(_avg_pool_key, kw))
+
+
+register_op("npx.fused_avg_pool2d", _fused_ops.avg_pool2d,
+            amp=_fused_ops.avg_pool2d._amp_class)
+_avg_pool_key = _segment.derive_key_cached(_fused_ops.avg_pool2d)
+
+
+def fused_batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
+                     momentum=0.9, axis=1, use_global_stats=False,
+                     training=None, sync_axis_name=None, act_type=None,
+                     residual=None):
+    """Batch norm with the apply stage routed through the fused kernel
+    tier, plus optional fused activation and pre-activation residual add
+    (ops.fused.batch_norm). Same running-stat write-back protocol as
+    npx.batch_norm."""
+    if training is None:
+        training = _autograd.is_training()
+    kw = dict(momentum=momentum, eps=eps, training=training, axis=axis,
+              use_global_stats=use_global_stats,
+              sync_axis_name=sync_axis_name, act_type=act_type,
+              interpret=_fused_ops._interpret())
+    info = get_op("npx.fused_batch_norm")
+    arrs = (_as_nd(x), _as_nd(gamma), _as_nd(beta), _as_nd(running_mean),
+            _as_nd(running_var))
+    if residual is not None:
+        out, nm, nv = invoke(
+            functools.partial(_bn_residual, **kw),
+            arrs + (_as_nd(residual),),
+            name="fused_batch_norm", op=info,
+            key=record_key(_fused_bn_res_key, kw), multi_out=True)
+    else:
+        out, nm, nv = invoke(
+            functools.partial(_fused_ops.batch_norm, **kw), arrs,
+            name="fused_batch_norm", op=info,
+            key=record_key(_fused_bn_key, kw), multi_out=True)
+    if training and isinstance(running_mean, NDArray):
+        with _autograd.pause():
+            # adopt the (possibly pending) stat buffers like npx.batch_norm
+            running_mean._set_arr(nm._data)
+            running_var._set_arr(nv._data)
+    return out
+
+
+def _bn_residual(a, g, b, rm, rv, r, **kw):
+    """Module-level residual variant: arrays positional so the dispatch
+    derives a stable key (a per-call closure would key as None — no
+    bulking, and a full vjp retrace per call under recording)."""
+    return _fused_ops.batch_norm(a, g, b, rm, rv, residual=r, **kw)
+
+
+register_op("npx.fused_batch_norm", _fused_ops.batch_norm,
+            amp=_fused_ops.batch_norm._amp_class)
+_fused_bn_key = _segment.derive_key_cached(_fused_ops.batch_norm)
+_fused_bn_res_key = _segment.derive_key_cached(_bn_residual)
+
+
+def flash_attention(query, key, value, causal=False, scale=None,
+                    block_q=None, block_k=None):
+    """Blockwise (flash) attention over (batch*heads, T, head_dim) —
+    the ops.pallas_attention kernel registered as a first-class op:
+    dispatch record + AMP class, so opperf, AMP lists and inspect
+    reports see it like any other op."""
+    from ..ops.pallas_attention import flash_attention as _fa
+    kw = dict(causal=causal, scale=scale, block_q=block_q,
+              block_k=block_k)
+    info = get_op("npx.flash_attention")
+    return invoke(functools.partial(_fa, **kw),
+                  (_as_nd(query), _as_nd(key), _as_nd(value)),
+                  name="flash_attention", op=info,
+                  key=record_key(_flash_key, kw))
+
+
+def _register_flash_attention():
+    from ..ops.pallas_attention import flash_attention as _fa
+    _fa._amp_class = "safe"   # MXU-bound flops: run in the autocast dtype
+    register_op("npx.flash_attention", _fa, amp="safe")
+    return _segment.derive_key_cached(_fa)
+
+
+_flash_key = _register_flash_attention()
+
+# layout-sensitive kernels get dispatch records too (PR 8): the npx
+# wrappers below stamp each call's layout onto the record (note_layout),
+# making the NHWC/NCHW choice introspectable next to the AMP class.
+for _kn in ("conv", "conv_transpose", "pooling"):
+    _k = getattr(_nn, _kn)
+    register_op("npx." + {"conv": "convolution",
+                          "conv_transpose": "deconvolution",
+                          "pooling": "pooling"}[_kn], _k,
+                amp=getattr(_k, "_amp_class", "neutral"))
+del _kn, _k
+
+__all__ += ["fused_bias_act", "fused_norm_act_residual",
+            "fused_bn_inference", "fused_avg_pool2d", "fused_batch_norm",
+            "flash_attention"]
+
+
 def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, **kwargs):
     arrs = (_as_nd(data),)
     kw = dict(act_type=act_type, slope=slope, **kwargs)
@@ -252,6 +400,7 @@ def convolution(data, weight, bias=None, kernel=None, stride=1, dilate=1,
                 layout="NCHW"):
     arrs = (_as_nd(data), _as_nd(weight)) + (() if no_bias or bias is None
                                              else (_as_nd(bias),))
+    note_layout(get_op("npx.convolution"), layout)
     return invoke(functools.partial(_nn.conv, stride=stride, padding=pad,
                                     dilation=dilate, groups=num_group,
                                     layout=layout),
@@ -262,6 +411,7 @@ def deconvolution(data, weight, bias=None, stride=1, dilate=1, pad=0, adj=0,
                   num_group=1, no_bias=False, layout="NCHW"):
     arrs = (_as_nd(data), _as_nd(weight)) + (() if no_bias or bias is None
                                              else (_as_nd(bias),))
+    note_layout(get_op("npx.deconvolution"), layout)
     return invoke(functools.partial(_nn.conv_transpose, stride=stride,
                                     padding=pad, dilation=dilate,
                                     output_padding=adj, groups=num_group,
@@ -274,6 +424,7 @@ def pooling(data, kernel=1, pool_type="max", stride=None, pad=0,
             ceil_mode=False, pooling_convention=None):
     if pooling_convention is not None:  # reference name: 'valid' | 'full'
         ceil_mode = pooling_convention == "full"
+    note_layout(get_op("npx.pooling"), layout)
     return invoke(functools.partial(_nn.pooling, kernel=kernel,
                                     pool_type=pool_type, stride=stride,
                                     padding=pad, global_pool=global_pool,

@@ -1,0 +1,503 @@
+"""mx.ops.fused — offender-driven fused op tier (Pallas + jnp fallback).
+
+Reference: MXNet's `MXNET_USE_FUSION` pointwise RTC fusion
+(src/operator/fusion/fused_op.cu) and the oneDNN/AMP graph passes fused
+exactly these chains on GPU/CPU. TPU-native: the `mx.inspect` roofline
+attribution (PR 7) ranks the compiled step's fusions by bytes moved, and
+this module hand-fuses the top memory-bound classes it found
+(benchmark/results/offenders_resnet18_r09.json — 86.7% of step bytes are
+0.18–0.62-intensity fusions):
+
+  op                      kills offender class              kernel
+  ----------------------  --------------------------------  ----------------
+  norm_act_residual       multiply_multiply_fusion (0.26    apply_scale_
+                          FLOP/B, 59 instances: BN apply +  shift_act
+                          relu + residual-add chains)
+  bias_act                convert/select pointwise chains   apply_scale_
+                          after dense/conv                  shift_act
+  bn_inference            folded BN-inference scale/shift   apply_scale_
+                          (+ optional act/residual)         shift_act
+  batch_norm              training BN: batch stats + ONE    apply_scale_
+                          fused apply pass                  shift_act
+  avg_pool2d              reduce-window (0.18 FLOP/B, 35    avg_pool2d_fwd /
+                          instances) — non-overlapping avg  avg_pool2d_bwd
+                          pool incl. GlobalAvgPool, with a  (VMEM-tiled
+                          broadcast backward                backward)
+
+Each op is a Pallas TPU kernel (ops/pallas_kernels.py) with a
+mathematically identical `jnp` composition fallback off-TPU — the
+`*_ref` functions here ARE the fallback, so CPU gradient parity is exact
+by construction and the kernels are interpret-mode tested against them.
+On the kernel path the backward is a hand-derived custom_vjp (one
+recompute of the pre-activation, then the analytic chain).
+
+Gating: the gluon rewrites (nn.Dense/_Conv/BatchNorm/_Pool, model-zoo
+residual blocks) engage only when `fusion_enabled()` — an explicit
+`fusion_scope(True)` / `set_fusion_default(True)` AND the
+`MXNET_USE_FUSION` env knob (default on). `FusedTrainStep` /
+`FusedInferStep` enter the scope automatically, so the flagship fused
+step gets the kernel tier by default while eager paths stay unchanged
+unless opted in. `MXNET_FUSION_INTERPRET=1` runs the Pallas kernels in
+interpret mode everywhere (CI exercises the kernel path on CPU).
+
+Counters: `profiler.fused_stats()` / telemetry `fused.*` —
+`pallas_calls` (kernel-path dispatches) vs `fallback_calls` (jnp
+composition). Inside a jitted step these count per TRACE (path choices
+baked into the program), eagerly they count per call.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from contextlib import contextmanager
+
+import numpy as _np
+
+from ..base import get_env
+from ..telemetry.registry import stats_group as _stats_group
+from . import pallas_kernels as _pk
+
+__all__ = ["bias_act", "norm_act_residual", "bn_inference", "batch_norm",
+           "avg_pool2d", "bias_act_ref", "norm_act_residual_ref",
+           "bn_inference_ref", "avg_pool2d_ref", "fusion_scope",
+           "fusion_enabled", "set_fusion_default", "set_use_fusion",
+           "set_interpret", "fused_stats", "FUSED_STATS", "FUSABLE_ACTS"]
+
+FUSABLE_ACTS = _pk.ACTS
+
+FUSED_STATS = _stats_group("fused", {
+    "pallas_calls": 0,       # dispatches that took a Pallas kernel path
+    "fallback_calls": 0,     # dispatches served by the jnp composition
+})
+_STATS = FUSED_STATS
+
+
+def fused_stats(reset=False):
+    """Snapshot of the fused-tier path counters (see module docstring for
+    the trace-time caveat). Also via profiler.fused_stats()."""
+    return _STATS.snapshot(reset=reset)
+
+
+# ---------------------------------------------------------------------------
+# gating: scope/default AND the MXNET_USE_FUSION env knob
+# ---------------------------------------------------------------------------
+_SCOPE = threading.local()
+_DEFAULT = [False]
+_ENV_FUSION = [None]       # None = re-read MXNET_USE_FUSION
+_INTERPRET = [None]        # None = re-read MXNET_FUSION_INTERPRET
+
+
+def _env_use_fusion():
+    if _ENV_FUSION[0] is None:
+        _ENV_FUSION[0] = bool(get_env("MXNET_USE_FUSION", True, bool))
+    return _ENV_FUSION[0]
+
+
+def set_use_fusion(flag):
+    """Override the MXNET_USE_FUSION kill switch at runtime (None =
+    re-read the env). Returns the previous effective setting."""
+    prev = _env_use_fusion()
+    _ENV_FUSION[0] = None if flag is None else bool(flag)
+    return prev
+
+
+@contextmanager
+def fusion_scope(active=True):
+    """Enable (or force-disable) the fused-op rewrites for the dynamic
+    extent — the hook FusedTrainStep/FusedInferStep use around tracing."""
+    prev = getattr(_SCOPE, "value", None)
+    _SCOPE.value = bool(active)
+    try:
+        yield
+    finally:
+        _SCOPE.value = prev
+
+
+def set_fusion_default(flag):
+    """Process-wide default outside any fusion_scope (eager opt-in).
+    Returns the previous default."""
+    prev = _DEFAULT[0]
+    _DEFAULT[0] = bool(flag)
+    return prev
+
+
+def fusion_enabled():
+    """True when gluon blocks should route through the fused ops: an
+    active scope (or the process default) AND MXNET_USE_FUSION."""
+    v = getattr(_SCOPE, "value", None)
+    if v is None:
+        v = _DEFAULT[0]
+    return bool(v) and _env_use_fusion()
+
+
+def set_interpret(flag):
+    """Run the Pallas kernels in interpret mode (tests/CI; env:
+    MXNET_FUSION_INTERPRET). None = re-read the env. Returns previous."""
+    prev = _interpret()
+    _INTERPRET[0] = None if flag is None else bool(flag)
+    return prev
+
+
+def _interpret():
+    if _INTERPRET[0] is None:
+        _INTERPRET[0] = bool(get_env("MXNET_FUSION_INTERPRET", False, bool))
+    return _INTERPRET[0]
+
+
+def _on_tpu():
+    # actual TPU platforms only ('tpu'/'axon'): a CUDA/ROCm accelerator
+    # must use the jnp fallback, not the TPU-shaped Pallas kernels
+    from ..device import tpu_platform_available
+    return tpu_platform_available()
+
+
+# ---------------------------------------------------------------------------
+# reference compositions — the off-TPU fallback AND the parity oracle
+# ---------------------------------------------------------------------------
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _act32(u, act_type):
+    import jax
+    return _pk._act_f32(jax, _jnp(), u, act_type)
+
+
+def _bshape(ndim, axis, c):
+    shape = [1] * ndim
+    shape[axis] = c
+    return tuple(shape)
+
+
+def _ref_apply(x, scale, shift, residual, act_type, axis):
+    """act(x [*scale] + shift [+ residual]) — f32 internal, cast out."""
+    jnp = _jnp()
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    bshape = _bshape(x.ndim, axis, c)
+    u = x.astype(jnp.float32)
+    if scale is not None:
+        u = u * scale.reshape(bshape).astype(jnp.float32)
+    u = u + shift.reshape(bshape).astype(jnp.float32)
+    if residual is not None:
+        u = u + residual.astype(jnp.float32)
+    return _act32(u, act_type).astype(x.dtype)
+
+
+def bias_act_ref(x, bias, act_type="relu", axis=-1):
+    """Unfused composition of bias_act (the fallback and parity oracle)."""
+    return _ref_apply(x, None, bias, None, act_type, axis)
+
+
+def norm_act_residual_ref(x, scale, shift, residual, act_type="relu",
+                          axis=-1):
+    """Unfused composition of norm_act_residual."""
+    return _ref_apply(x, scale, shift, residual, act_type, axis)
+
+
+def _fold_bn(gamma, beta, mean, var, eps):
+    """(scale, shift) f32 fold of the BN affine: scale = gamma*rsqrt(var
+    + eps), shift = beta - mean*scale (gamma/beta optional)."""
+    import jax
+    jnp = _jnp()
+    inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+    scale = inv if gamma is None else gamma.astype(jnp.float32) * inv
+    shift = -mean.astype(jnp.float32) * scale
+    if beta is not None:
+        shift = shift + beta.astype(jnp.float32)
+    return scale, shift
+
+
+def bn_inference_ref(x, gamma, beta, mean, var, eps=1e-5, axis=-1,
+                     act_type=None, residual=None):
+    """Unfused composition of bn_inference."""
+    scale, shift = _fold_bn(gamma, beta, mean, var, eps)
+    return _ref_apply(x, scale, shift, residual, act_type, axis)
+
+
+def avg_pool2d_ref(x, pool_size, layout="NHWC"):
+    """Unfused composition of the non-overlapping NHWC average pool
+    (f32-accumulated reshape+mean)."""
+    jnp = _jnp()
+    ph, pw = pool_size
+    n, h, w, c = x.shape
+    xf = x.astype(jnp.float32).reshape(n, h // ph, ph, w // pw, pw, c)
+    return jnp.mean(xf, axis=(2, 4)).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp kernels over the (M, C) view — one builder per arity, memoized
+# per static config so repeat traces reuse one callable identity
+# ---------------------------------------------------------------------------
+def _bwd_core(xf, scale32, g32):
+    """Shared backward tail: (dx_f32, dscale_f32, dshift_f32) given the
+    f32 input, f32 scale (or None) and the post-activation cotangent."""
+    jnp = _jnp()
+    dx = g32 if scale32 is None else g32 * scale32
+    dscale = None if scale32 is None else jnp.sum(g32 * xf, axis=0)
+    dshift = jnp.sum(g32, axis=0)
+    return dx, dscale, dshift
+
+
+def _act_grad(u, ct, act_type):
+    """d(act)/du applied to ct, both f32, via jax.vjp of the f32 act —
+    exactly the derivative jax AD of the reference composition uses."""
+    import jax
+    if act_type is None:
+        return ct
+    _, vjp = jax.vjp(lambda v: _act32(v, act_type), u)
+    return vjp(ct)[0]
+
+
+@functools.lru_cache(maxsize=None)
+def _kernel_bias_act(act_type, interpret):
+    import jax
+    jnp = _jnp()
+
+    @jax.custom_vjp
+    def f(x2d, shift):
+        out = _pk.apply_scale_shift_act(x2d, None, shift, None, act_type,
+                                        interpret)
+        if out is None:       # static-shape tiling miss: same math in jnp
+            out = _ref_apply(x2d, None, shift, None, act_type, -1)
+        return out
+
+    def f_fwd(x2d, shift):
+        return f(x2d, shift), (x2d, shift)
+
+    def f_bwd(saved, ct):
+        x2d, shift = saved
+        xf = x2d.astype(jnp.float32)
+        u = xf + shift.reshape(1, -1).astype(jnp.float32)
+        g = _act_grad(u, ct.astype(jnp.float32), act_type)
+        dx, _, dshift = _bwd_core(xf, None, g)
+        return dx.astype(x2d.dtype), dshift.astype(shift.dtype)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _kernel_scale_shift_act(act_type, interpret):
+    import jax
+    jnp = _jnp()
+
+    @jax.custom_vjp
+    def f(x2d, scale, shift):
+        out = _pk.apply_scale_shift_act(x2d, scale, shift, None, act_type,
+                                        interpret)
+        if out is None:
+            out = _ref_apply(x2d, scale, shift, None, act_type, -1)
+        return out
+
+    def f_fwd(x2d, scale, shift):
+        return f(x2d, scale, shift), (x2d, scale, shift)
+
+    def f_bwd(saved, ct):
+        x2d, scale, shift = saved
+        xf = x2d.astype(jnp.float32)
+        s32 = scale.reshape(1, -1).astype(jnp.float32)
+        u = xf * s32 + shift.reshape(1, -1).astype(jnp.float32)
+        g = _act_grad(u, ct.astype(jnp.float32), act_type)
+        dx, dscale, dshift = _bwd_core(xf, s32, g)
+        return (dx.astype(x2d.dtype), dscale.astype(scale.dtype),
+                dshift.astype(shift.dtype))
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _kernel_scale_shift_act_residual(act_type, interpret):
+    import jax
+    jnp = _jnp()
+
+    @jax.custom_vjp
+    def f(x2d, scale, shift, res):
+        out = _pk.apply_scale_shift_act(x2d, scale, shift, res, act_type,
+                                        interpret)
+        if out is None:
+            out = _ref_apply(x2d, scale, shift, res, act_type, -1)
+        return out
+
+    def f_fwd(x2d, scale, shift, res):
+        return f(x2d, scale, shift, res), (x2d, scale, shift, res)
+
+    def f_bwd(saved, ct):
+        x2d, scale, shift, res = saved
+        xf = x2d.astype(jnp.float32)
+        s32 = scale.reshape(1, -1).astype(jnp.float32)
+        u = (xf * s32 + shift.reshape(1, -1).astype(jnp.float32)
+             + res.astype(jnp.float32))
+        g = _act_grad(u, ct.astype(jnp.float32), act_type)
+        dx, dscale, dshift = _bwd_core(xf, s32, g)
+        return (dx.astype(x2d.dtype), dscale.astype(scale.dtype),
+                dshift.astype(shift.dtype), g.astype(res.dtype))
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def _apply(x, scale, shift, residual, act_type, axis, interpret):
+    """Route one apply through the Pallas kernel when viable (TPU or
+    interpret mode, channels minor, VMEM-tileable, supported act), else
+    the identical jnp composition. The decision is static per trace."""
+    if act_type is not None and not _pk.supported_act(act_type):
+        raise ValueError(f"unsupported fused activation {act_type!r}; "
+                         f"supported: {FUSABLE_ACTS}")
+    interpret = _interpret() if interpret is None else interpret
+    axis_n = axis % x.ndim
+    kernel_ok = (_on_tpu() or interpret) and axis_n == x.ndim - 1
+    if kernel_ok:
+        c = x.shape[-1]
+        m = 1
+        for d in x.shape[:-1]:
+            m *= d
+        n_bufs = 2 + (1 if residual is not None else 0)
+        bm = _pk._block_rows(m, c, n_bufs)
+        kernel_ok = bm > 0 and m % bm == 0
+    if not kernel_ok:
+        _STATS["fallback_calls"] += 1
+        return _ref_apply(x, scale, shift, residual, act_type, axis)
+    _STATS["pallas_calls"] += 1
+    c = x.shape[-1]
+    x2d = x.reshape(-1, c)
+    if scale is None:
+        out = _kernel_bias_act(act_type, interpret)(x2d, shift)
+    elif residual is None:
+        out = _kernel_scale_shift_act(act_type, interpret)(x2d, scale,
+                                                           shift)
+    else:
+        out = _kernel_scale_shift_act_residual(act_type, interpret)(
+            x2d, scale, shift, residual.reshape(-1, c))
+    return out.reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# public fused ops (raw jax arrays in/out; npx wrappers own NDArray glue)
+# ---------------------------------------------------------------------------
+def bias_act(x, bias, act_type="relu", axis=-1, interpret=None):
+    """Fused y = act(x + bias) with per-channel bias on `axis`."""
+    return _apply(x, None, bias, None, act_type, axis, interpret)
+
+
+def norm_act_residual(x, scale, shift, residual, act_type="relu", axis=-1,
+                      interpret=None):
+    """Fused y = act(x*scale + shift + residual) — the normalize-apply /
+    activation / residual-add tail of a residual block in ONE pass
+    (scale/shift are the folded norm affine; see `bn_inference` for the
+    fold). The 0.26-intensity `multiply_multiply_fusion` killer."""
+    return _apply(x, scale, shift, residual, act_type, axis, interpret)
+
+
+def bn_inference(x, gamma, beta, mean, var, eps=1e-5, axis=-1,
+                 act_type=None, residual=None, interpret=None):
+    """Folded BN-inference scale/shift (+ optional act/residual): the
+    running stats fold into ONE per-channel affine at trace time, then a
+    single fused apply pass."""
+    scale, shift = _fold_bn(gamma, beta, mean, var, eps)
+    return _apply(x, scale, shift, residual, act_type, axis, interpret)
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, momentum=0.9,
+               eps=1e-5, training=True, axis=1, use_global_stats=False,
+               sync_axis_name=None, act_type=None, residual=None,
+               interpret=None):
+    """Batch norm with the apply stage routed through the fused kernel.
+
+    Identical stats protocol to ops.nn.batch_norm (same f32 moments, same
+    pmean sync, same running-stat update; returns (out, new_rm, new_rv))
+    but the normalize/scale/shift(/act/residual) applies as ONE fused
+    pass instead of the chain XLA splits into memory-bound fusions.
+    Gradients flow through the batch moments exactly as in the unfused
+    composition — scale/shift are traced functions of x, and the apply's
+    custom_vjp chains through them."""
+    import jax
+    jnp = _jnp()
+    lax = jax.lax
+    reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    if training and not use_global_stats:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=reduce_axes)
+        mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
+        if sync_axis_name is not None:
+            mean = lax.pmean(mean, sync_axis_name)
+            mean_sq = lax.pmean(mean_sq, sync_axis_name)
+        var = mean_sq - jnp.square(mean)
+        new_rm = momentum * running_mean + (1 - momentum) * mean
+        new_rv = momentum * running_var + (1 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+    scale, shift = _fold_bn(gamma, beta, mean, var, eps)
+    out = _apply(x, scale, shift, residual, act_type, axis, interpret)
+    return out, new_rm, new_rv
+
+
+# bounded: the key includes the pooled SHAPE, and each entry pins a
+# custom_vjp callable whose identity also keys jax's compiled-program
+# caches — unbounded growth under variable-resolution workloads (same
+# rationale as the telemetry model_flops FIFO bound)
+@functools.lru_cache(maxsize=64)
+def _kernel_avg_pool(h, w, ph, pw, dtype, interpret):
+    import jax
+    jnp = _jnp()
+
+    @jax.custom_vjp
+    def f(x):
+        out = _pk.avg_pool2d_fwd(x, ph, pw, interpret)
+        if out is None:
+            out = avg_pool2d_ref(x, (ph, pw))
+        return out
+
+    def f_fwd(x):
+        return f(x), ()
+
+    def f_bwd(_res, dy):
+        dx = _pk.avg_pool2d_bwd(dy, h, w, ph, pw, interpret)
+        if dx is None:   # same math: broadcast the mean gradient
+            n, ho, wo, c = dy.shape
+            g = dy.astype(jnp.float32) * (1.0 / (ph * pw))
+            g = jnp.broadcast_to(g[:, :, None, :, None, :],
+                                 (n, ho, ph, wo, pw, c))
+            dx = g.reshape(n, h, w, c)
+        return (dx.astype(dtype),)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def avg_pool2d(x, pool_size, layout="NHWC", interpret=None):
+    """Non-overlapping (kernel == stride, no padding) NHWC average pool
+    with a VMEM-tiled Pallas backward — covers AvgPool2D(k, k) and the
+    GlobalAvgPool2D shape (pool_size = spatial dims, keepdims output).
+    Falls back to the f32 reshape+mean composition off-TPU (whose XLA
+    gradient is already a broadcast, not a reduce-window scatter)."""
+    ph, pw = (pool_size, pool_size) if isinstance(pool_size, int) \
+        else tuple(pool_size)
+    if layout != "NHWC" or x.ndim != 4:
+        raise ValueError("fused avg_pool2d is NHWC 2-D only "
+                         f"(got layout={layout!r}, ndim={x.ndim})")
+    n, h, w, c = x.shape
+    if h % ph or w % pw:
+        raise ValueError(f"pool {ph}x{pw} must divide spatial dims "
+                         f"{h}x{w} (non-overlapping pooling)")
+    interpret = _interpret() if interpret is None else interpret
+    if not (_on_tpu() or interpret) \
+            or _pk._pool_blocks(n, h, w, c, ph, pw) is None:
+        _STATS["fallback_calls"] += 1
+        return avg_pool2d_ref(x, (ph, pw))
+    _STATS["pallas_calls"] += 1
+    return _kernel_avg_pool(h, w, ph, pw, str(x.dtype), interpret)(x)
+
+
+# Dispatch-record AMP classes (PR2 metadata; picked up by register_op in
+# numpy_extension): the apply ops compute in f32 internally and are safe
+# to FEED in the autocast dtype — except the stats-bearing batch_norm
+# family, pinned f32 like ops.nn.batch_norm. Pooling matches nn.pooling.
+for _f, _cls in ((bias_act, "safe"), (norm_act_residual, "unsafe"),
+                 (bn_inference, "unsafe"), (batch_norm, "unsafe"),
+                 (avg_pool2d, "safe")):
+    _f._amp_class = _cls
+del _f, _cls

@@ -462,7 +462,8 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     if scale is None:
         scale = 1.0 / math.sqrt(d)
 
-    on_tpu = any(dev.platform != "cpu" for dev in jax.devices())
+    from ..device import tpu_platform_available
+    on_tpu = tpu_platform_available()
     if not (on_tpu or interpret):
         return _blockwise(q, k, v, scale, causal,
                           block_k if block_k else 512)

@@ -1,0 +1,214 @@
+"""Pallas TPU kernels for the fused-op tier (ops/fused.py).
+
+Reference contrast: MXNet's `USE_FUSION` RTC machinery generated pointwise
+CUDA kernels at runtime (src/operator/fusion/fused_op.cu); here the worst
+memory-bound offender classes the `mx.inspect` roofline attribution ranks
+(benchmark/results/offenders_resnet18_r09.json) get hand-written TPU
+kernels instead:
+
+  * `apply_scale_shift_act` — ONE pass of `act(x*scale + shift [+ res])`
+    over a (rows, channels) view: the normalize-scale-shift(-residual-relu)
+    chains XLA splits into several 0.26-intensity `multiply_multiply`
+    fusions become a single VMEM-resident sweep (one read of x/residual,
+    one write of out — the roofline floor for this op class).
+  * `avg_pool2d_fwd` / `avg_pool2d_bwd` — non-overlapping average pooling
+    (kernel == stride, no padding; the GlobalAvgPool shape included) with a
+    VMEM-tiled backward: the gradient is an in-register broadcast of the
+    upstream tile instead of XLA's generic reduce-window gradient scatter
+    (the 0.18-intensity `reduce-window` offender class).
+
+Everything here takes and returns raw jax arrays and is shape-strict: the
+caller (ops/fused.py) owns fallback policy, custom_vjp wiring and layout
+handling. Kernels compute in float32 internally and cast to the input
+dtype on the way out, matching ops/nn.py norm semantics under AMP.
+
+Layout: channels-minor (the TPU-preferred NHWC family) — `x` is reshaped
+by the caller to (M, C) for the apply kernel and kept (N, H, W, C) for
+pooling. Tile sizes come from a VMEM budget (see `_block_rows`).
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["apply_scale_shift_act", "avg_pool2d_fwd", "avg_pool2d_bwd",
+           "supported_act", "ACTS"]
+
+# activation set the kernels (and their hand-derived VJPs) support; None
+# means identity. Kept in sync with ops/fused.py's dispatch tables.
+ACTS = (None, "relu", "sigmoid", "tanh", "silu", "gelu")
+
+_VMEM_BUDGET = 4 * 1024 * 1024   # bytes of f32 working set per program
+
+
+def supported_act(act_type):
+    return act_type in ACTS
+
+
+def _act_f32(jax, jnp, u, act_type):
+    if act_type is None:
+        return u
+    if act_type == "relu":
+        return jax.nn.relu(u)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(u)
+    if act_type == "tanh":
+        return jnp.tanh(u)
+    if act_type == "silu":
+        return jax.nn.silu(u)
+    if act_type == "gelu":
+        return jax.nn.gelu(u, approximate=False)
+    raise ValueError(f"unsupported fused activation {act_type!r}")
+
+
+def _block_rows(m, c, n_row_bufs, cap=1024):
+    """Largest power-of-two row tile that divides `m` and keeps
+    `n_row_bufs` (M, C)-shaped f32 buffers inside the VMEM budget.
+    Returns 0 when even a single row of C floats cannot fit."""
+    if c * 4 * n_row_bufs > _VMEM_BUDGET:
+        return 0
+    bm = min(m & -m, cap)                     # largest 2^k dividing m
+    while bm > 1 and bm * c * 4 * n_row_bufs > _VMEM_BUDGET:
+        bm //= 2
+    if bm * c * 4 * n_row_bufs > _VMEM_BUDGET:
+        return 0
+    return bm
+
+
+# ---------------------------------------------------------------------------
+# fused scale/shift/activation/residual apply over (M, C)
+# ---------------------------------------------------------------------------
+def _apply_kernel(*refs, act_type, has_scale, has_residual):
+    """out = act(x [*scale] + shift [+ residual]) on one (bm, C) tile.
+    scale/shift are (1, C) rows broadcast down the tile."""
+    import jax
+    import jax.numpy as jnp
+
+    it = iter(refs)
+    x_ref = next(it)
+    scale_ref = next(it) if has_scale else None
+    shift_ref = next(it)
+    res_ref = next(it) if has_residual else None
+    o_ref = next(it)
+
+    u = x_ref[...].astype(jnp.float32)
+    if has_scale:
+        u = u * scale_ref[...].astype(jnp.float32)
+    u = u + shift_ref[...].astype(jnp.float32)
+    if has_residual:
+        u = u + res_ref[...].astype(jnp.float32)
+    o_ref[...] = _act_f32(jax, jnp, u, act_type).astype(o_ref.dtype)
+
+
+def apply_scale_shift_act(x2d, scale, shift, residual, act_type,
+                          interpret=False):
+    """Pallas apply pass. x2d/residual: (M, C); scale (optional): (C,);
+    shift: (C,). Returns act(x*scale + shift + residual) in x2d.dtype, or
+    None when the shape does not tile (caller falls back)."""
+    import jax
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+
+    m, c = x2d.shape
+    n_bufs = 2 + (1 if residual is not None else 0)
+    bm = _block_rows(m, c, n_bufs)
+    if bm == 0 or m % bm:
+        return None
+    grid = (m // bm,)
+    row_spec = pl.BlockSpec((bm, c), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((1, c), lambda i: (0, 0))
+    in_specs = [row_spec]
+    args = [x2d]
+    if scale is not None:
+        in_specs.append(vec_spec)
+        args.append(scale.reshape(1, c))
+    in_specs.append(vec_spec)
+    args.append(shift.reshape(1, c))
+    if residual is not None:
+        in_specs.append(row_spec)
+        args.append(residual)
+    kernel = functools.partial(_apply_kernel, act_type=act_type,
+                               has_scale=scale is not None,
+                               has_residual=residual is not None)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct((m, c), x2d.dtype),
+        interpret=interpret,
+    )(*args)
+
+
+# ---------------------------------------------------------------------------
+# non-overlapping average pooling, NHWC
+# ---------------------------------------------------------------------------
+def _pool_fwd_kernel(x_ref, o_ref, *, ph, pw):
+    import jax.numpy as jnp
+    x = x_ref[0].astype(jnp.float32)          # (bh*ph, W, C)
+    hh, w, c = x.shape
+    x = x.reshape(hh // ph, ph, w // pw, pw, c)
+    o_ref[0] = jnp.mean(x, axis=(1, 3)).astype(o_ref.dtype)
+
+
+def _pool_bwd_kernel(dy_ref, dx_ref, *, ph, pw):
+    """dX tile = upstream tile broadcast over each window / (ph*pw):
+    the entire reduce-window gradient becomes an in-VMEM broadcast."""
+    import jax.numpy as jnp
+    dy = dy_ref[0].astype(jnp.float32)        # (bh, Wo, C)
+    bh, wo, c = dy.shape
+    g = dy * (1.0 / (ph * pw))
+    g = jnp.broadcast_to(g[:, None, :, None, :], (bh, ph, wo, pw, c))
+    dx_ref[0] = g.reshape(bh * ph, wo * pw, c).astype(dx_ref.dtype)
+
+
+def _pool_blocks(n, h, w, c, ph, pw):
+    """(grid, bh) row tiling for the pooling kernels, or None."""
+    if h % ph or w % pw:
+        return None
+    ho = h // ph
+    # in + out tiles: (bh*ph, W, C) + (bh, W/pw, C) floats
+    bm = _block_rows(ho, w * c * ph + (w // pw) * c, 1)
+    if bm == 0 or ho % bm:
+        return None
+    return (n, ho // bm), bm
+
+
+def avg_pool2d_fwd(x, ph, pw, interpret=False):
+    """Forward non-overlapping NHWC average pool, or None (no tiling)."""
+    import jax
+    import jax.experimental.pallas as pl
+
+    n, h, w, c = x.shape
+    blocks = _pool_blocks(n, h, w, c, ph, pw)
+    if blocks is None:
+        return None
+    grid, bh = blocks
+    return pl.pallas_call(
+        functools.partial(_pool_fwd_kernel, ph=ph, pw=pw),
+        grid=grid,
+        in_specs=[pl.BlockSpec((1, bh * ph, w, c), lambda b, i: (b, i, 0, 0))],
+        out_specs=pl.BlockSpec((1, bh, w // pw, c), lambda b, i: (b, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h // ph, w // pw, c), x.dtype),
+        interpret=interpret,
+    )(x)
+
+
+def avg_pool2d_bwd(dy, h, w, ph, pw, interpret=False):
+    """VMEM-tiled backward of the non-overlapping NHWC average pool:
+    dX (N, h, w, C) from dY (N, h/ph, w/pw, C), or None (no tiling)."""
+    import jax
+    import jax.experimental.pallas as pl
+
+    n, ho, wo, c = dy.shape
+    blocks = _pool_blocks(n, h, w, c, ph, pw)
+    if blocks is None:
+        return None
+    grid, bh = blocks
+    return pl.pallas_call(
+        functools.partial(_pool_bwd_kernel, ph=ph, pw=pw),
+        grid=grid,
+        in_specs=[pl.BlockSpec((1, bh, wo, c), lambda b, i: (b, i, 0, 0))],
+        out_specs=pl.BlockSpec((1, bh * ph, w, c), lambda b, i: (b, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h, w, c), dy.dtype),
+        interpret=interpret,
+    )(dy)

@@ -104,15 +104,23 @@ class OpInfo:
     `key` is only precomputed for callables whose key cannot drift
     (closures/bound methods may rebind cells, so freezing their key at
     registration would serve stale kernels — they derive per call instead,
-    same as the derive_key_cached memo policy)."""
+    same as the derive_key_cached memo policy).
 
-    __slots__ = ("name", "fn", "amp", "doc", "key")
+    `layout` records the data layout of layout-sensitive ops (conv/pool/
+    fused kernels): the last layout the op dispatched with ("NHWC"/"NCHW"
+    ...), written by the npx wrappers via `note_layout`. Introspection for
+    the layout-autotune lever (ROADMAP item 2): `get_op(name).layout`
+    shows which layout a model actually ran, and the bench `fused_sweep`
+    phase records its NHWC/NCHW A-B winner next to it."""
+
+    __slots__ = ("name", "fn", "amp", "doc", "key", "layout")
 
     def __init__(self, name, fn, amp="neutral", doc=""):
         self.name = name
         self.fn = fn
         self.amp = amp
         self.doc = doc
+        self.layout = None
         drift_free = not (
             (isinstance(fn, _types.FunctionType) and fn.__closure__)
             or isinstance(fn, _types.MethodType))
@@ -127,6 +135,14 @@ def register_op(name, fn=None, amp="neutral", doc=""):
     if fn is not None:
         return _reg(fn)
     return _reg
+
+
+def note_layout(op, layout):
+    """Record the layout a layout-sensitive op dispatched with on its
+    dispatch record (a single benign attribute write — last writer wins;
+    the record is introspection, not dispatch state)."""
+    if op is not None and layout is not None:
+        op.layout = layout
 
 
 def get_op(name):

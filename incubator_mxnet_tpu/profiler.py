@@ -167,6 +167,18 @@ def feed_stats(reset=False):
     return _fs(reset=reset)
 
 
+def fused_stats(reset=False):
+    """Counters from the fused kernel tier (ops/fused.py): dispatches
+    that took a Pallas kernel path (`pallas_calls`) vs the jnp
+    composition fallback (`fallback_calls` — off-TPU, unsupported layout
+    or an untileable shape). Inside a jitted step these count per TRACE
+    (the path choice is baked into the program); eagerly they count per
+    call. Always on, like dispatch_stats(); `reset=True` zeroes after
+    the snapshot. See docs/PERF.md "Kernel tier"."""
+    from .ops.fused import fused_stats as _fus
+    return _fus(reset=reset)
+
+
 def dumps(reset=False, format="table"):
     """Aggregate stats table (≙ profiler.dumps / aggregate_stats.cc).
 

@@ -1,0 +1,95 @@
+"""Fixture: Pallas TPU kernel bodies inside a registered (jit-reachable)
+op must be trace-safety CLEAN — `pl.program_id` reads, `@pl.when`-nested
+scratch-ref initializers (`ref[:] = ...` through the enclosing kernel's
+parameters), accumulator stores and `.astype` casts are device-side
+Pallas idioms, not host captures or frozen closure state. The module
+also seeds genuinely-bad patterns in the same kernel nest to prove the
+carve-out stays narrow, plus one justified suppression.
+
+NOT imported by tests — parsed by the analyzer only (like bad_trace.py).
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import jax.experimental.pallas as pl
+
+from incubator_mxnet_tpu.ops.registry import register_op
+
+
+def fused_apply(x, scale):
+    """A registered op building its Pallas kernel the way ops/fused.py /
+    ops/pallas_attention.py do: kernel + @pl.when init nested inside the
+    jit-reachable builder."""
+
+    def kernel(x_ref, scale_ref, o_ref, acc_ref):
+        i = pl.program_id(0)                      # device-side, clean
+
+        @pl.when(i == 0)
+        def _init():
+            # scratch-ref store through the ENCLOSING KERNEL'S PARAMETER:
+            # the Pallas idiom the carve-out exists for — must NOT fire
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        acc_ref[:] += x_ref[...].astype(jnp.float32)   # clean accumulate
+        o_ref[...] = (acc_ref[:] * scale_ref[...]).astype(o_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(x.shape[0] // 8,),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x, scale)
+
+
+register_op("lintfix.fused_apply", fused_apply)
+
+
+_HOST_SIDE_ACC = []
+
+
+def bad_kernel_host_state(x):
+    """Negative controls: the carve-out must not swallow real hazards in
+    the same nesting shape."""
+
+    def kernel(x_ref, o_ref):
+        # mutator METHOD call on module state: still trace-closure-mutation
+        _HOST_SIDE_ACC.append(1)
+        # env read inside a kernel: still trace-impure-host
+        if os.environ.get("MXNET_LINTFIX_FAKE"):
+            o_ref[...] = x_ref[...] * 2.0
+        captured = []
+
+        def inner():
+            # store into an enclosing LOCAL (not a parameter): still fires
+            captured[0] = 1.0
+            # suppressed with justification: reported nowhere
+            host = x_ref[...].tolist()  # mxlint: disable=trace-host-capture -- fixture: justified-suppression demo
+            return host
+
+        inner()
+        o_ref[...] = x_ref[...]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(1,),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+register_op("lintfix.bad_kernel", bad_kernel_host_state)
+
+
+def bad_plain_closure_param(history):
+    """Subscript store through an enclosing function's PARAMETER with no
+    `pallas_call` anywhere in the nest: the classic trace-frozen mutation
+    (runs once at trace time, then state silently stops updating) — the
+    carve-out must NOT apply outside real Pallas kernel builds."""
+
+    def step(x):
+        history[0] = 1.0
+        return x
+
+    return jax.jit(step)(history)
+
+
+register_op("lintfix.bad_plain_closure", bad_plain_closure_param)

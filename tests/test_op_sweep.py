@@ -395,6 +395,32 @@ spec("npx.scaled_dot_product_attention",
      ref=_np_sdpa, grad=True, rtol=1e-4, atol=1e-4)
 spec("npx.stop_gradient", lambda: [F()], ref=lambda x: x)
 
+# ---- fused kernel tier (PR 8; ops/fused.py — off-TPU these ARE the jnp
+# compositions, so the sweep checks the registered surface + gradients;
+# the Pallas kernel path is interpret-mode swept in test_fused_ops.py)
+spec("npx.fused_bias_act", lambda: [F((3, 8)), F((8,))],
+     kw={"act_type": "relu"},
+     ref=lambda x, b, act_type: np.maximum(x + b, 0.0), grad=True)
+spec("npx.fused_norm_act_residual",
+     lambda: [F((3, 8)), POS((8,)), F((8,)), F((3, 8))],
+     kw={"act_type": "relu"},
+     ref=lambda x, s, b, r, act_type: np.maximum(x * s + b + r, 0.0),
+     grad=True, rtol=1e-4)
+
+
+def _np_bn_inference(x, g, bta, m, v):
+    scale = g / np.sqrt(v + 1e-5)
+    return x * scale + (bta - m * scale)
+
+
+# inputs conditioned so no output element sits near 0 (a zero-output
+# element makes the f32 finite-difference check all-noise: FD reads 0
+# where the analytic dL/dx = 2*out*scale is merely tiny)
+spec("npx.fused_bn_inference",
+     lambda: [POS((3, 8), 1.0, 2.0), POS((8,)), POS((8,), 1.0, 3.0),
+              F((8,), -0.3, 0.3), POS((8,))],
+     ref=_np_bn_inference, grad=True, rtol=1e-4, atol=1e-4)
+
 # ---------------------------------------------------------------------------
 # Exemptions: ops whose semantics are covered elsewhere or are not
 # numeric-comparable. Every entry carries its reason.
@@ -417,6 +443,21 @@ EXEMPT = {
     "npx.proposal": "covered in test_detection_ops.py (RPN)",
     "npx.psroi_pooling": "covered in test_detection_ops.py (R-FCN)",
     "npx.roi_align": "covered in test_detection_ops.py",
+    # PR 8 fused kernel tier: ops with tuple/stateful signatures the
+    # numeric sweep cannot express — parity-swept in test_fused_ops.py
+    "npx.fused_avg_pool2d": "pool_size-tuple op; fwd+VMEM-tiled-backward "
+                            "parity in test_fused_ops.py",
+    "npx.fused_batch_norm": "stats-writing multi-output; train+infer "
+                            "parity in test_fused_ops.py",
+    "npx.flash_attention": "covered in test_attention.py + "
+                           "test_fused_ops.py (registered wrapper)",
+    # layout-record dispatch registrations (note_layout surface); the
+    # kernels are covered functionally elsewhere
+    "npx.convolution": "covered in test_gluon.py / "
+                       "test_layout_equivalence.py",
+    "npx.deconvolution": "covered in test_gluon.py (Conv*DTranspose)",
+    "npx.pooling": "covered in test_gluon.py / "
+                   "test_layout_equivalence.py",
 }
 
 
@@ -518,6 +559,9 @@ GRAD_REQ_OPS = [
     "np.square", "np.negative", "np.reciprocal", "np.arctan",
     "np.logaddexp", "np.dot", "np.matmul",
     "npx.relu", "npx.sigmoid",
+    # PR 8: the fused kernel tier rides the same kWriteTo/kAddTo/kNullOp
+    # contract as any op
+    "npx.fused_bias_act", "npx.fused_norm_act_residual",
 ]
 
 

@@ -49,6 +49,12 @@ TREND_KEYS = {
     "est_step_mfu_ceiling": "higher",
     "offender_top1_share": "lower",
     "memory_bound_byte_share": "lower",
+    # fused_sweep phase (kernel tier, PR 8): the policy-sweep winner's
+    # throughput and MFU must not regress; the speedup over the unfused
+    # step is the tier's direct win
+    "fused_step_images_per_sec": "higher",
+    "fused_step_mfu": "higher",
+    "fused_step_speedup_vs_unfused": "higher",
     "per_dispatch_latency_us_sync": "lower",
     "per_dispatch_latency_us_chained": "lower",
     "serve_p99_ms_c32": "lower",
@@ -233,6 +239,24 @@ def self_test():
                        est_step_mfu_ceiling=0.60))
     check("improving offender keys pass with improvements reported",
           rep["status"] == "ok" and len(rep["improvements"]) == 3)
+    # fused_sweep keys: a falling winner throughput / MFU / speedup gates
+    fused_base = {"backend_ok": True, "fused_step_images_per_sec": 500.0,
+                  "fused_step_mfu": 0.30,
+                  "fused_step_speedup_vs_unfused": 1.5}
+    rep = compare(fused_base, dict(fused_base,
+                                   fused_step_images_per_sec=400.0,
+                                   fused_step_mfu=0.20,
+                                   fused_step_speedup_vs_unfused=1.1))
+    check(">10% drop in fused_step throughput/mfu/speedup is a regression",
+          rep["status"] == "regression"
+          and {r["key"] for r in rep["regressions"]}
+          == {"fused_step_images_per_sec", "fused_step_mfu",
+              "fused_step_speedup_vs_unfused"})
+    rep = compare(fused_base, dict(fused_base,
+                                   fused_step_images_per_sec=700.0,
+                                   fused_step_mfu=0.40))
+    check("improving fused_step keys pass with improvements reported",
+          rep["status"] == "ok" and len(rep["improvements"]) == 2)
     missing_only_new = {"backend_ok": True,
                         "io_pipeline_images_per_sec": 700.0}
     check("keys missing from one side are skipped, not regressions",

@@ -29,9 +29,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def build_step(model, batch_size, layout, mode, use_amp=True):
+def build_step(model, batch_size, layout, mode, use_amp=True,
+               use_fusion=None):
     """(step_obj, inputs, execute) for one model name. `execute` runs the
-    real program once (enables measured mode + wall timing)."""
+    real program once (enables measured mode + wall timing). `use_fusion`
+    routes the forward through the fused kernel tier (None = the fused
+    steps' MXNET_USE_FUSION default); `--no-fusion` turns it off — the
+    before/after offender pair is exactly this A/B."""
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import amp, gluon
     from incubator_mxnet_tpu import optimizer as opt_mod
@@ -60,14 +64,15 @@ def build_step(model, batch_size, layout, mode, use_amp=True):
     x = mx.np.array(np.random.uniform(-1, 1, shape).astype(np.float32))
     net(x)                                   # resolve deferred shapes
     if mode == "infer":
-        step = FusedInferStep(net)
+        step = FusedInferStep(net, use_fusion=use_fusion)
         step(x)                              # seed the chain
         return step, (), lambda: step()
     y = mx.np.array(np.random.randint(0, n_classes, (batch_size,)))
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     opt = opt_mod.create("sgd", learning_rate=0.05, momentum=0.9,
                          rescale_grad=1.0 / batch_size)
-    step = FusedTrainStep(net, lambda n, a, b: loss_fn(n(a), b).sum(), opt)
+    step = FusedTrainStep(net, lambda n, a, b: loss_fn(n(a), b).sum(), opt,
+                          use_fusion=use_fusion)
     return step, (x, y), lambda: step(x, y)
 
 
@@ -81,6 +86,10 @@ def main(argv=None):
     ap.add_argument("--layout", default="NHWC")
     ap.add_argument("--no-amp", action="store_true",
                     help="inspect the fp32 program instead of bf16 AMP")
+    ap.add_argument("--no-fusion", action="store_true",
+                    help="inspect the UNFUSED step (kernel tier off) — "
+                         "pair with the default for the before/after "
+                         "offender artifacts")
     ap.add_argument("--top-k", type=int, default=None,
                     help="offenders listed (default MXNET_INSPECT_TOP_K)")
     ap.add_argument("--json", nargs="?", const="-", default=None,
@@ -109,10 +118,12 @@ def main(argv=None):
         model = "tiny" if args.quick else args.model
         bs = 4 if args.quick else args.batch_size
         step, inputs, execute = build_step(
-            model, bs, args.layout, args.mode, use_amp=not args.no_amp)
+            model, bs, args.layout, args.mode, use_amp=not args.no_amp,
+            use_fusion=False if args.no_fusion else None)
         report = mxinspect.inspect_step(
             step, *inputs,
-            name=f"{model}_{args.mode}_bs{bs}",
+            name=f"{model}_{args.mode}_bs{bs}"
+                 + ("_unfused" if args.no_fusion else ""),
             top_k=args.top_k,
             measured=args.measured or None,
             execute=execute if args.measured else None)
