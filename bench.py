@@ -536,13 +536,23 @@ def _phase_io():
            "io_vs_reference_3000": r.get(
                "vs_baseline", round(r["value"] / 3000.0, 4))}
     # per-stage evidence for the decode-bound analysis rides along
-    for k in ("stage_decode_ms_per_img", "stage_augment_ms_per_img",
-              "stage_other_ms_per_img",
+    for k in ("stage_read_ms_per_img", "stage_decode_ms_per_img",
+              "stage_augment_ms_per_img", "stage_other_ms_per_img",
               "decode_only_ceiling_img_s_per_core", "decode_share",
               "host_cores", "host_loadavg_1m", "threads",
               "thread_scaling_2", "thread_scaling_max"):
         if k in r:
             out[f"io_{k}"] = r[k]
+    # uint8 fast-path trend scalars (PR 9): throughput through the shm
+    # worker pool, host->device bytes per image, and the uint8 path's
+    # decode share — already io_-prefixed in the io_bench output
+    for k in ("io_images_per_sec_uint8", "io_host_bytes_per_img",
+              "io_host_bytes_per_img_uint8", "io_bytes_reduction",
+              "io_stage_decode_share", "io_uint8_speedup",
+              "io_reference_reached", "io_workers",
+              "device_augment_retraces"):
+        if k in r:
+            out[k] = r[k]
     return out
 
 

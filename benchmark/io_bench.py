@@ -22,8 +22,17 @@ process is affinity-restricted to one cpu (`--no-pin` disables), so
 "compute_ms" means the same thing alone and under the feed — the
 shared-core-host analog of a dedicated accelerator.
 
+Standalone mode measures THREE ImageRecordIter configurations
+back-to-back: float32 handoff (reference semantics, the "before"), uint8
+handoff through the persistent shm-worker pool (the PR-9 fast path), and
+uint8 + device-side fused augmentation (zero-retrace asserted via
+`fused.device_augment_calls`). `--pair-out` writes the
+`io_r11_{before,after}.json` acceptance artifact pair.
+
 Usage:
   python benchmark/io_bench.py [--n 768] [--batch 128] [--threads 0]
+                               [--workers N] [--quick]
+                               [--pair-out results/io_r11]
   python benchmark/io_bench.py --overlap [--quick] [--depth 2]
                                [--pair-out results/feed_r08] [--no-pin]
 """
@@ -69,22 +78,38 @@ def make_rec(path, n, size=256):
     w.close()
 
 
-def bench(rec_path, batch_size, threads, epochs=2):
+def bench(rec_path, batch_size, threads, epochs=2, handoff="float32",
+          device_augment=False, workers=0):
+    """One ImageRecordIter configuration end-to-end: persistent decode pool
+    (threads or `workers` shm processes), `handoff` float32 (reference
+    semantics: normalized NHWC f32 from the host) or uint8 (raw cropped
+    pixels, 1/4 the staged bytes; `device_augment` runs mirror/normalize
+    on device as the fused jitted kernel). Returns the measured dict."""
     from incubator_mxnet_tpu import io as mxio
     from incubator_mxnet_tpu import native as mxnative
+    from incubator_mxnet_tpu.ops.fused import FUSED_STATS
+    # raw-uint8 handoff rejects mean/std (they would be silently unused:
+    # normalization is the consumer's job there)
+    norm = {} if (handoff == "uint8" and not device_augment) else dict(
+        mean_r=123.68, mean_g=116.779, mean_b=103.939,
+        std_r=58.393, std_g=57.12, std_b=57.375)
     it = mxio.ImageRecordIter(
         path_imgrec=rec_path, data_shape=(224, 224, 3),
         batch_size=batch_size, shuffle=True, rand_crop=True,
         rand_mirror=True, resize=256,
-        mean_r=123.68, mean_g=116.779, mean_b=103.939,
-        std_r=58.393, std_g=57.12, std_b=57.375,
-        preprocess_threads=threads, round_batch=False)
+        preprocess_threads=threads, round_batch=False,
+        handoff=handoff, device_augment=device_augment, workers=workers,
+        **norm)
     native = it._native is not None
-    # warm epoch (page cache, thread pool)
-    n = 0
+    # warm epoch (page cache, thread pool, device-augment program) —
+    # consumed exactly like the timed loop, so every program the steady
+    # state needs (augment + the bulked-segment replays around it) is
+    # compiled BEFORE the retrace counter baseline is read
     for b in it:
-        n += b.data[0].shape[0]
+        _ = float(b.label[0][0, 0]) + float(b.data[0][0, 0, 0, 0])
     mxnative.imagerec_stage_reset()
+    mxio.io_stats(reset=True)
+    warm_traces = int(FUSED_STATS["device_augment_calls"])
     t0 = time.perf_counter()
     total = 0
     checksum = 0.0
@@ -98,8 +123,37 @@ def bench(rec_path, batch_size, threads, epochs=2):
             checksum += float(b.label[0][0, 0]) + float(b.data[0][0, 0, 0, 0])
     dt = time.perf_counter() - t0
     assert checksum == checksum  # not NaN
-    stages = mxnative.imagerec_stage_stats() if native else None
-    return total / dt, native, dt, stages
+    ios = mxio.io_stats()
+    it.close()
+    out = {
+        "images_per_sec": total / dt,
+        "native": native,
+        "mode": "processes" if workers else "threads",
+        "handoff": handoff,
+        "device_augment": bool(device_augment),
+        "host_bytes_per_img": (ios["bytes_staged"] / ios["images"]
+                               if ios["images"] else 0.0),
+        "wait_us_per_batch": (ios["wait_us"] / ios["batches"]
+                              if ios["batches"] else 0.0),
+        "stage_us_per_batch": (ios["stage_us"] / ios["batches"]
+                               if ios["batches"] else 0.0),
+        # retraces of the fused augment kernel AFTER warmup (the
+        # zero-retrace acceptance: per-batch PRNGKeys are array data)
+        "device_augment_retraces":
+            int(FUSED_STATS["device_augment_calls"]) - warm_traces,
+    }
+    if native:
+        st = {k: ios.get(k, 0) for k in ("read_ns", "decode_ns",
+                                         "augment_ns", "decoded_records")}
+        if st["decoded_records"]:
+            n_img = st["decoded_records"]
+            tot = st["read_ns"] + st["decode_ns"] + st["augment_ns"]
+            out["stage_read_ms_per_img"] = st["read_ns"] / n_img / 1e6
+            out["stage_decode_ms_per_img"] = st["decode_ns"] / n_img / 1e6
+            out["stage_augment_ms_per_img"] = st["augment_ns"] / n_img / 1e6
+            out["stage_decode_share"] = (st["decode_ns"] / tot
+                                         if tot else 0.0)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -278,6 +332,124 @@ def bench_overlap(quick=False, depth=2, trials=None, steps=None,
     return out
 
 
+def bench_overlap_rec(rec_path, batch=128, workers=2, depth=2, epochs=3,
+                      quick=False):
+    """PR-4 overlap contract THROUGH the real decode path. PR 9 rolls the
+    device staging INTO ImageRecordIter (async `device_put` straight from
+    the shm ring + `MXNET_IMAGEREC_LOOKAHEAD` batches decoded ahead), so
+    the iterator itself is the device-feeding prefetcher: a plain
+    fetch -> step -> sync loop over it is the "device-fed" loop. Measured
+    against `prefetch=False` (the serial before: decode THEN step, pays
+    data+compute) and against max(data, compute); the acceptance metric
+    is device_fed_step <= 1.15 x max(data, compute). Wrapping the
+    iterator in `io.DeviceFeed` on top is reported as an A/B
+    (`feed_wrapped_step_ms`) — for a source that already stages to
+    device, the extra thread hop is pure overhead (use DeviceFeed for
+    host-array sources; this shows why the staging moved inside)."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import io as mxio
+
+    if quick:
+        epochs = 2
+    h = w = 224
+
+    def make_it(**kw):
+        return mxio.ImageRecordIter(
+            path_imgrec=rec_path, data_shape=(h, w, 3), batch_size=batch,
+            shuffle=True, rand_crop=True, rand_mirror=True, resize=256,
+            round_batch=False, handoff="uint8", workers=workers, **kw)
+
+    W1 = jnp.asarray(np.random.RandomState(0)
+                     .standard_normal((1024, 256)).astype(np.float32) * .03)
+    W2 = jnp.asarray(np.random.RandomState(1)
+                     .standard_normal((256, 256)).astype(np.float32) * .05)
+
+    @jax.jit
+    def train_step(x_u8):
+        x = x_u8.astype(jnp.float32) * (1.0 / 255.0) - 0.45   # device aug
+        x = x.reshape(x.shape[0], -1)[:, :1024]
+        y = jnp.tanh(x @ W1)
+        for _ in range(10):
+            y = jnp.tanh(y @ W2)
+        return y.sum()
+
+    def consume(b):
+        return float(train_step(b.data[0]._arr))
+
+    def timed_epochs(it, body):
+        """Wall clock per batch over `epochs` full passes (reset cost
+        included — an epoch loop pays it too)."""
+        for b in it:                              # warm pass
+            body(b)
+        t0 = time.perf_counter()
+        n = 0
+        for _ in range(epochs):
+            it.reset()
+            for b in it:
+                body(b)
+                n += 1
+        dt = time.perf_counter() - t0
+        it.close()
+        return dt / n * 1e3
+
+    # 1. data: the decode pipeline alone (force each staged batch)
+    data_ms = timed_epochs(make_it(),
+                           lambda b: b.data[0]._arr.block_until_ready())
+
+    # 2. compute: pre-staged batch, per-step host sync
+    xd = jax.device_put(np.zeros((batch, h, w, 3), np.uint8))
+    float(train_step(xd))
+    ts = []
+    for _ in range(12):
+        t0 = time.perf_counter()
+        float(train_step(xd))
+        ts.append(time.perf_counter() - t0)
+    comp_ms = _median(ts) * 1e3
+
+    # 3. serial (before): prefetch off — decode, then step, strictly
+    serial_ms = timed_epochs(make_it(prefetch=False), consume)
+
+    # 4. device-fed (after): the default iterator — lookahead decode +
+    #    async staging overlap the consumer's step
+    dev_ms = timed_epochs(make_it(), consume)
+
+    # 5. A/B: DeviceFeed wrapped around the already-device-staging source
+    it = make_it()
+    feed = mxio.DeviceFeed(it, depth=depth)
+    for b in feed:
+        consume(b)
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(epochs):
+        feed.reset()                 # fresh source epoch through the feed
+        for b in feed:
+            consume(b)
+            n += 1
+    wrapped_ms = (time.perf_counter() - t0) / n * 1e3
+    it.close()
+
+    mx_ms = max(data_ms, comp_ms)
+    return {
+        "metric": "io_rec_device_fed_step_ms",
+        "value": round(dev_ms, 2),
+        "unit": "ms/step",
+        "batch": batch,
+        "workers": workers,
+        "data_ms": round(data_ms, 2),
+        "compute_ms": round(comp_ms, 2),
+        "serial_step_ms": round(serial_ms, 2),
+        "serial_sum_ms": round(data_ms + comp_ms, 2),
+        "device_fed_step_ms": round(dev_ms, 2),
+        "feed_wrapped_step_ms": round(wrapped_ms, 2),
+        "max_ms": round(mx_ms, 2),
+        "device_fed_vs_max": round(dev_ms / mx_ms, 4),
+        "serial_vs_max": round(serial_ms / mx_ms, 4),
+        "speedup_vs_serial": round(serial_ms / dev_ms, 4),
+        "images_per_sec_device_fed": round(batch / (dev_ms / 1e3), 1),
+    }
+
+
 def _finalize(out):
     """Every io_bench artifact reports through the telemetry registry: the
     feed/dispatch counter groups and span aggregates ride along, plus the
@@ -296,17 +468,27 @@ def main():
     ap.add_argument("--n", type=int, default=768)
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--threads", type=int, default=0)
+    ap.add_argument("--workers", type=int, default=None,
+                    help="shm decode workers for the uint8 fast-path "
+                         "measurement (default: min(4, cores) when >= 2 "
+                         "cores, else 0 = thread pool)")
     ap.add_argument("--rec", default=None)
     ap.add_argument("--overlap", action="store_true",
                     help="measure DeviceFeed input-pipeline overlap")
+    ap.add_argument("--overlap-rec", action="store_true",
+                    help="measure the PR-4 overlap contract through the "
+                         "REAL decode path (ImageRecordIter uint8 + shm "
+                         "workers -> DeviceFeed -> jitted step)")
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--depth", type=int, default=2)
     ap.add_argument("--no-pin", action="store_true",
                     help="overlap mode: do not pin XLA compute to one "
                          "worker thread")
     ap.add_argument("--pair-out", default=None,
-                    help="overlap mode: write <prefix>_before.json / "
-                         "<prefix>_after.json artifact pair")
+                    help="write <prefix>_before.json / <prefix>_after.json "
+                         "artifact pair (overlap mode: host-fed vs "
+                         "device-fed; standalone: float32 vs uint8 "
+                         "handoff)")
     args = ap.parse_args()
 
     # backend preflight (io_bench forces the CPU backend, but even that can
@@ -375,6 +557,12 @@ def main():
         print(json.dumps(_finalize(out)))
         return
 
+    if args.quick:
+        args.n = min(args.n, 96)
+        args.batch = min(args.batch, 32)
+        epochs = 1
+    else:
+        epochs = 2
     if args.rec is None:
         # size-stamped per-user cache: no stale-count reuse, no /tmp clash
         import tempfile
@@ -382,23 +570,81 @@ def main():
             tempfile.gettempdir(), f"io_bench_{os.getuid()}_{args.n}.rec")
     if not os.path.exists(args.rec):
         make_rec(args.rec, args.n)
-    ips, native, dt, stages = bench(args.rec, args.batch, args.threads)
+
+    workers = args.workers
+    if workers is None:
+        # the shm worker pool wins once >= 2 cores feed it; stay honest on
+        # a 1-core box (IPC overhead with nothing to parallelize)
+        workers = min(4, os.cpu_count() or 1) if (os.cpu_count() or 1) >= 2 \
+            else 0
+
+    if args.overlap_rec:
+        out = bench_overlap_rec(args.rec, batch=args.batch, workers=workers,
+                                depth=args.depth, quick=args.quick)
+        out["quick"] = bool(args.quick)
+        out["host_cores"] = os.cpu_count()
+        out["host_loadavg_1m"] = round(os.getloadavg()[0], 2)
+        print(json.dumps(_finalize(out)))
+        return
+    # before: float32 handoff — reference semantics, host-side normalize
+    # (the pre-uint8-handoff pipeline); after: uint8 handoff through the
+    # same persistent pool. The native in-process thread pool is the fast
+    # path when the toolchain built it (C++ decode releases the GIL, no
+    # IPC); the shm process workers are measured alongside — they exist to
+    # scale the PIL fallback across cores and are the only parallel path
+    # without a toolchain. Device augment is measured separately (on a
+    # CPU-only host the "device" burns the same cores the decoders need —
+    # it is a win only with a real accelerator).
+    f32 = bench(args.rec, args.batch, args.threads, epochs=epochs)
+    u8 = bench(args.rec, args.batch, args.threads, epochs=epochs,
+               handoff="uint8")
+    u8_procs = None
+    if workers > 0:
+        u8_procs = bench(args.rec, args.batch, args.threads, epochs=epochs,
+                         handoff="uint8", workers=workers)
+        if not u8["native"]:
+            u8 = u8_procs          # no native lib: the worker pool IS the
+            #                        parallel path (PIL scaled across cores)
+    aug = bench(args.rec, args.batch, args.threads, epochs=epochs,
+                handoff="uint8", device_augment=True)
+    ips = f32["images_per_sec"]
     out = {
         "metric": "image_pipeline_images_per_sec",
         "value": round(ips, 1),
         "unit": "images/sec",
         "vs_baseline": round(ips / REFERENCE_IMG_S, 4),
-        "native": native,
+        "native": f32["native"],
         "decode_resize_crop_mirror_normalize": True,
+        "quick": bool(args.quick),
+        # the uint8 fast path (raw pixels staged, normalize deferred)
+        "io_images_per_sec_uint8": round(u8["images_per_sec"], 1),
+        "io_images_per_sec_uint8_device_augment":
+            round(aug["images_per_sec"], 1),
+        "io_uint8_speedup": round(u8["images_per_sec"] / ips, 4),
+        "io_uint8_vs_reference": round(
+            u8["images_per_sec"] / REFERENCE_IMG_S, 4),
+        "io_reference_img_s": REFERENCE_IMG_S,
+        "io_reference_reached": u8["images_per_sec"] >= REFERENCE_IMG_S,
+        "io_host_bytes_per_img": round(f32["host_bytes_per_img"], 1),
+        "io_host_bytes_per_img_uint8": round(u8["host_bytes_per_img"], 1),
+        "io_bytes_reduction": round(
+            f32["host_bytes_per_img"] / u8["host_bytes_per_img"], 4)
+            if u8["host_bytes_per_img"] else 0.0,
+        "io_uint8_mode": u8["mode"],
+        "io_images_per_sec_uint8_shm_workers":
+            round(u8_procs["images_per_sec"], 1) if u8_procs else None,
+        "io_workers": workers,
+        "device_augment_retraces": aug["device_augment_retraces"],
         # environment: the 3000 img/s reference row assumed a multi-core
         # host feeding 4+ decode threads; this box's capability is below
         "host_cores": os.cpu_count(),
         "host_loadavg_1m": round(os.getloadavg()[0], 2),
     }
-    if stages and stages["records"]:
-        n = stages["records"]
-        dec_ms = stages["decode_ns"] / n / 1e6
-        aug_ms = stages["augment_ns"] / n / 1e6
+    if "stage_decode_share" in f32:
+        dec_ms = f32["stage_decode_ms_per_img"]
+        aug_ms = f32["stage_augment_ms_per_img"]
+        out["stage_read_ms_per_img"] = round(f32["stage_read_ms_per_img"],
+                                             3)
         out["stage_decode_ms_per_img"] = round(dec_ms, 3)
         out["stage_augment_ms_per_img"] = round(aug_ms, 3)
         out["stage_other_ms_per_img"] = round(
@@ -407,6 +653,70 @@ def main():
         # stage, given the measured per-core decode cost
         out["decode_only_ceiling_img_s_per_core"] = round(1000.0 / dec_ms, 1)
         out["decode_share"] = round(dec_ms / (dec_ms + aug_ms), 3)
+        out["io_stage_decode_share"] = round(
+            u8.get("stage_decode_share", 0.0), 4)
+        out["io_stage_augment_ms_per_img_uint8"] = round(
+            u8.get("stage_augment_ms_per_img", 0.0), 3)
+    if args.pair_out:
+        meta = {"bench": "io_bench (ImageRecordIter standalone)",
+                "quick": bool(args.quick), "n": args.n, "batch": args.batch,
+                "epochs": epochs, "host_cores": os.cpu_count(),
+                "host_loadavg_1m": round(os.getloadavg()[0], 2),
+                "platform": "cpu", "backend_ok": True,
+                "reference_img_s": REFERENCE_IMG_S,
+                "note": "measured back-to-back within ONE run on the same "
+                        "host: 'before' is the float32 handoff (reference "
+                        "semantics, host-side normalize) through the SAME "
+                        "persistent pool — the uint8 handoff's direct A/B, "
+                        "NOT the pre-PR9 baseline (the committed r11 "
+                        "before was measured from the actual pre-PR9 tree, "
+                        "which also lacked the pool + in-place decode); "
+                        "'after' is the uint8 handoff (native in-process "
+                        "thread pool when built — C++ decode releases the "
+                        "GIL, no IPC; the shm process-worker figure rides "
+                        "along: the parallel path for the PIL fallback / "
+                        "toolchain-less hosts); device-augment throughput "
+                        "on this CPU-only host shares cores with the "
+                        "decoders and is reported for honesty, not as "
+                        "the win"}
+        before = {"meta": dict(meta, label="float32 handoff (before)"),
+                  "input_pipeline": {
+                      "io_pipeline_images_per_sec": round(ips, 1),
+                      "io_host_bytes_per_img": out["io_host_bytes_per_img"],
+                      "stage_decode_ms_per_img":
+                          out.get("stage_decode_ms_per_img"),
+                      "stage_augment_ms_per_img":
+                          out.get("stage_augment_ms_per_img"),
+                      "vs_reference": out["vs_baseline"]}}
+        after = {"meta": dict(meta,
+                              label=f"uint8 handoff "
+                                    f"({out['io_uint8_mode']} mode; shm "
+                                    f"workers measured: {workers}) "
+                                    f"(after)"),
+                 "input_pipeline": {
+                     "io_pipeline_images_per_sec":
+                         out["io_images_per_sec_uint8"],
+                     "io_images_per_sec_uint8":
+                         out["io_images_per_sec_uint8"],
+                     "io_images_per_sec_uint8_shm_workers":
+                         out["io_images_per_sec_uint8_shm_workers"],
+                     "io_images_per_sec_uint8_device_augment":
+                         out["io_images_per_sec_uint8_device_augment"],
+                     "speedup_vs_before": out["io_uint8_speedup"],
+                     "io_host_bytes_per_img":
+                         out["io_host_bytes_per_img_uint8"],
+                     "io_bytes_reduction": out["io_bytes_reduction"],
+                     "io_stage_decode_share":
+                         out.get("io_stage_decode_share"),
+                     "device_augment_retraces":
+                         out["device_augment_retraces"],
+                     "vs_reference": out["io_uint8_vs_reference"],
+                     "reference_reached": out["io_reference_reached"]}}
+        os.makedirs(os.path.dirname(os.path.abspath(
+            args.pair_out + "_before.json")), exist_ok=True)
+        for suffix, payload in (("_before", before), ("_after", after)):
+            with open(args.pair_out + suffix + ".json", "w") as f:
+                json.dump(payload, f, indent=1)
     print(json.dumps(_finalize(out)))
 
 

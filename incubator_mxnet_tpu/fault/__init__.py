@@ -69,6 +69,8 @@ POINTS = {
     "checkpoint.load": "load_checkpoint / load_sharded entry",
     "io.prefetch": "PrefetchingIter worker, per fetched batch",
     "io.device_feed": "DeviceFeed feeder thread, before each source fetch",
+    "io.imagerec": "ImageRecordIter producer, before each batch decode "
+                   "submit (worker death mid-batch)",
     "dataloader.fetch": "gluon DataLoader batch assembly, per batch",
     "kvstore.push": "KVStore.push entry",
     "kvstore.pull": "KVStore.pull entry",

@@ -155,6 +155,13 @@ class ImageRecordDataset(Dataset):
     def __len__(self):
         return len(self._rec)
 
+    def payload(self, idx):
+        """Raw IRHeader-packed record bytes — no decode, no NDArray wrap
+        (the ImageRecordIter PIL fallback and the shm decode workers parse
+        these through `io._imagerec_common.parse_record` instead of paying
+        a device round-trip per image)."""
+        return self._rec[idx]
+
     def __getitem__(self, idx):
         from ....recordio import unpack
         header, payload = unpack(self._rec[idx])

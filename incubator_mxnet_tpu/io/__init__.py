@@ -7,15 +7,129 @@ legacy training scripts working.
 """
 from __future__ import annotations
 
+import threading as _threading
 from collections import namedtuple
 
 import numpy as _np
 
 from ..base import MXNetError
 from ..ndarray import NDArray, array
+from ..telemetry.registry import stats_group as _stats_group
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter"]
+
+
+# ---------------------------------------------------------------------------
+# ImageRecordIter pipeline counters (consumer-side; the native per-stage
+# read/decode/augment clocks ride along in profiler.io_stats()). Adopted
+# into the telemetry registry as the `io.imagerec` group.
+# ---------------------------------------------------------------------------
+_IO_STATS_LOCK = _threading.Lock()
+
+IO_STATS = _stats_group("io.imagerec", {
+    "batches": 0,            # batches delivered to the consumer
+    "images": 0,             # real (non-pad) images delivered
+    "failed_records": 0,     # corrupt records zero-filled by the decoders
+    "stage_us": 0.0,         # consumer staging (async H2D dispatch + wrap)
+    "wait_us": 0.0,          # consumer waited on the decode pool (producer-
+    #                          bound stall; ≙ feed.stall_data_us)
+    "bytes_staged": 0,       # host bytes handed to device_put (the uint8-
+    #                          handoff 4x win shows up here)
+    "device_augment_batches": 0,  # batches normalized on device (fused op)
+    "alias_copies": 0,       # slot-aliasing device_put defended by a copy
+    "submit_restarts": 0,    # transient submit faults retried in place
+    "worker_restarts": 0,    # decode worker processes respawned
+}, lock=_IO_STATS_LOCK,
+    help="ImageRecordIter pipeline counters (profiler.io_stats)")
+
+
+def _bump_io(key, delta=1):
+    with _IO_STATS_LOCK:
+        IO_STATS[key] += delta
+
+
+# native decoder per-stage clocks (imagerec.cc), mirrored into the registry
+# by io_stats(): gauges (levels), so snapshot(reset=True) leaves them alone
+from ..telemetry.registry import REGISTRY as _REGISTRY
+
+_STAGE_GAUGES = {
+    "read_ns": _REGISTRY.gauge(
+        "io.imagerec.read_ns",
+        help="native record-byte acquisition time (mmap fault / chunk "
+             "reassembly) — what ir_advise readahead targets"),
+    "decode_ns": _REGISTRY.gauge(
+        "io.imagerec.decode_ns", help="native JPEG decode time"),
+    "augment_ns": _REGISTRY.gauge(
+        "io.imagerec.augment_ns",
+        help="native fused resize/crop/mirror[/normalize] sampling pass"),
+    "decoded_records": _REGISTRY.gauge(
+        "io.imagerec.decoded_records",
+        help="records decoded by the native pipeline since stage reset"),
+}
+
+# native stage-clock deltas shipped back by out-of-process decode workers
+# (the in-process lib's globals only see parent-side decodes); guarded by
+# _IO_STATS_LOCK, folded into io_stats()
+_WORKER_STAGES = {"read_ns": 0, "decode_ns": 0, "augment_ns": 0,
+                  "records": 0}
+
+
+def _note_worker_stages(stages):
+    with _IO_STATS_LOCK:
+        for k in _WORKER_STAGES:
+            _WORKER_STAGES[k] += int(stages.get(k, 0))
+
+
+def io_stats(reset=False):
+    """Snapshot of the ImageRecordIter pipeline counters plus the native
+    decoder's per-stage clocks (`native.imagerec_stage_stats`): read
+    (record-byte acquisition — what `ir_advise` readahead targets),
+    decode (JPEG), augment (fused resize/crop/mirror[/normalize] sampling
+    pass), and the decoded-record count. The stage clocks are mirrored
+    into the telemetry registry as `io.imagerec.{read_ns, decode_ns,
+    augment_ns, decoded_records}` gauges on every call (gauges: levels,
+    not flows — they survive `snapshot(reset=True)`). `reset=True` zeroes
+    both the counters and the native clocks after the snapshot. Exposed
+    as `profiler.io_stats()`."""
+    snap = IO_STATS.snapshot(reset=reset)
+    try:
+        from ..native import imagerec_stage_stats
+        stages = imagerec_stage_stats(reset=reset)
+    except Exception:
+        stages = None
+    with _IO_STATS_LOCK:
+        worker = dict(_WORKER_STAGES)
+        if reset:
+            for k in _WORKER_STAGES:
+                _WORKER_STAGES[k] = 0
+    if stages is None:          # no native lib: worker deltas still count
+        stages = {"read_ns": 0, "decode_ns": 0, "augment_ns": 0,
+                  "records": 0}
+    for key, src in (("read_ns", "read_ns"),
+                     ("decode_ns", "decode_ns"),
+                     ("augment_ns", "augment_ns"),
+                     ("decoded_records", "records")):
+        val = stages[src] + worker[src]
+        snap[key] = val
+        _STAGE_GAUGES[key].set(val)
+    return snap
+
+
+def _host_aliased(dev, view):
+    """True when the staged jax array shares memory with `view` (CPU PjRt
+    zero-copy of an aligned host array). Reusing the ring slot would then
+    silently rewrite the delivered batch — the caller copies instead."""
+    try:
+        ptr = dev.unsafe_buffer_pointer()
+    except Exception:
+        try:
+            ptr = next(iter(dev.addressable_shards)) \
+                .data.unsafe_buffer_pointer()
+        except Exception:
+            return False
+    base = view.ctypes.data
+    return base <= ptr < base + view.nbytes
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -443,22 +557,47 @@ __all__ += ["CSVIter", "LibSVMIter"]
 
 
 class ImageRecordIter(DataIter):
-    """Threaded image .rec iterator (≙ ImageRecordIter,
+    """Image .rec iterator over a persistent decode pool (≙ ImageRecordIter,
     /root/reference/src/io/iter_image_recordio_2.cc:708-940 + the
     prefetcher in iter_prefetcher.h).
 
     TPU-first differences from the reference: batches come out NHWC
-    float32 (the MXU layout) rather than NCHW, normalization happens in
-    the C++ worker (mean/std in [0,1] units), and the decode+augment
-    pipeline runs on a native thread pool (imagerec.cc) with a one-batch
-    lookahead so device step time overlaps host decode. Falls back to a
-    single-threaded PIL path when the native library is unavailable.
+    (the MXU layout) rather than NCHW, and the decode+augment pipeline
+    runs on a PERSISTENT producer — `MXNET_IO_WORKERS=N` decodes each
+    batch sharded across N out-of-process shared-memory workers
+    (io/imagerec_pool.py; no per-batch thread spawn, no pickling of image
+    arrays), default `0` uses the in-process native thread pool
+    (imagerec.cc) behind one persistent dispatcher thread — with
+    `MXNET_IMAGEREC_LOOKAHEAD` batches decoded ahead of the consumer and
+    `posix_fadvise(WILLNEED)` readahead over each upcoming batch's
+    record ranges. Falls back to a synchronous PIL path (shared augment
+    spec — crop/mirror geometry parity with native) when neither the
+    native library nor workers are available.
+
+    Handoff modes:
+      * float32 (default, reference semantics): normalized float32 NHWC,
+        mean/std applied by the decode workers.
+      * `handoff="uint8"`: workers produce raw cropped uint8 NHWC — 1/4
+        the bytes through shared memory and H2D — staged to device
+        asynchronously (zero host copies between decode buffer and
+        `device_put`). With `device_augment=True` (or
+        `MXNET_IO_DEVICE_AUGMENT=1`, which also implies uint8 handoff)
+        mirror/normalize/cast run ON DEVICE as one jitted batched kernel
+        (`npx.fused_image_augment`) seeded from a fixed PRNGKey per
+        (epoch, batch) — the batch still arrives as normalized float
+        (`dtype`), so training code is unchanged.
 
     Supported reference knobs: path_imgrec, data_shape ((3,H,W) or
     (H,W,3)), batch_size, shuffle, rand_crop, rand_mirror, resize,
     mean_r/g/b, std_r/g/b (255-scale like the reference; converted),
     label_width, seed, round_batch (partial final batch dropped like the
     reference when round_batch=False ... kept=padded when True).
+
+    Failure semantics: a decode-worker failure re-raises the ORIGINAL
+    exception in the consumer's `next()`; transient submit-time faults
+    (`io.imagerec` injection point) retry in place up to a bounded number
+    of CONSECUTIVE times (`MXNET_PREFETCH_RESTARTS`), mirroring
+    `io.device_feed`. Observability: `profiler.io_stats()`.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, shuffle=False,
@@ -466,8 +605,11 @@ class ImageRecordIter(DataIter):
                  mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=0.0, std_g=0.0, std_b=0.0,
                  label_width=1, seed=0, round_batch=True,
-                 preprocess_threads=0, prefetch=True, **kwargs):
+                 preprocess_threads=0, prefetch=True, handoff=None,
+                 device_augment=None, dtype="float32", workers=None,
+                 lookahead=None, shm_mb=None, max_restarts=None, **kwargs):
         super().__init__(batch_size)
+        from ..base import get_env
         self._path = path_imgrec
         self._shape = tuple(int(s) for s in data_shape)
         if self._shape[0] == 3 and self._shape[2] != 3:
@@ -479,9 +621,9 @@ class ImageRecordIter(DataIter):
         self._rand_mirror = rand_mirror
         self._resize = int(resize)
         # reference means/stds are in 0..255 pixel units (each std defaults
-        # to 1.0 per channel there); the native pipeline normalizes after
-        # scaling to [0,1], so divide by 255 and map unset std channels to
-        # the reference default 1.0 rather than a 1/0 blow-up
+        # to 1.0 per channel there); normalization happens after scaling to
+        # [0,1], so divide by 255 and map unset std channels to the
+        # reference default 1.0 rather than a 1/0 blow-up
         self._mean = ([mean_r / 255.0, mean_g / 255.0, mean_b / 255.0]
                       if (mean_r or mean_g or mean_b) else None)
         self._std = ([(s if s else 1.0) / 255.0
@@ -492,6 +634,35 @@ class ImageRecordIter(DataIter):
         self._round_batch = round_batch
         self._prefetch = prefetch
         self._epoch = 0
+        self._dtype = dtype
+        if device_augment is None:
+            device_augment = get_env("MXNET_IO_DEVICE_AUGMENT", "0") \
+                not in ("0", "false")
+        self._device_augment = bool(device_augment)
+        if handoff is None:
+            handoff = "uint8" if self._device_augment else "float32"
+        if handoff not in ("float32", "uint8"):
+            raise MXNetError(f"invalid handoff {handoff!r}")
+        if self._device_augment and handoff != "uint8":
+            raise MXNetError("device_augment needs handoff='uint8' "
+                             "(the device kernel normalizes raw pixels)")
+        self._handoff_u8 = handoff == "uint8"
+        if self._handoff_u8 and not self._device_augment \
+                and (self._mean is not None or std_r or std_g or std_b):
+            raise MXNetError(
+                "handoff='uint8' delivers RAW pixels — mean/std would be "
+                "silently ignored. Use device_augment=True (normalize on "
+                "device) or the float32 handoff (normalize in the "
+                "decoders), or drop the mean/std arguments and normalize "
+                "in your step")
+        self._workers = (get_env("MXNET_IO_WORKERS", 0, typ=int)
+                         if workers is None else int(workers))
+        ahead = (get_env("MXNET_IMAGEREC_LOOKAHEAD", 2, typ=int)
+                 if lookahead is None else int(lookahead))
+        self._ahead = max(0, ahead) if prefetch else 0
+        self._shm_mb = shm_mb
+        self._max_restarts = (get_env("MXNET_PREFETCH_RESTARTS", 3, typ=int)
+                              if max_restarts is None else int(max_restarts))
 
         from ..native import NativeImageRecordFile
         try:
@@ -500,15 +671,53 @@ class ImageRecordIter(DataIter):
             self._n = len(self._native)
         except (RuntimeError, IOError):
             self._native = None
-            from ..gluon.data.vision.datasets import ImageRecordDataset
-            self._pyds = ImageRecordDataset(path_imgrec)
+            from ._imagerec_common import PyRecordIndex
+            self._pyds = PyRecordIndex(path_imgrec)
             self._n = len(self._pyds)
         self._order = _np.arange(self._n)
+        self._pool = self._make_pool()
+        self._batch_ids = iter(range(1 << 62)).__next__
         self.reset()
+
+    def _make_pool(self):
+        if self._native is None and self._workers <= 0:
+            return None              # synchronous shared-spec PIL path
+        from .imagerec_pool import DecodePool
+        try:
+            return DecodePool(
+                self._path, self._hw, self.batch_size,
+                out_u8=self._handoff_u8, resize=self._resize,
+                rand_crop=self._rand_crop,
+                rand_mirror=self._host_mirror, mean=self._mean,
+                std=self._std, label_width=self._label_width,
+                reader=self._native, workers=self._workers,
+                lookahead=max(1, self._ahead), shm_mb=self._shm_mb,
+                max_restarts=self._max_restarts)
+        except Exception as e:
+            if self._native is not None:
+                raise
+            from .. import fault as _fault
+            _fault._log_event("io.imagerec_pool_fallback",
+                              error=f"{type(e).__name__}: {e}",
+                              mode="python-sync")
+            return None
+
+    @property
+    def _host_mirror(self):
+        # device_augment moves the mirror coin-flip into the fused device
+        # kernel (PRNGKey stream); the host decode must not also mirror
+        return self._rand_mirror and not self._device_augment
 
     @property
     def num_records(self):
         return self._n
+
+    def __len__(self):
+        if self._n == 0:
+            return 0
+        if self._round_batch:
+            return -(-self._n // self.batch_size)
+        return self._n // self.batch_size
 
     def reset(self):
         self._epoch += 1
@@ -516,27 +725,42 @@ class ImageRecordIter(DataIter):
             rng = _np.random.RandomState(self._seed + self._epoch)
             self._order = rng.permutation(self._n)
         self._cursor = 0
-        self._pending = None
-        if self._prefetch and self._native is not None:
-            self._pending = self._launch(self._cursor)
+        self._sched_cursor = 0
+        self._inflight = []
+        self._restarts = 0
+        if self._pool is None:
+            return
+        self._pool.reset()
+        self._fill_lookahead()
 
-    # -- native path with one-batch lookahead ---------------------------
-    def _launch(self, cursor):
-        import threading
-        idx = self._batch_indices(cursor)
-        if idx is None:
-            return None
-        result = {}
+    def _force_python_fallback(self):
+        """TEST hook: drop the native reader and its pool so subsequent
+        epochs run the synchronous shared-augment-spec PIL path — the
+        parity tests' way of exercising the fallback on a host where the
+        native library built fine."""
+        self._native = None
+        if self._pool is not None:
+            self._pool.close()
+            self._pool = None
+        if not hasattr(self, "_pyds"):
+            from ._imagerec_common import PyRecordIndex
+            self._pyds = PyRecordIndex(self._path)
+        self.reset()
 
-        def work():
-            try:
-                result["out"] = self._decode(idx)
-            except BaseException as e:  # resurface in the consumer thread
-                result["err"] = e
+    def close(self):
+        """Stop the decode pool (workers/dispatcher); idempotent."""
+        if getattr(self, "_pool", None) is not None:
+            self._pool.close()
+            self._pool = None
 
-        t = threading.Thread(target=work, daemon=True)
-        t.start()
-        return (t, result, len(idx))
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _epoch_seed(self):
+        return self._seed * 1000003 + self._epoch
 
     def _batch_indices(self, cursor):
         if cursor >= self._n:
@@ -554,89 +778,146 @@ class ImageRecordIter(DataIter):
                 [idx, wrapped[:self.batch_size - len(idx)]])
         return idx
 
-    def _decode(self, idx):
-        images, labels, _failed = self._native.read_batch(
-            idx, (self._hw[0], self._hw[1], 3), resize=self._resize,
-            rand_crop=self._rand_crop, rand_mirror=self._rand_mirror,
-            seed=self._seed * 1000003 + self._epoch, mean=self._mean,
-            std=self._std, label_width=self._label_width)
-        return images, labels
+    # -- pooled path: persistent producer, bounded lookahead -------------
+    def _fill_lookahead(self):
+        limit = min(self._ahead + 1, self._pool.n_slots)
+        while len(self._inflight) < limit:
+            idx = self._batch_indices(self._sched_cursor)
+            if idx is None:
+                return
+            job = self._submit_with_restarts(idx)
+            n_real = min(self.batch_size, self._n - self._sched_cursor)
+            self._inflight.append((job, self._sched_cursor, n_real))
+            self._sched_cursor += self.batch_size
+
+    def _submit_with_restarts(self, idx):
+        """`io.device_feed` semantics for the `io.imagerec` fault point:
+        inject BEFORE the submit, retry transient I/O errors in place up
+        to a bounded number of CONSECUTIVE times, re-raise the original
+        exception once the budget is exhausted."""
+        from .. import fault as _fault
+        while True:
+            try:
+                _fault.inject("io.imagerec")
+                job = self._pool.submit(self._batch_ids(), idx,
+                                        self._epoch_seed())
+            except (IOError, OSError, TimeoutError) as e:
+                if self._restarts < self._max_restarts:
+                    self._restarts += 1
+                    _bump_io("submit_restarts")
+                    _fault._log_event("io.imagerec_restart",
+                                      attempt=self._restarts, error=repr(e))
+                    continue
+                raise
+            self._restarts = 0   # budget bounds CONSECUTIVE errors
+            return job
 
     def next(self):
-        if self._native is None:
+        if self._pool is None:
             return self._next_python()
-        if self._pending is not None:
-            t, result, n_idx = self._pending
-            t.join()
-            if "err" in result:
-                self._pending = None
-                raise result["err"]
-            out = result["out"]
-            cursor = self._cursor
-        else:
-            idx = self._batch_indices(self._cursor)
-            if idx is None:
-                raise StopIteration
-            out = self._decode(idx)
-            cursor = self._cursor
-        n_real = min(self.batch_size, self._n - cursor)
-        self._cursor += self.batch_size
-        if self._prefetch:
-            self._pending = self._launch(self._cursor)
-        if out is None:
+        import time as _time
+        self._fill_lookahead()
+        if not self._inflight:
             raise StopIteration
-        images, labels = out
-        return DataBatch(data=[array(images)], label=[array(labels)],
+        job, cursor, n_real = self._inflight.pop(0)
+        t0 = _time.perf_counter()
+        images_view, labels_view, failed = self._pool.wait(job)
+        wait_us = (_time.perf_counter() - t0) * 1e6
+        self._cursor = cursor + self.batch_size
+        batch = self._stage(images_view, labels_view, job, cursor, n_real,
+                            failed, wait_us)
+        self._fill_lookahead()   # the consumed batch's slot is free again
+        return batch
+
+    def _stage(self, images_view, labels_view, job, cursor, n_real, failed,
+               wait_us):
+        """Move one decoded slot to the consumer: labels copy out (tiny),
+        images go straight from the (shared-memory) slot into an ASYNC
+        `device_put` — no intermediate host copy — and, in device_augment
+        mode, through the fused crop/flip/normalize/cast kernel. The slot
+        returns to the ring fenced on the staged device array."""
+        import time as _time
+        from .device_feed import maybe_device_put
+        t0 = _time.perf_counter()
+        labels = array(_np.array(labels_view))
+        dev = maybe_device_put(images_view)
+        if job is not None and _host_aliased(dev, images_view):
+            # CPU PjRt zero-copies aligned host arrays: the "device" array
+            # IS the ring slot, which the producer is about to rewrite —
+            # materialize a copy before releasing the slot (real
+            # accelerators H2D-copy, so this never fires there)
+            dev = maybe_device_put(_np.array(images_view))
+            _bump_io("alias_copies")
+        from ..ndarray import _wrap
+        if self._device_augment:
+            data = self._augment_on_device(_wrap(dev), cursor)
+        else:
+            data = _wrap(dev)
+        if self._pool is not None and job is not None:
+            self._pool.release(job, fence=[dev])
+        stage_us = (_time.perf_counter() - t0) * 1e6
+        with _IO_STATS_LOCK:
+            IO_STATS["batches"] += 1
+            IO_STATS["images"] += int(n_real)
+            IO_STATS["failed_records"] += int(failed)
+            IO_STATS["stage_us"] += stage_us
+            IO_STATS["wait_us"] += wait_us
+            IO_STATS["bytes_staged"] += int(images_view.nbytes)
+            if self._device_augment:
+                IO_STATS["device_augment_batches"] += 1
+        return DataBatch(data=[data], label=[labels],
                          pad=self.batch_size - n_real)
 
-    # -- PIL fallback ---------------------------------------------------
+    def _augment_on_device(self, data_u8, cursor):
+        """ONE jitted batched kernel (npx.fused_image_augment) for
+        mirror/normalize/cast, keyed by a fixed PRNGKey per (epoch, batch)
+        — key DATA is an array argument, so per-batch keys never retrace."""
+        from .. import numpy_extension as npx
+        batch_no = cursor // self.batch_size
+        key = _np.array([self._epoch_seed() & 0xFFFFFFFF,
+                         batch_no & 0xFFFFFFFF], _np.uint32)
+        mean = tuple(self._mean) if self._mean is not None else None
+        std = tuple(self._std) if self._std is not None else None
+        return npx.fused_image_augment(
+            data_u8, array(key), mean=mean, std=std,
+            rand_mirror=bool(self._rand_mirror), out_dtype=self._dtype)
+
+    # -- synchronous fallback (shared augment spec; PIL decode) ----------
     def _next_python(self):
         idx = self._batch_indices(self._cursor)
         if idx is None:
             raise StopIteration
         n_real = min(self.batch_size, self._n - self._cursor)
+        cursor = self._cursor
         self._cursor += self.batch_size
         h, w = self._hw
-        images = _np.zeros((len(idx), h, w, 3), dtype=_np.float32)
-        labels = _np.zeros((len(idx), self._label_width), dtype=_np.float32)
-        rng = _np.random.RandomState(self._seed + self._cursor)
+        from . import _imagerec_common as common
+        out_u8 = self._handoff_u8
+        images = _np.zeros((len(idx), h, w, 3),
+                           _np.uint8 if out_u8 else _np.float32)
+        labels = _np.zeros((len(idx), self._label_width), _np.float32)
+        failed = 0
+        eseed = self._epoch_seed()
         for k, i in enumerate(idx):
-            x, label = self._pyds[int(i)]
-            img = x.asnumpy()
-            ih, iw = img.shape[:2]
-            short = self._resize if self._resize > 0 else max(h, w)
-            scale = short / min(ih, iw)
-            nh, nw = max(int(ih * scale + 0.5), h), max(int(iw * scale + 0.5),
-                                                        w)
             try:
-                from PIL import Image
-                img = _np.asarray(
-                    Image.fromarray(img.astype(_np.uint8)).resize(
-                        (nw, nh), Image.BILINEAR))
-            except ImportError:
-                # numpy nearest-neighbor resize fallback so the crop
-                # geometry invariants (ih >= h, iw >= w) always hold
-                ys = _np.clip((_np.arange(nh) + 0.5) * (ih / nh) - 0.5,
-                              0, ih - 1).round().astype(_np.int64)
-                xs_ = _np.clip((_np.arange(nw) + 0.5) * (iw / nw) - 0.5,
-                               0, iw - 1).round().astype(_np.int64)
-                img = img[ys][:, xs_]
-            ih, iw = img.shape[:2]
-            y0 = rng.randint(0, ih - h + 1) if self._rand_crop else (ih - h) // 2
-            x0 = rng.randint(0, iw - w + 1) if self._rand_crop else (iw - w) // 2
-            crop = img[y0:y0 + h, x0:x0 + w, :3].astype(_np.float32) / 255.0
-            if self._rand_mirror and rng.randint(2):
-                crop = crop[:, ::-1]
-            if self._mean is not None:
-                crop = crop - _np.asarray(self._mean, _np.float32)
-            if self._std is not None:
-                crop = crop / _np.asarray(self._std, _np.float32)
-            images[k] = crop
-            lab = _np.atleast_1d(_np.asarray(label, _np.float32))
-            m = min(self._label_width, lab.size)
-            labels[k, :m] = lab[:m]
-        return DataBatch(data=[array(images)], label=[array(labels)],
-                         pad=self.batch_size - n_real)
+                img, lab = common.process_record(
+                    self._payload(int(i)), h, w, self._resize,
+                    self._rand_crop, self._host_mirror,
+                    common.record_seed(eseed, int(i)), self._label_width,
+                    out_u8, mean=self._mean, std=self._std)
+                images[k] = img
+                labels[k] = lab
+            except ValueError:       # corrupt record: native parity
+                labels[k] = -1.0
+                failed += 1
+        return self._stage(images, labels, None, cursor, n_real, failed,
+                           0.0)
+
+    def _payload(self, i):
+        ds = self._pyds
+        if hasattr(ds, "payload"):
+            return ds.payload(i)
+        return ds._rec[i]            # gluon ImageRecordDataset shim
 
 
 __all__ += ["ImageRecordIter"]

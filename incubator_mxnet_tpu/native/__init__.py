@@ -143,8 +143,18 @@ def load_imagerec():
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int]
+        lib.ir_read_batch_u8.restype = ctypes.c_int64
+        lib.ir_read_batch_u8.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int]
+        lib.ir_advise.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_int64),
+                                  ctypes.c_int64]
         lib.ir_version.restype = ctypes.c_char_p
-        lib.ir_stage_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)] * 3
+        lib.ir_stage_stats.argtypes = [ctypes.POINTER(ctypes.c_int64)] * 4
         lib.ir_stage_reset.argtypes = []
         _LIB["imagerec"] = lib
         return lib
@@ -152,16 +162,23 @@ def load_imagerec():
 
 def imagerec_stage_stats(reset=False):
     """Per-stage accumulated wall nanoseconds of the native image pipeline
-    since the last reset: {'decode_ns', 'augment_ns', 'records'}. The
-    measured basis for the IO decode-bound analysis (VERDICT-r3 Weak #2)."""
+    since the last reset: {'read_ns', 'decode_ns', 'augment_ns', 'records'}.
+    read = record-byte acquisition (mmap fault / chunk reassembly — the
+    stage ir_advise readahead targets), decode = JPEG, augment = the fused
+    resize/crop/mirror[/normalize] sampling pass. The measured basis for
+    the IO decode-bound analysis (VERDICT-r3 Weak #2); surfaced as
+    telemetry `io.imagerec.*` gauges via profiler.io_stats()."""
     lib = load_imagerec()
     if lib is None:
         return None
+    rd = ctypes.c_int64()
     d = ctypes.c_int64()
     a = ctypes.c_int64()
     r = ctypes.c_int64()
-    lib.ir_stage_stats(ctypes.byref(d), ctypes.byref(a), ctypes.byref(r))
-    out = {"decode_ns": d.value, "augment_ns": a.value, "records": r.value}
+    lib.ir_stage_stats(ctypes.byref(rd), ctypes.byref(d), ctypes.byref(a),
+                       ctypes.byref(r))
+    out = {"read_ns": rd.value, "decode_ns": d.value, "augment_ns": a.value,
+           "records": r.value}
     if reset:
         lib.ir_stage_reset()
     return out
@@ -194,24 +211,23 @@ class NativeImageRecordFile:
 
     def read_batch(self, indices, data_shape, resize=0, rand_crop=False,
                    rand_mirror=False, seed=0, mean=None, std=None,
-                   label_width=1):
+                   label_width=1, out_images=None, out_labels=None):
         """Decode+augment `indices` into one contiguous NHWC float32 batch.
 
         data_shape is (H, W, 3) (NHWC — the MXU layout) or reference-style
         (3, H, W); labels come back as (n, label_width) float32. Corrupt
-        records zero-fill their slot with label -1."""
+        records zero-fill their slot with label -1. `out_images`/
+        `out_labels` decode in place (e.g. straight into a ring slot — no
+        intermediate batch copy); omitted, fresh arrays are allocated."""
         np = self._np
         ct = ctypes
-        if len(data_shape) != 3:
-            raise ValueError("data_shape must be rank 3")
-        if data_shape[0] == 3 and data_shape[2] != 3:
-            h, w = int(data_shape[1]), int(data_shape[2])  # (3,H,W) legacy
-        else:
-            h, w = int(data_shape[0]), int(data_shape[1])
+        h, w = self._out_hw(data_shape)
         idx = np.ascontiguousarray(indices, dtype=np.int64)
         n = len(idx)
-        images = np.empty((n, h, w, 3), dtype=np.float32)
-        labels = np.empty((n, label_width), dtype=np.float32)
+        images = (np.empty((n, h, w, 3), dtype=np.float32)
+                  if out_images is None else out_images)
+        labels = (np.empty((n, label_width), dtype=np.float32)
+                  if out_labels is None else out_labels)
 
         def fptr(a):
             return a.ctypes.data_as(ct.POINTER(ct.c_float))
@@ -230,6 +246,52 @@ class NativeImageRecordFile:
         if failed < 0:
             raise IOError("ir_read_batch: invalid arguments")
         return images, labels, int(failed)
+
+    @staticmethod
+    def _out_hw(data_shape):
+        if len(data_shape) != 3:
+            raise ValueError("data_shape must be rank 3")
+        if data_shape[0] == 3 and data_shape[2] != 3:
+            return int(data_shape[1]), int(data_shape[2])  # (3,H,W) legacy
+        return int(data_shape[0]), int(data_shape[1])
+
+    def read_batch_u8(self, indices, data_shape, resize=0, rand_crop=False,
+                      rand_mirror=False, seed=0, label_width=1,
+                      out_images=None, out_labels=None):
+        """uint8-handoff decode: resize+crop[+mirror] to raw NHWC uint8 —
+        normalize/cast run on device (ops.fused.image_augment), so the
+        batch handed to H2D is 1/4 the float32 bytes. Same per-record RNG
+        as read_batch (crop geometry is bitwise identical across paths).
+        `out_images`/`out_labels` decode in place (e.g. into a
+        shared-memory ring slot); omitted, fresh arrays are allocated."""
+        np = self._np
+        ct = ctypes
+        h, w = self._out_hw(data_shape)
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        n = len(idx)
+        if out_images is None:
+            out_images = np.empty((n, h, w, 3), dtype=np.uint8)
+        if out_labels is None:
+            out_labels = np.empty((n, label_width), dtype=np.float32)
+        failed = self._lib.ir_read_batch_u8(
+            self._h, idx.ctypes.data_as(ct.POINTER(ct.c_int64)), n,
+            h, w, int(resize), int(bool(rand_crop)), int(bool(rand_mirror)),
+            ct.c_uint64(seed),
+            out_images.ctypes.data_as(ct.POINTER(ct.c_uint8)),
+            out_labels.ctypes.data_as(ct.POINTER(ct.c_float)), label_width)
+        if failed < 0:
+            raise IOError("ir_read_batch_u8: invalid arguments")
+        return out_images, out_labels, int(failed)
+
+    def advise(self, indices):
+        """posix_fadvise/madvise(WILLNEED) the records' coalesced byte
+        ranges so an upcoming batch's pages stream in ahead of the decode
+        (called per lookahead batch by the ImageRecordIter producer)."""
+        np = self._np
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        self._lib.ir_advise(
+            self._h, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(idx))
 
     def close(self):
         if self._h:

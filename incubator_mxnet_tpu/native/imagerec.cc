@@ -25,7 +25,10 @@
 #include <atomic>
 #include <chrono>
 #include <cstddef>
+#include <algorithm>
 #include <cstdio>
+#include <type_traits>
+#include <utility>
 
 #include <jpeglib.h>
 
@@ -43,6 +46,8 @@ namespace {
 // for VERDICT-r3 Weak #2 — where the IO budget actually goes. Thread
 // contention inflates wall-sum beyond elapsed x threads; ratios are what
 // matter.
+std::atomic<int64_t> g_read_ns{0};
+std::atomic<uint64_t> g_touch{0};  // defeats dead-code elim of page touches
 std::atomic<int64_t> g_decode_ns{0};
 std::atomic<int64_t> g_augment_ns{0};
 std::atomic<int64_t> g_records{0};
@@ -160,11 +165,94 @@ struct AugmentParams {
   const float* stdv;  // len 3 or null
 };
 
+// Sampling pass shared by the float32 (normalized) and uint8 (raw pixels)
+// output paths: virtual shorter-side resize + crop + mirror via one
+// separable-bilinear map over the decoded RGB buffer. OutT=float applies
+// the folded [0,1]-scale+mean/std affine; OutT=uint8_t rounds the blended
+// pixel straight back to 8 bits (normalize/cast move to the device-side
+// fused augment kernel — 1/4 the handoff bytes).
+template <typename OutT>
+void SamplePass(const uint8_t* src, int w, int h, int nw, int nh, int x0,
+                int y0, bool mirror, const AugmentParams& ap, OutT* dst) {
+  const float sx = static_cast<float>(w) / nw;
+  const float sy = static_cast<float>(h) / nh;
+  // fold [0,1] scaling and mean/std into one affine per channel:
+  // out = v_u8 * a[c] + b[c] (float output only)
+  const float inv255 = 1.0f / 255.0f;
+  float a[3], b[3];
+  for (int c = 0; c < 3; ++c) {
+    float mean_c = ap.mean ? ap.mean[c] : 0.f;
+    float istd_c = ap.stdv ? 1.f / ap.stdv[c] : 1.f;
+    a[c] = inv255 * istd_c;
+    b[c] = -mean_c * istd_c;
+  }
+
+  // separable bilinear: the x-mapping is row-invariant, so precompute the
+  // horizontal taps once; each output row then does one vectorizable
+  // vertical blend over the needed source span plus a 2-tap horizontal
+  // gather (≙ the reference's single-pass augmenter, but ~4x fewer flops
+  // per pixel than the naive 4-tap form)
+  std::vector<int> tx0(ap.out_w), tx1(ap.out_w);
+  std::vector<float> twx(ap.out_w);
+  int ix_lo = w, ix_hi = 0;
+  for (int x = 0; x < ap.out_w; ++x) {
+    float fx = (x0 + x + 0.5f) * sx - 0.5f;
+    if (fx < 0) fx = 0;
+    if (fx > w - 1) fx = static_cast<float>(w - 1);
+    int i0 = static_cast<int>(fx);
+    int i1 = i0 + 1 < w ? i0 + 1 : i0;
+    tx0[x] = i0;
+    tx1[x] = i1;
+    twx[x] = fx - i0;
+    if (i0 < ix_lo) ix_lo = i0;
+    if (i1 > ix_hi) ix_hi = i1;
+  }
+  const int span = (ix_hi - ix_lo + 1) * 3;
+  std::vector<float> vrow(span);
+  for (int y = 0; y < ap.out_h; ++y) {
+    float fy = (y0 + y + 0.5f) * sy - 0.5f;
+    if (fy < 0) fy = 0;
+    if (fy > h - 1) fy = static_cast<float>(h - 1);
+    int iy0 = static_cast<int>(fy);
+    int iy1 = iy0 + 1 < h ? iy0 + 1 : iy0;
+    float wy = fy - iy0;
+    const uint8_t* r0 = src + (static_cast<size_t>(iy0) * w + ix_lo) * 3;
+    const uint8_t* r1 = src + (static_cast<size_t>(iy1) * w + ix_lo) * 3;
+    float* vr = vrow.data();
+    if (wy == 0.f) {
+      for (int k = 0; k < span; ++k) vr[k] = r0[k];
+    } else {
+      const float cy = 1.f - wy;
+      for (int k = 0; k < span; ++k)
+        vr[k] = cy * r0[k] + wy * r1[k];
+    }
+    OutT* drow = dst + static_cast<size_t>(y) * ap.out_w * 3;
+    for (int x = 0; x < ap.out_w; ++x) {
+      int xo = mirror ? (ap.out_w - 1 - x) : x;
+      const float* p0 = vr + (tx0[x] - ix_lo) * 3;
+      const float* p1 = vr + (tx1[x] - ix_lo) * 3;
+      const float wx = twx[x], cx = 1.f - wx;
+      OutT* o = drow + xo * 3;
+      if constexpr (std::is_same<OutT, float>::value) {
+        o[0] = static_cast<OutT>((cx * p0[0] + wx * p1[0]) * a[0] + b[0]);
+        o[1] = static_cast<OutT>((cx * p0[1] + wx * p1[1]) * a[1] + b[1]);
+        o[2] = static_cast<OutT>((cx * p0[2] + wx * p1[2]) * a[2] + b[2]);
+      } else {
+        // blend of u8 values stays in [0,255]; +0.5f = round-to-nearest
+        o[0] = static_cast<OutT>(cx * p0[0] + wx * p1[0] + 0.5f);
+        o[1] = static_cast<OutT>(cx * p0[1] + wx * p1[1] + 0.5f);
+        o[2] = static_cast<OutT>(cx * p0[2] + wx * p1[2] + 0.5f);
+      }
+    }
+  }
+}
+
 // Full per-record pipeline: decode -> resize -> crop -> mirror ->
-// normalize into dst (out_h*out_w*3 float32 NHWC). Returns false if the
-// image failed to decode.
+// [normalize] into dst (out_h*out_w*3 NHWC, float32 normalized or raw
+// uint8). Returns false if the image failed to decode.
+template <typename OutT>
 bool ProcessOne(const uint8_t* payload, uint64_t len, const AugmentParams& ap,
-                uint64_t record_seed, float* dst, float* label_out,
+                uint64_t record_seed, OutT* dst, float* label_out,
                 int label_width) {
   if (len < static_cast<uint64_t>(kIRHeaderBytes)) return false;
   uint32_t flag;
@@ -201,11 +289,13 @@ bool ProcessOne(const uint8_t* payload, uint64_t len, const AugmentParams& ap,
 
   Rng rng(record_seed);
 
-  // Virtual shorter-side resize to `short_target` + crop + mirror +
-  // normalize, all in ONE sampling pass: output pixel (y, x) maps through
-  // crop offset and resize scale straight into decoded-image coordinates
-  // (half-pixel convention at both hops composes into one affine map), so
-  // no intermediate resized buffer is ever materialized.
+  // Virtual shorter-side resize to `short_target` + crop + mirror, all in
+  // ONE sampling pass: output pixel (y, x) maps through crop offset and
+  // resize scale straight into decoded-image coordinates (half-pixel
+  // convention at both hops composes into one affine map), so no
+  // intermediate resized buffer is ever materialized. The crop/mirror RNG
+  // consumption order here is the parity contract the Python augment-spec
+  // helper (io/_imagerec_common.py) replicates — change both together.
   int min_side = w < h ? w : h;
   float scale = static_cast<float>(short_target) / min_side;
   int nw = static_cast<int>(w * scale + 0.5f);
@@ -218,74 +308,72 @@ bool ProcessOne(const uint8_t* payload, uint64_t len, const AugmentParams& ap,
   int y0 = ap.rand_crop ? static_cast<int>(rng.below(max_y + 1)) : max_y / 2;
   bool mirror = ap.rand_mirror && (rng.next() & 1);
 
-  const float sx = static_cast<float>(w) / nw;
-  const float sy = static_cast<float>(h) / nh;
-  // fold [0,1] scaling and mean/std into one affine per channel:
-  // out = v_u8 * a[c] + b[c]
-  const float inv255 = 1.0f / 255.0f;
-  float a[3], b[3];
-  for (int c = 0; c < 3; ++c) {
-    float mean_c = ap.mean ? ap.mean[c] : 0.f;
-    float istd_c = ap.stdv ? 1.f / ap.stdv[c] : 1.f;
-    a[c] = inv255 * istd_c;
-    b[c] = -mean_c * istd_c;
-  }
-
-  // separable bilinear: the x-mapping is row-invariant, so precompute the
-  // horizontal taps once; each output row then does one vectorizable
-  // vertical blend over the needed source span plus a 2-tap horizontal
-  // gather (≙ the reference's single-pass augmenter, but ~4x fewer flops
-  // per pixel than the naive 4-tap form)
-  std::vector<int> tx0(ap.out_w), tx1(ap.out_w);
-  std::vector<float> twx(ap.out_w);
-  int ix_lo = w, ix_hi = 0;
-  for (int x = 0; x < ap.out_w; ++x) {
-    float fx = (x0 + x + 0.5f) * sx - 0.5f;
-    if (fx < 0) fx = 0;
-    if (fx > w - 1) fx = static_cast<float>(w - 1);
-    int i0 = static_cast<int>(fx);
-    int i1 = i0 + 1 < w ? i0 + 1 : i0;
-    tx0[x] = i0;
-    tx1[x] = i1;
-    twx[x] = fx - i0;
-    if (i0 < ix_lo) ix_lo = i0;
-    if (i1 > ix_hi) ix_hi = i1;
-  }
-  const int span = (ix_hi - ix_lo + 1) * 3;
-  std::vector<float> vrow(span);
-  const uint8_t* src = rgb.data();
-  for (int y = 0; y < ap.out_h; ++y) {
-    float fy = (y0 + y + 0.5f) * sy - 0.5f;
-    if (fy < 0) fy = 0;
-    if (fy > h - 1) fy = static_cast<float>(h - 1);
-    int iy0 = static_cast<int>(fy);
-    int iy1 = iy0 + 1 < h ? iy0 + 1 : iy0;
-    float wy = fy - iy0;
-    const uint8_t* r0 = src + (static_cast<size_t>(iy0) * w + ix_lo) * 3;
-    const uint8_t* r1 = src + (static_cast<size_t>(iy1) * w + ix_lo) * 3;
-    float* vr = vrow.data();
-    if (wy == 0.f) {
-      for (int k = 0; k < span; ++k) vr[k] = r0[k];
-    } else {
-      const float cy = 1.f - wy;
-      for (int k = 0; k < span; ++k)
-        vr[k] = cy * r0[k] + wy * r1[k];
-    }
-    float* drow = dst + static_cast<size_t>(y) * ap.out_w * 3;
-    for (int x = 0; x < ap.out_w; ++x) {
-      int xo = mirror ? (ap.out_w - 1 - x) : x;
-      const float* p0 = vr + (tx0[x] - ix_lo) * 3;
-      const float* p1 = vr + (tx1[x] - ix_lo) * 3;
-      const float wx = twx[x], cx = 1.f - wx;
-      float* o = drow + xo * 3;
-      o[0] = (cx * p0[0] + wx * p1[0]) * a[0] + b[0];
-      o[1] = (cx * p0[1] + wx * p1[1]) * a[1] + b[1];
-      o[2] = (cx * p0[2] + wx * p1[2]) * a[2] + b[2];
-    }
-  }
+  SamplePass<OutT>(rgb.data(), w, h, nw, nh, x0, y0, mirror, ap, dst);
   g_augment_ns.fetch_add(now_ns() - t1, std::memory_order_relaxed);
   g_records.fetch_add(1, std::memory_order_relaxed);
   return true;
+}
+
+// Batch runner shared by the f32 and u8 entry points: fan the records out
+// over the reader's thread pool, zero-fill failed slots, count failures.
+template <typename OutT>
+int64_t ReadBatch(Reader* r, const int64_t* indices, int64_t n,
+                  const AugmentParams& ap, uint64_t seed, OutT* out_images,
+                  float* out_labels, int label_width) {
+  if (!r || n < 0 || ap.out_h <= 0 || ap.out_w <= 0 || label_width <= 0)
+    return -1;
+  const size_t img_elems = static_cast<size_t>(ap.out_h) * ap.out_w * 3;
+  std::atomic<int64_t> done{0};
+  std::atomic<int64_t> failed{0};
+  std::mutex mu;
+  std::condition_variable cv;
+  for (int64_t i = 0; i < n; ++i) {
+    r->pool->Submit([=, &ap, &done, &failed, &mu, &cv] {
+      int64_t idx = indices[i];
+      OutT* dst = out_images + static_cast<size_t>(i) * img_elems;
+      float* lab = out_labels + static_cast<size_t>(i) * label_width;
+      bool ok = false;
+      if (idx >= 0 && idx < static_cast<int64_t>(r->records.size())) {
+        const Record& rec = r->records[idx];
+        const uint8_t* payload;
+        std::vector<uint8_t> tmp;
+        int64_t tr = now_ns();
+        if (!rec.chunked) {
+          payload = r->data + rec.offset + 8;
+          // fault the payload's pages IN here (one byte per 4KB page):
+          // without the touch the timed region is pointer arithmetic and
+          // cold-cache mmap faults land in decode_ns instead
+          uint64_t touch = 0;
+          for (uint64_t off = 0; off < rec.length; off += 4096)
+            touch += payload[off];
+          g_touch.fetch_add(touch, std::memory_order_relaxed);
+        } else {
+          tmp.resize(rec.length);
+          CopyRecord(r, rec, tmp.data());
+          payload = tmp.data();
+        }
+        // read stage = getting payload bytes in hand (mmap fault / chunk
+        // reassembly); cold-cache epochs show up here, hot epochs round
+        // to ~0 — the evidence ir_advise is judged by
+        g_read_ns.fetch_add(now_ns() - tr, std::memory_order_relaxed);
+        ok = ProcessOne<OutT>(payload, rec.length, ap,
+                              seed ^ (0x9e3779b97f4a7c15ull * (idx + 1)),
+                              dst, lab, label_width);
+      }
+      if (!ok) {
+        std::memset(dst, 0, img_elems * sizeof(OutT));
+        for (int k = 0; k < label_width; ++k) lab[k] = -1.f;
+        failed.fetch_add(1);
+      }
+      if (done.fetch_add(1) + 1 == n) {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.notify_one();
+      }
+    });
+  }
+  std::unique_lock<std::mutex> lk(mu);
+  cv.wait(lk, [&] { return done.load() == n; });
+  return failed.load();
 }
 
 }  // namespace
@@ -312,65 +400,86 @@ int64_t ir_read_batch(void* handle, const int64_t* indices, int64_t n,
                       int rand_mirror, uint64_t seed, const float* mean,
                       const float* stdv, float* out_images, float* out_labels,
                       int label_width) {
-  auto* r = static_cast<Reader*>(handle);
-  if (!r || n < 0 || out_h <= 0 || out_w <= 0 || label_width <= 0) return -1;
   AugmentParams ap{out_h, out_w, resize_min, rand_crop, rand_mirror,
                    seed, mean, stdv};
-  const size_t img_elems = static_cast<size_t>(out_h) * out_w * 3;
-  std::atomic<int64_t> done{0};
-  std::atomic<int64_t> failed{0};
-  std::mutex mu;
-  std::condition_variable cv;
-  for (int64_t i = 0; i < n; ++i) {
-    r->pool->Submit([=, &ap, &done, &failed, &mu, &cv] {
-      int64_t idx = indices[i];
-      float* dst = out_images + static_cast<size_t>(i) * img_elems;
-      float* lab = out_labels + static_cast<size_t>(i) * label_width;
-      bool ok = false;
-      if (idx >= 0 && idx < static_cast<int64_t>(r->records.size())) {
-        const Record& rec = r->records[idx];
-        const uint8_t* payload;
-        std::vector<uint8_t> tmp;
-        if (!rec.chunked) {
-          payload = r->data + rec.offset + 8;
-        } else {
-          tmp.resize(rec.length);
-          CopyRecord(r, rec, tmp.data());
-          payload = tmp.data();
-        }
-        ok = ProcessOne(payload, rec.length, ap,
-                        seed ^ (0x9e3779b97f4a7c15ull * (idx + 1)), dst, lab,
-                        label_width);
-      }
-      if (!ok) {
-        std::memset(dst, 0, img_elems * sizeof(float));
-        for (int k = 0; k < label_width; ++k) lab[k] = -1.f;
-        failed.fetch_add(1);
-      }
-      if (done.fetch_add(1) + 1 == n) {
-        std::unique_lock<std::mutex> lk(mu);
-        cv.notify_one();
-      }
-    });
-  }
-  std::unique_lock<std::mutex> lk(mu);
-  cv.wait(lk, [&] { return done.load() == n; });
-  return failed.load();
+  return ReadBatch<float>(static_cast<Reader*>(handle), indices, n, ap, seed,
+                          out_images, out_labels, label_width);
 }
 
-const char* ir_version() { return "incubator-mxnet-tpu-native-imagerec/1"; }
+// uint8 handoff variant: decode -> resize -> crop -> [mirror] straight to
+// raw uint8 NHWC pixels — normalize/cast happen on DEVICE in the fused
+// augment kernel, so the host hands off (and H2D moves) 1/4 the bytes.
+// Same per-record RNG stream as ir_read_batch: crop offsets (and mirror,
+// when requested here instead of on device) are bitwise identical across
+// the f32/u8 paths and across thread-pool/process workers.
+int64_t ir_read_batch_u8(void* handle, const int64_t* indices, int64_t n,
+                         int out_h, int out_w, int resize_min, int rand_crop,
+                         int rand_mirror, uint64_t seed, uint8_t* out_images,
+                         float* out_labels, int label_width) {
+  AugmentParams ap{out_h, out_w, resize_min, rand_crop, rand_mirror,
+                   seed, nullptr, nullptr};
+  return ReadBatch<uint8_t>(static_cast<Reader*>(handle), indices, n, ap,
+                            seed, out_images, out_labels, label_width);
+}
+
+// OS readahead for an upcoming batch: coalesce the records' byte ranges
+// (index-sorted) and issue posix_fadvise(WILLNEED) + madvise(WILLNEED) so
+// a cold-cache epoch streams sequential reads instead of faulting one
+// 4KB page per seek (≙ the reference prefetcher's sequential read pattern
+// over the .rec shard). Cheap enough to call per lookahead batch.
+void ir_advise(void* handle, const int64_t* indices, int64_t n) {
+  auto* r = static_cast<Reader*>(handle);
+  if (!r || n <= 0) return;
+  std::vector<std::pair<uint64_t, uint64_t>> ranges;
+  ranges.reserve(n);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t idx = indices[i];
+    if (idx < 0 || idx >= static_cast<int64_t>(r->records.size())) continue;
+    const Record& rec = r->records[idx];
+    ranges.emplace_back(rec.offset, rec.length + 16);
+  }
+  std::sort(ranges.begin(), ranges.end());
+  const uint64_t kGap = 1 << 16;  // merge ranges closer than 64KB
+  size_t w = 0;
+  for (size_t i = 1; i < ranges.size(); ++i) {
+    auto& cur = ranges[w];
+    if (ranges[i].first <= cur.first + cur.second + kGap) {
+      uint64_t end = ranges[i].first + ranges[i].second;
+      if (end > cur.first + cur.second) cur.second = end - cur.first;
+    } else {
+      ranges[++w] = ranges[i];
+    }
+  }
+  if (!ranges.empty()) ranges.resize(w + 1);
+  const long page = sysconf(_SC_PAGESIZE);
+  for (auto& rg : ranges) {
+    uint64_t off = rg.first, len = rg.second;
+    if (off + len > r->size) len = r->size > off ? r->size - off : 0;
+    if (!len) continue;
+    posix_fadvise(r->fd, static_cast<off_t>(off), static_cast<off_t>(len),
+                  POSIX_FADV_WILLNEED);
+    uint64_t aoff = off & ~static_cast<uint64_t>(page - 1);
+    madvise(const_cast<uint8_t*>(r->data) + aoff, len + (off - aoff),
+            MADV_WILLNEED);
+  }
+}
+
+const char* ir_version() { return "incubator-mxnet-tpu-native-imagerec/2"; }
 
 // Per-stage accumulated wall time across pool threads since the last
-// reset: separates JPEG decode from the fused resize/crop/mirror/normalize
-// pass so the decode-bound claim is measurable, not asserted.
-void ir_stage_stats(int64_t* decode_ns, int64_t* augment_ns,
+// reset: separates record-byte READ (mmap fault/chunk reassembly) and JPEG
+// decode from the fused resize/crop/mirror[/normalize] pass so the
+// decode-bound claim is measurable, not asserted.
+void ir_stage_stats(int64_t* read_ns, int64_t* decode_ns, int64_t* augment_ns,
                     int64_t* records) {
+  if (read_ns) *read_ns = g_read_ns.load();
   if (decode_ns) *decode_ns = g_decode_ns.load();
   if (augment_ns) *augment_ns = g_augment_ns.load();
   if (records) *records = g_records.load();
 }
 
 void ir_stage_reset() {
+  g_read_ns.store(0);
   g_decode_ns.store(0);
   g_augment_ns.store(0);
   g_records.store(0);

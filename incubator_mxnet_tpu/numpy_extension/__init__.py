@@ -213,6 +213,10 @@ fused_bias_act = _make_fused("bias_act", "fused_bias_act")
 fused_norm_act_residual = _make_fused("norm_act_residual",
                                       "fused_norm_act_residual")
 fused_bn_inference = _make_fused("bn_inference", "fused_bn_inference")
+# device half of the uint8 input-pipeline handoff (crop/flip/normalize/
+# cast as ONE batched kernel; ImageRecordIter device_augment mode and the
+# DeviceFeed staging path call this) — jnp-only, no Pallas variant
+fused_image_augment = _make_fused("image_augment", "fused_image_augment")
 
 
 def fused_avg_pool2d(data, pool_size, layout="NHWC"):
@@ -322,7 +326,7 @@ del _kn, _k
 
 __all__ += ["fused_bias_act", "fused_norm_act_residual",
             "fused_bn_inference", "fused_avg_pool2d", "fused_batch_norm",
-            "flash_attention"]
+            "fused_image_augment", "flash_attention"]
 
 
 def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, **kwargs):

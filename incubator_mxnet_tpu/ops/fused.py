@@ -58,8 +58,9 @@ from ..telemetry.registry import stats_group as _stats_group
 from . import pallas_kernels as _pk
 
 __all__ = ["bias_act", "norm_act_residual", "bn_inference", "batch_norm",
-           "avg_pool2d", "bias_act_ref", "norm_act_residual_ref",
-           "bn_inference_ref", "avg_pool2d_ref", "fusion_scope",
+           "avg_pool2d", "image_augment", "bias_act_ref",
+           "norm_act_residual_ref", "bn_inference_ref", "avg_pool2d_ref",
+           "fusion_scope",
            "fusion_enabled", "set_fusion_default", "set_use_fusion",
            "set_interpret", "fused_stats", "FUSED_STATS", "FUSABLE_ACTS"]
 
@@ -68,6 +69,7 @@ FUSABLE_ACTS = _pk.ACTS
 FUSED_STATS = _stats_group("fused", {
     "pallas_calls": 0,       # dispatches that took a Pallas kernel path
     "fallback_calls": 0,     # dispatches served by the jnp composition
+    "device_augment_calls": 0,  # image_augment programs built (per trace)
 })
 _STATS = FUSED_STATS
 
@@ -435,6 +437,58 @@ def batch_norm(x, gamma, beta, running_mean, running_var, momentum=0.9,
     return out, new_rm, new_rv
 
 
+def image_augment(images, key, mean=None, std=None, crop_hw=None,
+                  rand_mirror=False, out_dtype="float32", interpret=None):
+    """Device-side half of the input pipeline as ONE jitted batched kernel:
+    optional per-image random crop (when the staged images are larger than
+    `crop_hw`), optional per-image horizontal mirror, [0,1] scale +
+    per-channel mean/std normalize, cast — the work `ImageRecordIter`'s
+    float32 path used to burn host cores on (uint8 handoff moves it here,
+    behind the 4x-smaller H2D transfer).
+
+    `images`: (N, H, W, 3) NHWC — uint8 raw pixels (scaled by 1/255) or a
+    float array already in [0, 1] (gradients flow through the affine for
+    float inputs; the crop/mirror randomness does not block them).
+    `key`: PRNGKey DATA as a uint32 (2,) array — an array argument, not a
+    static seed, so per-(epoch, batch) keys swap without a retrace (the
+    zero-retrace contract io_bench asserts). `mean`/`std` are static
+    per-channel tuples in [0, 1] units; `crop_hw`/`rand_mirror`/`out_dtype`
+    are static too.
+
+    jnp-only by design: every stage is pointwise/slice-shaped and XLA
+    fuses the chain into one kernel on any backend — there is no separate
+    Pallas path, so `interpret` is accepted for tier uniformity and
+    ignored. Counted per program build in `fused.device_augment_calls`
+    (inside jit the body runs at trace time only)."""
+    import jax
+    jnp = _jnp()
+    _STATS["device_augment_calls"] += 1
+    x = images
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        x = x.astype(jnp.float32) * (1.0 / 255.0)
+    elif x.dtype != jnp.float32:
+        x = x.astype(jnp.float32)
+    kc, km = jax.random.split(jnp.asarray(key))
+    if crop_hw is not None:
+        ch, cw = int(crop_hw[0]), int(crop_hw[1])
+        n, h, w = x.shape[0], x.shape[1], x.shape[2]
+        if (h, w) != (ch, cw):
+            ky, kx = jax.random.split(kc)
+            y0 = jax.random.randint(ky, (n,), 0, h - ch + 1)
+            x0 = jax.random.randint(kx, (n,), 0, w - cw + 1)
+            x = jax.vmap(
+                lambda img, yy, xx: jax.lax.dynamic_slice(
+                    img, (yy, xx, 0), (ch, cw, 3)))(x, y0, x0)
+    if rand_mirror:
+        flips = jax.random.bernoulli(km, 0.5, (x.shape[0],))
+        x = jnp.where(flips[:, None, None, None], x[:, :, ::-1, :], x)
+    if mean is not None:
+        x = x - jnp.asarray(mean, jnp.float32)
+    if std is not None:
+        x = x / jnp.asarray(std, jnp.float32)
+    return x.astype(out_dtype)
+
+
 # bounded: the key includes the pooled SHAPE, and each entry pins a
 # custom_vjp callable whose identity also keys jax's compiled-program
 # caches — unbounded growth under variable-resolution workloads (same
@@ -498,6 +552,6 @@ def avg_pool2d(x, pool_size, layout="NHWC", interpret=None):
 # family, pinned f32 like ops.nn.batch_norm. Pooling matches nn.pooling.
 for _f, _cls in ((bias_act, "safe"), (norm_act_residual, "unsafe"),
                  (bn_inference, "unsafe"), (batch_norm, "unsafe"),
-                 (avg_pool2d, "safe")):
+                 (avg_pool2d, "safe"), (image_augment, "neutral")):
     _f._amp_class = _cls
 del _f, _cls

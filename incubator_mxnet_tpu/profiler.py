@@ -167,6 +167,21 @@ def feed_stats(reset=False):
     return _fs(reset=reset)
 
 
+def io_stats(reset=False):
+    """Counters from the ImageRecordIter decode pipeline (io/__init__.py +
+    io/imagerec_pool.py): batches/images delivered, corrupt records
+    zero-filled, consumer staging vs waited-on-decode time, host bytes
+    handed to `device_put` (the uint8-handoff 4x reduction shows up
+    here), device-augment batches, slot-aliasing defensive copies, and
+    submit/worker restart counts — plus the native decoder's per-stage
+    clocks (read/decode/augment ns + decoded records, mirrored into the
+    telemetry registry as `io.imagerec.*` gauges). Always on, like
+    dispatch_stats(); `reset=True` zeroes both after the snapshot. See
+    docs/PERF.md "Input pipeline"."""
+    from .io import io_stats as _ios
+    return _ios(reset=reset)
+
+
 def fused_stats(reset=False):
     """Counters from the fused kernel tier (ops/fused.py): dispatches
     that took a Pallas kernel path (`pallas_calls`) vs the jnp

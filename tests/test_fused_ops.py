@@ -307,7 +307,8 @@ def test_fused_stats_counters_move():
     assert snap["fallback_calls"] == 1
     from incubator_mxnet_tpu import profiler
     assert set(profiler.fused_stats()) == {"pallas_calls",
-                                           "fallback_calls"}
+                                           "fallback_calls",
+                                           "device_augment_calls"}
 
 
 def test_set_interpret_toggle_not_served_stale_programs():

@@ -183,12 +183,7 @@ def test_python_fallback_parity(tmp_path):
     it = mxio.ImageRecordIter(path_imgrec=str(p), data_shape=(3, 24, 24),
                               batch_size=3, shuffle=False)
     native_batch = next(iter(it))
-    # force the fallback
-    from incubator_mxnet_tpu.gluon.data.vision.datasets import (
-        ImageRecordDataset)
-    it._native = None
-    it._pyds = ImageRecordDataset(str(p))
-    it.reset()
+    it._force_python_fallback()
     py_batch = next(iter(it))
     assert py_batch.data[0].shape == native_batch.data[0].shape
     np.testing.assert_allclose(py_batch.label[0].asnumpy(),

@@ -40,6 +40,18 @@ TREND_KEYS = {
     "eager_tape_images_per_sec_bs32": "higher",
     "infer_images_per_sec_bs32_bf16": "higher",
     "io_pipeline_images_per_sec": "higher",
+    # io phase uint8 fast path (PR 9): pool throughput must not regress,
+    # the handoff must keep moving fewer host->device bytes per image,
+    # and the uint8 path's decode share should only rise (decode is the
+    # irreducible stage — a falling share means pipeline overhead crept
+    # back in around it)
+    "io_images_per_sec_uint8": "higher",
+    # the uint8 run's bytes/img is the real handoff gate (a silently
+    # broken uint8 path reverts it 150528 -> 602112); the f32 key is a
+    # shape-derived constant and rides along for the record only
+    "io_host_bytes_per_img_uint8": "lower",
+    "io_host_bytes_per_img": "lower",
+    "io_stage_decode_share": "higher",
     "input_pipeline_speedup": "higher",
     "serve_requests_per_sec_c32": "higher",
     "mfu_bs32": "higher",
@@ -256,6 +268,23 @@ def self_test():
                                    fused_step_images_per_sec=700.0,
                                    fused_step_mfu=0.40))
     check("improving fused_step keys pass with improvements reported",
+          rep["status"] == "ok" and len(rep["improvements"]) == 2)
+    # io uint8 fast-path keys (PR 9): falling pool throughput, RISING
+    # host->device bytes/img, or a falling decode share gates the trend
+    io_base = {"backend_ok": True, "io_images_per_sec_uint8": 2000.0,
+               "io_host_bytes_per_img_uint8": 150528.0,
+               "io_stage_decode_share": 0.60}
+    rep = compare(io_base, dict(io_base, io_images_per_sec_uint8=1500.0,
+                                io_host_bytes_per_img_uint8=602112.0,
+                                io_stage_decode_share=0.40))
+    check("uint8 io keys regress on drop/bytes-rise/share-fall",
+          rep["status"] == "regression"
+          and {r["key"] for r in rep["regressions"]}
+          == {"io_images_per_sec_uint8", "io_host_bytes_per_img_uint8",
+              "io_stage_decode_share"})
+    rep = compare(io_base, dict(io_base, io_images_per_sec_uint8=3000.0,
+                                io_host_bytes_per_img_uint8=110000.0))
+    check("improving uint8 io keys pass with improvements reported",
           rep["status"] == "ok" and len(rep["improvements"]) == 2)
     missing_only_new = {"backend_ok": True,
                         "io_pipeline_images_per_sec": 700.0}
