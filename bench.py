@@ -419,6 +419,36 @@ def bench_input_pipeline():
         return None
 
 
+def bench_elastic(quick=False):
+    """Elastic ZeRO-trainer trend row (subprocess: the measurement runs on
+    a CPU-forced 8-device virtual mesh regardless of the attached chip —
+    see benchmark/elastic_bench.py). Returns the bench JSON dict or
+    None."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            out = os.path.join(d, "elastic.json")
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env.pop("XLA_FLAGS", None)   # the bench forces its own 8-dev
+            cmd = [sys.executable,
+                   os.path.join(here, "benchmark", "elastic_bench.py"),
+                   "--out", out]
+            if quick:
+                cmd.append("--quick")
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=600, cwd=here, env=env)
+            if r.returncode != 0:
+                return None
+            with open(out) as f:
+                return json.load(f)
+    except Exception:
+        return None
+
+
 def bench_serve():
     """Serving-path trend row (subprocess: serve_bench forces CPU — the
     metric is request-level host throughput, concurrency 32). Returns the
@@ -657,6 +687,20 @@ def bench_fused_train(model="resnet18", batch_size=32, iters=12, warmup=4,
     return batch_size * iters / dt, flops, retraces
 
 
+def _phase_elastic(quick=False):
+    r = bench_elastic(quick=quick)
+    if r is None:
+        return {}
+    out = {}
+    for k in ("elastic_mem_per_replica_mb", "elastic_overlap_fraction",
+              "elastic_resume_latency_ms",
+              "elastic_rescale_resume_latency_ms",
+              "elastic_mem_linearity", "elastic_steps_per_sec"):
+        if k in r:
+            out[k] = r[k]
+    return out
+
+
 def _phase_fused_sweep(tiny=False):
     """Kernel-tier policy sweep (ROADMAP item 2 close-out): ResNet-18
     FusedTrainStep with the fused op tier ON, swept over the remat x
@@ -795,6 +839,7 @@ PHASES = [
     ("io", _phase_io),
     ("input_pipeline", _phase_input_pipeline),
     ("serve", _phase_serve),
+    ("elastic", _phase_elastic),
     ("offenders", _phase_offenders),
     ("fused_sweep", _phase_fused_sweep),
     ("calib", _phase_calib),
@@ -832,12 +877,19 @@ def _phase_fused_sweep_quick():
     return _phase_fused_sweep(tiny=True)
 
 
+def _phase_elastic_quick():
+    # same keys, small MLP + 6 steps: the tier-1 smoke exercises the full
+    # trainer + checkpoint/resume/rescale path on the 8-device CPU mesh
+    return _phase_elastic(quick=True)
+
+
 QUICK_PHASES = {
     "dispatch": _phase_dispatch_quick,
     "train32": _phase_train32_quick,
     "infer": _phase_infer_quick,
     "offenders": _phase_offenders_quick,
     "fused_sweep": _phase_fused_sweep_quick,
+    "elastic": _phase_elastic_quick,
 }
 
 # Per-phase subprocess timeouts, seconds. MXNET_BENCH_PHASE_TIMEOUT (one
@@ -845,7 +897,8 @@ QUICK_PHASES = {
 PHASE_TIMEOUTS = {
     "dispatch": 300, "eager": 900, "train32": 1500, "train128": 1500,
     "infer": 900, "io": 700, "input_pipeline": 700, "serve": 700,
-    "offenders": 700, "fused_sweep": 2000, "calib": 900, "xla_flops": 600,
+    "elastic": 700, "offenders": 700, "fused_sweep": 2000, "calib": 900,
+    "xla_flops": 600,
 }
 PHASE_TIMEOUT_DEFAULT_S = 900
 

@@ -200,6 +200,10 @@ _register_env("MXNET_PREFETCH_TO_DEVICE", bool, False,
 _register_env("MXNET_DEVICE_FEED_DEPTH", int, 2,
               "io.DeviceFeed buffer depth (batches staged ahead; "
               "2 = double buffering)")
+_register_env("MXNET_KVSTORE_BARRIER_TIMEOUT", float, None,
+              "Seconds before a dist kvstore barrier aborts with a typed "
+              "BarrierTimeout naming the missing ranks instead of "
+              "hanging on a dead peer")
 _register_env("MXNET_KV_BARRIER_TIMEOUT", float, None,
-              "Seconds before a dist kvstore barrier aborts with "
-              "WatchdogTimeout instead of hanging on a dead peer")
+              "Legacy alias for MXNET_KVSTORE_BARRIER_TIMEOUT "
+              "(consulted when the new knob is unset)")

@@ -28,7 +28,27 @@ from . import fault as _fault
 
 __all__ = ["save_checkpoint", "load_checkpoint", "save_sharded",
            "load_sharded", "rescale_sharded", "latest_step", "latest_entry",
-           "commit_step", "MANIFEST_NAME"]
+           "commit_step", "MANIFEST_NAME", "Repartition"]
+
+
+class Repartition:
+    """`rescale_sharded` spec leaf for ZeRO-style ``(dp, L)`` shard views
+    (optimizer/master-copy shards from `optimizer.sharded`): instead of
+    resharding the saved shape onto the new mesh — impossible when the dp
+    size changed, because the LEADING AXIS of the saved array is the old
+    dp — the leaf is restored replicated, its `numel` true elements are
+    re-padded and re-sliced onto the target mesh's `axis` size, and the
+    result lands sharded ``P(axis, None)``. Dtype-preserving; uneven
+    counts (dp=3 -> 2) round-trip exactly because padding is recomputed."""
+
+    __slots__ = ("numel", "axis")
+
+    def __init__(self, numel, axis="dp"):
+        self.numel = int(numel)
+        self.axis = axis
+
+    def __repr__(self):
+        return f"Repartition(numel={self.numel}, axis={self.axis!r})"
 
 
 def _flatten(tree, prefix=""):
@@ -179,18 +199,24 @@ def _remove_entry_payload(directory, entry):
         pass  # retention GC is best-effort; the manifest entry is gone
 
 
-def commit_step(directory, step, kind="sharded", path=None, keep_last=None):
+def commit_step(directory, step, kind="sharded", path=None, keep_last=None,
+                extra=None):
     """Record `step` as COMMITTED in the directory manifest (atomically),
     then apply the `keep_last` retention policy: entries beyond the newest
     N are dropped from the manifest first and their payloads deleted after,
     so a crash mid-GC can only leave orphans, never a manifest pointing at
-    deleted data. Returns the manifest."""
+    deleted data. `extra` (JSON-safe dict) rides on the entry — run
+    counters, RNG state, elastic shard metadata — and commits atomically
+    WITH the step, so a resume sees counters exactly as of the restored
+    checkpoint, never newer. Returns the manifest."""
     directory = os.path.abspath(directory)
     _gc_partials(directory)  # orphans from saves that died pre-commit
     manifest = _read_manifest(directory) or {"version": 1, "committed": []}
     entries = [e for e in manifest["committed"] if e["step"] != step]
-    entries.append({"step": int(step), "kind": kind,
-                    "path": path or str(step)})
+    entry = {"step": int(step), "kind": kind, "path": path or str(step)}
+    if extra is not None:
+        entry["extra"] = extra
+    entries.append(entry)
     entries.sort(key=lambda e: e["step"])
     evicted = []
     if keep_last is not None and keep_last > 0 and len(entries) > keep_last:
@@ -231,7 +257,7 @@ def _is_proc0():
         return True
 
 
-def save_sharded(directory, tree, step=0, keep_last=None):
+def save_sharded(directory, tree, step=0, keep_last=None, extra=None):
     """Save a pytree of (possibly mesh-sharded) jax arrays; each host writes
     its own shards (orbax). Use for pjit/SPMD training state.
 
@@ -266,7 +292,8 @@ def save_sharded(directory, tree, step=0, keep_last=None):
             shutil.rmtree(path)
         os.replace(tmp, path)
         _fault.fsync_dir(directory)
-        commit_step(directory, step, kind="sharded", keep_last=keep_last)
+        commit_step(directory, step, kind="sharded", keep_last=keep_last,
+                    extra=extra)
     return path
 
 
@@ -335,7 +362,12 @@ def rescale_sharded(directory, mesh, specs, step=None):
     specs: a pytree of jax.sharding.PartitionSpec congruent with the
     saved tree (None leaves mean replicated). Shapes/dtypes come from the
     checkpoint's own metadata, so no model construction is needed before
-    restore. Returns (tree_of_resharded_arrays, step).
+    restore. A `Repartition(numel, axis)` spec leaf marks a ZeRO-style
+    ``(dp, L)`` optimizer/master shard view: it is restored replicated and
+    RE-PARTITIONED onto the target mesh's `axis` size (the saved leading
+    axis is the OLD dp — a plain reshard cannot change it), landing
+    sharded ``P(axis, None)`` with padding recomputed and dtype preserved.
+    Returns (tree_of_resharded_arrays, step).
     """
     import jax
     import jax.tree_util as jtu
@@ -351,11 +383,32 @@ def rescale_sharded(directory, mesh, specs, step=None):
     meta = getattr(meta, "tree", meta)
 
     def build_target(m, spec):
-        if spec is None:
+        if isinstance(spec, Repartition):
+            # load the old (dp_old, L_old) view replicated; the real
+            # re-slice onto the new dp happens after restore
+            if spec.axis not in mesh.shape:
+                raise MXNetError(
+                    f"Repartition axis {spec.axis!r} not in mesh "
+                    f"{dict(mesh.shape)}")
+            if spec.numel > int(_np.prod(m.shape or (1,))):
+                raise MXNetError(
+                    f"Repartition numel {spec.numel} exceeds the saved "
+                    f"leaf's size {tuple(m.shape)}")
+            spec = PartitionSpec()
+        elif spec is None:
             spec = PartitionSpec()
         return jax.ShapeDtypeStruct(
             tuple(m.shape), m.dtype,
             sharding=NamedSharding(mesh, spec))
+
+    def apply_repartition(arr, spec):
+        if not isinstance(spec, Repartition):
+            return arr
+        from .optimizer.sharded import repartition
+        dp_new = int(mesh.shape[spec.axis])
+        view = repartition(_np.asarray(arr), spec.numel, dp_new)
+        return jax.device_put(
+            view, NamedSharding(mesh, PartitionSpec(spec.axis, None)))
 
     def fill_missing(m, spec):
         """Dict specs may omit entries (treated as replicated); other
@@ -390,4 +443,5 @@ def rescale_sharded(directory, mesh, specs, step=None):
     specs = fill_missing(meta, specs)
     target = jtu.tree_map(build_target, meta, specs)
     tree, _ = load_sharded(directory, step=step, target=target)
+    tree = jtu.tree_map(apply_repartition, tree, specs)
     return tree, step

@@ -56,9 +56,23 @@ __all__ = [
     "inject", "active_rules",
     "retrying", "watchdog", "atomic_output", "fsync_dir",
     "loss_is_finite", "run_resilient", "ResilientRun",
+    "rng_state_encode", "rng_state_restore",
 ]
 
 logger = logging.getLogger("mxnet.fault")
+
+
+def __getattr__(name):
+    # lazy submodule: `fault.elastic` pulls in kvstore/optimizer/parallel,
+    # far too heavy for the bare fault-injection import path
+    # (importlib, not `from . import`: the fromlist probe re-enters this
+    # __getattr__ while the submodule is mid-initialization)
+    if name == "elastic":
+        import importlib
+        mod = importlib.import_module(".elastic", __name__)
+        globals()["elastic"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 # Injection points wired into the stack (call sites register themselves here
 # implicitly by calling inject(); this table documents the stable names).
@@ -83,6 +97,16 @@ POINTS = {
     "resilient.step": "run_resilient, inside the watchdog around step_fn",
     "resilient.loss": "run_resilient, applied to the returned loss "
                       "(nan kind poisons it)",
+    "kvstore.reduce_scatter": "bucketed dp-axis reduce-scatter, before "
+                              "each bucket dispatch (the ZeRO gradient "
+                              "path)",
+    "kvstore.allgather": "bucketed dp-axis all-gather, before each bucket "
+                         "dispatch (the ZeRO parameter reassembly)",
+    "elastic.resume": "ElasticTrainer.resume entry, before the checkpoint "
+                      "restore / shard repartition",
+    "elastic.step": "run_elastic, before each trainer step",
+    "elastic.loss": "run_elastic, applied to the step loss (nan kind "
+                    "poisons it)",
 }
 
 _KINDS = ("ioerror", "oserror", "error", "timeout", "nan", "stall", "kill")
@@ -433,6 +457,74 @@ def loss_is_finite(loss):
 # ---------------------------------------------------------------------------
 # auto-resume driver
 # ---------------------------------------------------------------------------
+def _jsonify_rng_leaf(v):
+    """Recursively make a bit_generator.state tree JSON-safe: ndarray
+    leaves (MT19937's 624-word key, Philox counters) become tagged
+    base64 blobs; everything else PCG64-style plain ints/strs."""
+    import base64
+    import numpy as _np
+    if isinstance(v, dict):
+        return {k: _jsonify_rng_leaf(x) for k, x in v.items()}
+    if isinstance(v, _np.ndarray):
+        return {"__nd__": base64.b64encode(v.tobytes()).decode("ascii"),
+                "dtype": str(v.dtype), "shape": list(v.shape)}
+    if isinstance(v, _np.integer):
+        return int(v)
+    return v
+
+
+def _unjsonify_rng_leaf(v):
+    import base64
+    import numpy as _np
+    if isinstance(v, dict):
+        if "__nd__" in v:
+            return _np.frombuffer(
+                base64.b64decode(v["__nd__"]),
+                dtype=_np.dtype(v["dtype"])).reshape(v["shape"]).copy()
+        return {k: _unjsonify_rng_leaf(x) for k, x in v.items()}
+    return v
+
+
+def rng_state_encode(rng):
+    """JSON-safe snapshot of a numpy RNG (RandomState, or Generator over
+    ANY bit generator — MT19937/Philox array states are base64-tagged),
+    for the checkpoint manifest. None passes through."""
+    if rng is None:
+        return None
+    import base64
+    import numpy as _np
+    if hasattr(rng, "bit_generator"):      # np.random.Generator
+        return {"kind": "generator",
+                "state": _jsonify_rng_leaf(rng.bit_generator.state)}
+    name, keys, pos, has_gauss, cached = rng.get_state()
+    return {"kind": "randomstate", "name": name,
+            "keys": base64.b64encode(
+                _np.asarray(keys, dtype=_np.uint32).tobytes())
+            .decode("ascii"),
+            "pos": int(pos), "has_gauss": int(has_gauss),
+            "cached": float(cached)}
+
+
+def rng_state_restore(rng, snap):
+    """Restore a `rng_state_encode` snapshot into the SAME kind of RNG."""
+    if rng is None or snap is None:
+        return
+    import base64
+    import numpy as _np
+    if snap["kind"] == "generator":
+        if not hasattr(rng, "bit_generator"):
+            raise MXNetError("checkpoint holds np.random.Generator state "
+                             "but a RandomState was passed")
+        rng.bit_generator.state = _unjsonify_rng_leaf(snap["state"])
+        return
+    if hasattr(rng, "bit_generator"):
+        raise MXNetError("checkpoint holds RandomState state but a "
+                         "Generator was passed")
+    keys = _np.frombuffer(base64.b64decode(snap["keys"]), dtype=_np.uint32)
+    rng.set_state((snap["name"], keys, snap["pos"], snap["has_gauss"],
+                   snap["cached"]))
+
+
 class ResilientRun:
     """Result of run_resilient: final state + step + failure accounting."""
 
@@ -473,7 +565,7 @@ def run_resilient(step_fn, state, ckpt_dir, num_steps, *, ckpt_every=10,
                   mesh=None, specs=None, sharded=True, device=None,
                   max_step_retries=2, retry_backoff=0.05,
                   retry_on=(IOError, OSError, TimeoutError),
-                  ckpt_retries=3):
+                  ckpt_retries=3, rng=None):
     """Run `num_steps` of `step_fn(state, step) -> (state, loss)` with
     crash-consistent checkpoints every `ckpt_every` steps and automatic
     resume from the newest COMMITTED checkpoint in `ckpt_dir`.
@@ -501,30 +593,54 @@ def run_resilient(step_fn, state, ckpt_dir, num_steps, *, ckpt_every=10,
     (orbax, mesh-sharded jax pytrees); `sharded=False` uses the host-local
     npz format for plain dict-of-array state. Both commit through the
     manifest protocol, so a crash mid-save never loses the previous
-    checkpoint. Returns a ResilientRun.
+    checkpoint.
+
+    Crash-consistent accounting: `skipped_nonfinite` / `step_retries`
+    counters — and the state of `rng` (a numpy RandomState/Generator the
+    step_fn draws from), when one is passed — are persisted in each
+    committed manifest entry and restored on resume, so a SIGKILL cannot
+    reset the skip count or replay different random draws than the
+    uninterrupted run would have made. Returns a ResilientRun.
     """
     from .. import checkpoint as ckpt
 
     run = ResilientRun()
-    completed = ckpt.latest_step(ckpt_dir)
-    if completed is not None:
+    entry = ckpt.latest_entry(ckpt_dir)
+    if entry is not None:
+        completed = entry["step"]
         state = _restore(ckpt_dir, completed, mesh, specs, sharded, device)
         run.resumed_from = completed
+        saved = (entry.get("extra") or {}).get("resilient") or {}
+        run.skipped_nonfinite = int(saved.get("skipped_nonfinite", 0))
+        run.step_retries = int(saved.get("step_retries", 0))
+        rng_state_restore(rng, saved.get("rng"))
         _log_event("resilient.resumed", dir=ckpt_dir, step=completed,
-                   rescaled=mesh is not None)
+                   rescaled=mesh is not None,
+                   skipped_nonfinite=run.skipped_nonfinite,
+                   step_retries=run.step_retries,
+                   rng_restored=rng is not None
+                   and saved.get("rng") is not None)
     else:
         completed = 0
+
+    def _run_extra():
+        ex = {"skipped_nonfinite": run.skipped_nonfinite,
+              "step_retries": run.step_retries}
+        if rng is not None:
+            ex["rng"] = rng_state_encode(rng)
+        return {"resilient": ex}
 
     def _save(st, step_no):
         if sharded:
             ckpt.save_sharded(ckpt_dir, st, step=step_no,
-                              keep_last=keep_last)
+                              keep_last=keep_last, extra=_run_extra())
         else:
             name = f"ckpt-{step_no}"
             ckpt.save_checkpoint(os.path.join(ckpt_dir, name), st,
                                  step=step_no)
             ckpt.commit_step(ckpt_dir, step_no, kind="npz",
-                             path=name + ".npz", keep_last=keep_last)
+                             path=name + ".npz", keep_last=keep_last,
+                             extra=_run_extra())
         run.saved_steps.append(step_no)
         _log_event("resilient.saved", dir=ckpt_dir, step=step_no)
 

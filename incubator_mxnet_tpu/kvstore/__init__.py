@@ -28,6 +28,7 @@ from __future__ import annotations
 import pickle
 import threading
 import time as _time
+from collections import OrderedDict
 
 import numpy as _np
 
@@ -35,37 +36,272 @@ from ..base import MXNetError, get_env
 from .. import fault as _fault
 from ..telemetry.registry import stats_group as _stats_group
 
-__all__ = ["KVStore", "KVStoreBase", "create", "KV_STATS"]
+__all__ = ["KVStore", "KVStoreBase", "create", "KV_STATS",
+           "BarrierTimeout", "reduce_scatter_buckets", "allgather_buckets"]
 
 # Collective timings for step-timeline attribution (telemetry.StepTimeline
 # diffs allreduce_us around each train step — the distributed analog of the
-# DeviceFeed stall clock). Increments under _KV_STATS_LOCK; `allreduce_us`
-# is DISPATCH-side wall time of the bucketed collective (concatenate +
-# collective issue + result split) — buckets dispatch asynchronously, so
-# device-side reduction overlap is measured by benchmark/overlap_bench.py,
-# not here.
+# DeviceFeed stall clock). Increments under _KV_STATS_LOCK; the `*_us`
+# clocks are DISPATCH-side wall time of the bucketed collective
+# (concatenate + collective issue + result split) — buckets dispatch
+# asynchronously, so device-side reduction overlap is measured by
+# benchmark/overlap_bench.py and benchmark/elastic_bench.py, not here.
 _KV_STATS_LOCK = threading.Lock()
 
 KV_STATS = _stats_group("kvstore", {
     "allreduce_us": 0.0,       # wall time inside bucketed-collective calls
     "allreduce_buckets": 0,    # collective buckets dispatched
     "allreduce_bytes": 0,      # payload bytes across those buckets
+    "reduce_scatter_us": 0.0,  # wall time inside bucketed reduce-scatter
+    "reduce_scatter_buckets": 0,
+    "reduce_scatter_bytes": 0,
+    "allgather_us": 0.0,       # wall time inside bucketed all-gather
+    "allgather_buckets": 0,
+    "allgather_bytes": 0,
 }, lock=_KV_STATS_LOCK,
     help="kvstore collective timings (telemetry step-timeline attribution)")
 
 
-def _note_allreduce(t0, nbytes, keys):
-    """One collective bucket dispatched at perf_counter seconds `t0`:
-    advance the KV_STATS clocks and record the `kv.allreduce` span lane —
-    the single implementation both collective paths share."""
+# process-wide barrier sequence: two KVStore instances in one process
+# must never reuse a sequence number, or their arrival announcements
+# would collide in the coordinator KV store and corrupt attribution.
+# (Ranks agree on numbers through the usual SPMD discipline — every
+# process makes the same barrier calls in the same order; a lone rank
+# restarting mid-job is not a supported barrier mode, whole-job restart
+# gets a fresh coordinator store.)
+_BARRIER_SEQ_LOCK = threading.Lock()
+_BARRIER_SEQ = [0]
+
+
+def _next_barrier_seq():
+    with _BARRIER_SEQ_LOCK:
+        _BARRIER_SEQ[0] += 1
+        return _BARRIER_SEQ[0]
+
+
+class BarrierTimeout(MXNetError):
+    """A kvstore barrier rendezvous exceeded its deadline. `missing_ranks`
+    names the peers that provably never announced their arrival (empty when
+    no coordinator KV store is available to attribute the stall)."""
+
+    def __init__(self, message, missing_ranks=None):
+        super().__init__(message)
+        self.missing_ranks = list(missing_ranks or [])
+
+
+def _note_collective(kind, t0, nbytes, keys):
+    """One collective bucket of `kind` (allreduce / reduce_scatter /
+    allgather) dispatched at perf_counter seconds `t0`: advance the
+    KV_STATS clocks and record the `kv.<kind>` span lane — the single
+    implementation every bucketed collective path shares."""
     from ..telemetry import record_span
     dur_us = (_time.perf_counter() - t0) * 1e6
     with _KV_STATS_LOCK:
-        KV_STATS["allreduce_us"] += dur_us
-        KV_STATS["allreduce_buckets"] += 1
-        KV_STATS["allreduce_bytes"] += nbytes
-    record_span("kv.allreduce", dur_us, ts_us=t0 * 1e6, cat="kv",
+        KV_STATS[kind + "_us"] += dur_us
+        KV_STATS[kind + "_buckets"] += 1
+        KV_STATS[kind + "_bytes"] += nbytes
+    record_span("kv." + kind, dur_us, ts_us=t0 * 1e6, cat="kv",
                 nbytes=nbytes, keys=keys)
+
+
+def _note_allreduce(t0, nbytes, keys):
+    _note_collective("allreduce", t0, nbytes, keys)
+
+
+# ---------------------------------------------------------------------------
+# bucketed dp-axis collectives (the ZeRO data path, mx.fault.elastic)
+# ---------------------------------------------------------------------------
+# compiled shard_map programs keyed on (kind, mesh, axis, shapes/dtypes,
+# scale). Entries hold the mesh STRONGLY so a recycled id() can never alias
+# a different mesh while the entry lives; FIFO-bounded so elastic mesh
+# shrinks don't accumulate programs for dead meshes forever.
+_COLL_FN_CACHE = OrderedDict()
+_COLL_FN_CACHE_CAP = 64
+_COLL_FN_LOCK = threading.Lock()
+
+
+def _coll_fn(kind, jmesh, axis, sig, scale, build):
+    key = (kind, id(jmesh), axis, sig, scale)
+    with _COLL_FN_LOCK:
+        hit = _COLL_FN_CACHE.get(key)
+        if hit is not None and hit[0] is jmesh:
+            return hit[1]
+    fn = build()   # tracing outside the lock: compiles can be slow
+    with _COLL_FN_LOCK:
+        _COLL_FN_CACHE[key] = (jmesh, fn)
+        while len(_COLL_FN_CACHE) > _COLL_FN_CACHE_CAP:
+            _COLL_FN_CACHE.popitem(last=False)
+    return fn
+
+
+def _bucketize(raws, bytes_of_idx, bucket_bytes):
+    """Greedy ~bucket_bytes buckets of indices into `raws`,
+    dtype-segregated, order-preserving within dtype (≙ the kvstore_dist
+    key batching)."""
+    by_dtype = {}
+    for i, a in enumerate(raws):
+        by_dtype.setdefault(str(a.dtype), []).append(i)
+    buckets = []
+    for _, idxs in by_dtype.items():
+        cur, cur_bytes = [], 0
+        for i in idxs:
+            sz = bytes_of_idx(i)
+            if cur and cur_bytes + sz > bucket_bytes:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += sz
+        if cur:
+            buckets.append(cur)
+    return buckets
+
+
+def reduce_scatter_buckets(grads, mesh, axis="dp", scale=None,
+                           bucket_bytes=None):
+    """Bucketed reduce-scatter over the dp mesh axis — the gradient half of
+    the ZeRO step (`mx.fault.elastic`).
+
+    `grads`: list of per-replica-stacked arrays of global shape
+    ``(dp, *shape)`` sharded ``P(axis, ...)`` — row r is replica r's local
+    gradient. Each ~4MB bucket dispatches as ONE jitted shard_map program:
+    per param, the local gradient is flattened, zero-padded to ``dp * L``,
+    and `lax.psum_scatter`'d so rank r receives the REDUCED elements of
+    shard r only (`scale` multiplies the sum — pass ``1/dp`` for a mean).
+    Returns ``(dp, L_i)`` shard views sharded ``P(axis, None)``, the layout
+    `optimizer.sharded` updates in place.
+
+    Buckets dispatch asynchronously, so bucket k+1's issue overlaps bucket
+    k's reduction AND the still-in-flight backward that produced the
+    grads (the overlap `benchmark/elastic_bench.py` measures). Each bucket
+    hits the `kvstore.reduce_scatter` fault point and lands in
+    KV_STATS reduce_scatter_us/buckets/bytes + the `kv.reduce_scatter`
+    span lane.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ..parallel import shard_map as _shard_map
+
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    if axis not in jmesh.shape:
+        raise MXNetError(f"mesh {dict(jmesh.shape)} has no {axis!r} axis")
+    dp = int(jmesh.shape[axis])
+    bucket_bytes = bucket_bytes or KVStore._BUCKET_BYTES
+    raws = [getattr(g, "_arr", g) for g in grads]
+    for i, g in enumerate(raws):
+        if g.ndim < 1 or g.shape[0] != dp:
+            raise MXNetError(
+                f"grads[{i}] must be per-replica stacked (dp={dp}, ...), "
+                f"got shape {tuple(g.shape)}")
+
+    def per_replica_bytes(g):
+        n = 1
+        for s in g.shape[1:]:
+            n *= s
+        return max(n, 1) * g.dtype.itemsize
+
+    results = [None] * len(raws)
+    for bucket in _bucketize(raws, lambda i: per_replica_bytes(raws[i]),
+                             bucket_bytes):
+        sig = tuple((tuple(raws[i].shape), str(raws[i].dtype))
+                    for i in bucket)
+
+        def build(bucket=bucket, sig=sig):
+            shapes = [raws[i].shape for i in bucket]
+
+            def body(*locals_):
+                outs = []
+                for gl, shp in zip(locals_, shapes):
+                    n = 1
+                    for s in shp[1:]:
+                        n *= s
+                    flat = gl.reshape(-1)
+                    L = -(-n // dp)
+                    if n < dp * L:
+                        flat = jnp.concatenate(
+                            [flat, jnp.zeros((dp * L - n,), flat.dtype)])
+                    red = jax.lax.psum_scatter(
+                        flat, axis, scatter_dimension=0, tiled=True)
+                    if scale is not None:
+                        red = red * jnp.asarray(scale, red.dtype)
+                    outs.append(red.reshape(1, L))
+                return tuple(outs)
+
+            in_specs = tuple(P(axis, *([None] * (len(s[0]) - 1)))
+                             for s in sig)
+            out_specs = tuple(P(axis, None) for _ in sig)
+            return jax.jit(_shard_map(body, jmesh, in_specs, out_specs))
+
+        fn = _coll_fn("reduce_scatter", jmesh, axis, sig,
+                      None if scale is None else float(scale), build)
+        _fault.inject("kvstore.reduce_scatter")
+        t0 = _time.perf_counter()
+        outs = fn(*[raws[i] for i in bucket])
+        nbytes = sum(per_replica_bytes(raws[i]) for i in bucket)
+        _note_collective("reduce_scatter", t0, nbytes, len(bucket))
+        for i, o in zip(bucket, outs):
+            results[i] = o
+    return results
+
+
+def allgather_buckets(shards, metas, mesh, axis="dp", bucket_bytes=None):
+    """Bucketed all-gather over the dp mesh axis — the parameter half of
+    the ZeRO step: each rank contributes its fresh ``(1, L)`` shard row and
+    every rank receives the full parameter.
+
+    `shards`: list of ``(dp, L_i)`` arrays sharded ``P(axis, None)``;
+    `metas`: congruent list of ``(numel, shape)`` to unpad and reshape the
+    gathered flats. Returns fully-replicated arrays of the original
+    shapes. Per-bucket `kvstore.allgather` fault point, KV_STATS
+    allgather_us/buckets/bytes, `kv.allgather` span lane.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from ..parallel import shard_map as _shard_map
+
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    if axis not in jmesh.shape:
+        raise MXNetError(f"mesh {dict(jmesh.shape)} has no {axis!r} axis")
+    dp = int(jmesh.shape[axis])
+    if len(shards) != len(metas):
+        raise MXNetError("shards and metas must be congruent lists")
+    bucket_bytes = bucket_bytes or KVStore._BUCKET_BYTES
+    raws = [getattr(s, "_arr", s) for s in shards]
+
+    def full_bytes(i):
+        numel, _ = metas[i]
+        return max(int(numel), 1) * raws[i].dtype.itemsize
+
+    results = [None] * len(raws)
+    for bucket in _bucketize(raws, full_bytes, bucket_bytes):
+        sig = tuple((tuple(raws[i].shape), str(raws[i].dtype),
+                     int(metas[i][0]), tuple(metas[i][1])) for i in bucket)
+
+        def build(bucket=bucket, sig=sig):
+            items = [(int(metas[i][0]), tuple(metas[i][1]))
+                     for i in bucket]
+
+            def body(*locals_):
+                outs = []
+                for sl, (numel, shape) in zip(locals_, items):
+                    full = jax.lax.all_gather(
+                        sl.reshape(-1), axis, tiled=True)
+                    outs.append(full[:numel].reshape(shape))
+                return tuple(outs)
+
+            in_specs = tuple(P(axis, None) for _ in sig)
+            out_specs = tuple(P() for _ in sig)
+            return jax.jit(_shard_map(body, jmesh, in_specs, out_specs))
+
+        fn = _coll_fn("allgather", jmesh, axis, sig, None, build)
+        _fault.inject("kvstore.allgather")
+        t0 = _time.perf_counter()
+        outs = fn(*[raws[i] for i in bucket])
+        nbytes = sum(full_bytes(i) for i in bucket)
+        _note_collective("allgather", t0, nbytes, len(bucket))
+        for i, o in zip(bucket, outs):
+            results[i] = o
+    return results
 
 
 class KVStoreBase:
@@ -466,17 +702,125 @@ class KVStore(KVStoreBase):
     def barrier(self):
         """≙ KVStore::Barrier: local completion + (in dist mode) a real
         cross-process rendezvous. A dead peer would hang the rendezvous
-        forever; set MXNET_KV_BARRIER_TIMEOUT (seconds) to abort with
-        WatchdogTimeout instead (preemptive on the main thread only — a
-        non-main-thread barrier cannot be interrupted mid-call)."""
+        forever; set MXNET_KVSTORE_BARRIER_TIMEOUT (seconds; legacy alias
+        MXNET_KV_BARRIER_TIMEOUT) to abort with a typed `BarrierTimeout`
+        NAMING the ranks that never announced their arrival, instead of
+        hanging. Arrival is announced through the jax.distributed
+        coordinator's KV store before the rendezvous, so a stalled barrier
+        can attribute WHICH peer is missing; when no coordinator store is
+        reachable the error still fires, with `missing_ranks=[]`. The
+        rendezvous runs in a watcher thread, so the timeout works off the
+        main thread too (the old watchdog was main-thread-preemptive
+        only)."""
         from ..ndarray import waitall
         waitall()
-        if self._dist_active():
-            from jax.experimental import multihost_utils
+        if not self._dist_active():
+            return
+        timeout = get_env("MXNET_KVSTORE_BARRIER_TIMEOUT", typ=float)
+        if timeout is None:
             timeout = get_env("MXNET_KV_BARRIER_TIMEOUT", typ=float)
-            with _fault.watchdog(timeout, "kvstore barrier timed out "
-                                          "(peer process likely dead)"):
-                multihost_utils.sync_global_devices("mx_kvstore_barrier")
+        seq = _next_barrier_seq()
+        # announce UNCONDITIONALLY (one cheap best-effort key_value_set):
+        # a peer whose own timeout env is unset must still be attributable
+        # as present when some OTHER rank's barrier times out
+        self._barrier_announce(seq)
+        if timeout is None or timeout <= 0:
+            self._barrier_sync(seq)
+            self._barrier_retract(seq)
+            return
+        done = threading.Event()
+        errs = []
+
+        def _rendezvous():
+            try:
+                self._barrier_sync(seq)
+            except Exception as e:   # surfaced to the caller below
+                errs.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_rendezvous, daemon=True,
+                             name=f"mx-kv-barrier-{seq}")
+        t.start()
+        if not done.wait(timeout):
+            missing = self._barrier_missing_ranks(seq)
+            who = (f"rank(s) {', '.join(map(str, missing))} never arrived"
+                   if missing else
+                   "missing ranks unknown (no coordinator KV store)")
+            # the abandoned daemon thread stays blocked in the rendezvous;
+            # the job is about to be torn down/restarted, which is the only
+            # way out of a half-entered cross-process barrier anyway
+            raise BarrierTimeout(
+                f"kvstore barrier #{seq} timed out after {timeout:.3g}s; "
+                f"{who}", missing_ranks=missing)
+        self._barrier_retract(seq)
+        if errs:
+            raise errs[0]
+
+    def _barrier_sync(self, seq):
+        from jax.experimental import multihost_utils
+        # seq-suffixed name: a count mismatch between processes surfaces as
+        # a loud coordinator error instead of silently pairing two
+        # different barriers
+        multihost_utils.sync_global_devices(f"mx_kvstore_barrier_{seq}")
+
+    @staticmethod
+    def _coordinator_client():
+        """The jax.distributed coordinator KV client, or None (single
+        process, or a jax without the internal handle)."""
+        try:
+            from jax._src import distributed
+            return distributed.global_state.client
+        except Exception:
+            return None
+
+    def _barrier_announce(self, seq):
+        """Best-effort arrival announcement for stall attribution."""
+        client = self._coordinator_client()
+        if client is None:
+            return
+        try:
+            client.key_value_set(f"mx/barrier/{seq}/{self.rank}", "1")
+        except Exception:
+            pass
+
+    def _barrier_retract(self, seq):
+        """Best-effort cleanup after a COMPLETED rendezvous: each rank
+        deletes its own announcement so the coordinator store doesn't
+        grow one key per rank per barrier for the life of the job."""
+        client = self._coordinator_client()
+        if client is None:
+            return
+        try:
+            client.key_value_delete(f"mx/barrier/{seq}/{self.rank}")
+        except Exception:
+            pass
+
+    def _barrier_missing_ranks(self, seq):
+        """Ranks with no arrival announcement for barrier `seq` (self
+        always announced). Empty when attribution is impossible."""
+        client = self._coordinator_client()
+        if client is None:
+            return []
+        present = set()
+        try:
+            # one directory read for every announced rank (newer jax also
+            # has key_value_try_get; dir_get exists on every jaxlib with
+            # a coordinator client)
+            entries = client.key_value_dir_get(f"mx/barrier/{seq}/")
+            for k, _v in entries:
+                tail = str(k).rsplit("/", 1)[-1]
+                if tail.isdigit():
+                    present.add(int(tail))
+        except Exception:
+            return []
+        missing = [r for r in range(self.num_workers)
+                   if r not in present]
+        if self.rank in missing:
+            # we DID announce — the store cannot be read back at all, so
+            # per-rank attribution would be noise, not signal
+            return []
+        return missing
 
     def _send_command_to_servers(self, head, body):
         pass  # no server processes in the SPMD runtime

@@ -32,6 +32,17 @@ __all__ = [
 _REGISTRY = {}
 
 
+def __getattr__(name):
+    # lazy submodule: `optimizer.sharded` (the ZeRO shard layer for
+    # mx.fault.elastic) stays off the base optimizer import path
+    if name == "sharded":
+        import importlib
+        mod = importlib.import_module(".sharded", __name__)
+        globals()["sharded"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 def register(klass):
     """≙ mx.optimizer.register."""
     _REGISTRY[klass.__name__.lower()] = klass

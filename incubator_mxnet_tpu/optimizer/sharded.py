@@ -1,0 +1,299 @@
+"""mx.optimizer.sharded — ZeRO-1/2-style optimizer-state sharding over the
+dp mesh axis.
+
+The reference's parameter-server KVStore split optimizer-update work across
+server shards (PAPER.md layer 0, ps-lite: each server owned a key range and
+ran the updater for it). The SPMD-era equivalent is ZeRO: every data-parallel
+replica owns ``1/dp`` of the optimizer state (and a master copy of its slice
+of every parameter), updates only that shard, and the fresh parameters are
+re-assembled with an all-gather. Memory for moments drops ~linearly with dp;
+the reduce-scatter that feeds the shard update moves the same bytes an
+allreduce would, split across ranks.
+
+Layout: every parameter ``p`` of ``numel`` elements is flattened, padded to a
+multiple of ``dp``, and viewed as a ``(dp, L)`` array with
+``NamedSharding(mesh, P(axis, None))`` — row ``r`` (the shard rank ``r``
+owns) lives on device ``r`` of the dp axis. The same ``(dp, L)`` layout holds
+the fp32 master copy (``wshard``) and every optimizer-state leaf, so the
+shard update is a pure elementwise program XLA partitions with zero
+collectives. The layout survives mesh-size changes: `repartition` re-slices
+the true ``numel`` elements onto a new dp (see `checkpoint.Repartition` for
+the restore-time form).
+
+`ShardedOptimizer` reuses the base optimizer's `step_one` rule exactly the
+way `Optimizer.fused_update_all` does — wrapped buffers inside one jitted,
+donated program with lr/wd (and the Adam family's bias-correction ``t``)
+entering as traced scalars — so every `_fused_safe` rule (SGD, Adam, AdamW,
+LAMB, ...) shards without a parallel reimplementation.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from . import Optimizer, create as _create_opt, _state_bufs, _wrap_state
+
+__all__ = ["ShardedOptimizer", "shard_len", "to_shards", "from_shards",
+           "repartition", "state_layout", "layout_spec_tree"]
+
+
+def shard_len(numel, dp):
+    """Per-rank shard length: ceil(numel / dp) (the tail rank is padded)."""
+    if dp < 1:
+        raise MXNetError(f"dp must be >= 1, got {dp}")
+    return -(-int(numel) // int(dp))
+
+
+def to_shards(arr, dp):
+    """Flatten + zero-pad a host/jax array to the (dp, L) shard view."""
+    flat = _np.asarray(arr).reshape(-1)
+    L = shard_len(flat.size, dp)
+    if flat.size < dp * L:
+        flat = _np.concatenate(
+            [flat, _np.zeros(dp * L - flat.size, flat.dtype)])
+    return flat.reshape(dp, L)
+
+
+def from_shards(arr2d, numel, shape=None):
+    """Invert to_shards: drop padding, restore the original shape."""
+    flat = _np.asarray(arr2d).reshape(-1)[:int(numel)]
+    return flat if shape is None else flat.reshape(shape)
+
+
+def repartition(arr2d, numel, new_dp):
+    """Re-slice a (dp_old, L_old) shard view onto new_dp ranks — the host
+    half of the elastic-restart recipe (dtype-preserving; padding is
+    recomputed, so uneven counts like dp=3 -> 2 round-trip exactly)."""
+    return to_shards(from_shards(arr2d, numel), new_dp)
+
+
+def state_layout(state):
+    """JSON-safe structure marker for a (possibly nested-tuple) state:
+    None stays None, every array leaf becomes the string "shard". Used by
+    the checkpoint manifest so a resume can rebuild spec trees without
+    reconstructing the optimizer first."""
+    if state is None:
+        return None
+    if isinstance(state, (tuple, list)):
+        return [state_layout(s) for s in state]
+    return "shard"
+
+
+def layout_spec_tree(layout, make_leaf):
+    """Map a `state_layout` marker structure to a spec pytree, calling
+    `make_leaf()` for every "shard" marker. Tuples/lists come back as
+    lists — congruent with orbax's restored containers."""
+    if layout is None:
+        return None
+    if isinstance(layout, (tuple, list)):
+        return [layout_spec_tree(l, make_leaf) for l in layout]
+    return make_leaf()
+
+
+class ShardedOptimizer:
+    """Wrap a base `Optimizer` so its states live sharded over the dp axis.
+
+    ::
+
+        sopt = ShardedOptimizer("sgd", mesh, momentum=0.9, learning_rate=0.1)
+        wshard, meta = sopt.shard_params(params)    # (dp, L) master copies
+        states = sopt.init_states(wshard)           # (dp, L) moments
+        wshard, states = sopt.update(wshard, gshard, states)
+
+    `mesh` is a raw `jax.sharding.Mesh` (or `parallel.Mesh`) with the dp
+    axis named `axis`. Only `_fused_safe` rules are supported — the same
+    criterion the multi-tensor fused path uses: `step_one` must be
+    trace-pure given (lr, wd[, t]).
+    """
+
+    def __init__(self, optimizer, mesh, axis="dp", **opt_kwargs):
+        base = (optimizer if isinstance(optimizer, Optimizer)
+                else _create_opt(optimizer, **opt_kwargs))
+        if not type(base)._fused_safe:
+            raise MXNetError(
+                f"{type(base).__name__} is not _fused_safe: its step_one "
+                "carries per-step host state and cannot be shard-jitted")
+        if base.multi_precision:
+            raise MXNetError("ShardedOptimizer keeps its own fp32 master "
+                             "shards; multi_precision must be off")
+        self.base = base
+        self.jax_mesh = getattr(mesh, "jax_mesh", mesh)
+        self.axis = axis
+        if axis not in self.jax_mesh.shape:
+            raise MXNetError(f"mesh {dict(self.jax_mesh.shape)} has no "
+                             f"{axis!r} axis")
+        self.dp = int(self.jax_mesh.shape[axis])
+        self._update_fn_cache = {}
+
+    # ------------------------------------------------------------------
+    def _sharding(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        return jax.sharding.NamedSharding(self.jax_mesh, P(self.axis, None))
+
+    def place(self, arr2d):
+        """Device-put a (dp, L) host view row-sharded over the dp axis."""
+        import jax
+        a = _np.asarray(arr2d)
+        if a.ndim != 2 or a.shape[0] != self.dp:
+            raise MXNetError(f"expected a ({self.dp}, L) shard view, got "
+                             f"{a.shape}")
+        return jax.device_put(a, self._sharding())
+
+    def shard_params(self, params):
+        """params: dict name -> array. Returns (wshard, meta): the sharded
+        fp32-master views and the {name: {numel, shape, dtype}} metadata a
+        checkpoint needs to reassemble/repartition them."""
+        wshard, meta = {}, {}
+        for name, v in params.items():
+            a = _np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+            meta[name] = {"numel": int(a.size), "shape": list(a.shape),
+                          "dtype": str(a.dtype)}
+            wshard[name] = self.place(to_shards(a, self.dp))
+        return wshard, meta
+
+    def init_states(self, wshard):
+        """Fresh sharded optimizer states, one (dp, L)-leaved tree per
+        param (the base rule's create_state run on the shard view — zeros
+        with the shard's shape/dtype, sharded like the master copy)."""
+        from ..ndarray import _wrap
+        states = {}
+        for name, w in wshard.items():
+            st = self.base.create_state(name, _wrap(w))
+            states[name] = self._place_state(st)
+        return states
+
+    def _place_state(self, st):
+        if st is None:
+            return None
+        if isinstance(st, (tuple, list)):
+            return tuple(self._place_state(s) for s in st)
+        # create_state returns NDArrays; keep raw sharded jax buffers
+        import jax
+        raw = st._arr if hasattr(st, "_arr") else _np.asarray(st)
+        return jax.device_put(raw, self._sharding())
+
+    # ------------------------------------------------------------------
+    def mem_per_replica_bytes(self, wshard, states):
+        """Bytes of optimizer state (master shards + moments) ONE replica
+        holds — the quantity ZeRO shrinks ~linearly with dp. Measured from
+        the actual per-device buffers, not shapes."""
+        total = 0
+        for leaf in self._leaves(wshard) + self._leaves(states):
+            if hasattr(leaf, "addressable_shards"):
+                total += int(leaf.addressable_shards[0].data.nbytes)
+            else:
+                total += int(_np.asarray(leaf).nbytes) // self.dp
+        return total
+
+    @staticmethod
+    def _leaves(tree):
+        out = []
+
+        def walk(x):
+            if x is None:
+                return
+            if isinstance(x, dict):
+                for v in x.values():
+                    walk(v)
+            elif isinstance(x, (tuple, list)):
+                for v in x:
+                    walk(v)
+            else:
+                out.append(x)
+        walk(tree)
+        return out
+
+    # ------------------------------------------------------------------
+    def update(self, wshard, gshard, states):
+        """One sharded optimizer step: every rank updates its (dp, L) rows.
+
+        ONE jitted program over all params, master-shard and state buffers
+        donated (XLA updates in place), lr/wd/t traced so schedules never
+        recompile — `fused_update_all`, transposed onto the shard layout.
+        Returns (new_wshard, new_states).
+        """
+        import jax
+        import jax.tree_util as jtu
+
+        names = tuple(sorted(wshard))
+        base = self.base
+        for name in names:
+            base._update_count(name)
+        takes_t = type(base)._step_takes_t()
+        lrs = _np.asarray([base._get_lr(n) for n in names], _np.float32)
+        wds = _np.asarray([base._get_wd(n) for n in names], _np.float32)
+        ts = (_np.asarray([base._index_update_count[n] for n in names],
+                          _np.float32) if takes_t else None)
+
+        sbuf_trees = [states[n] for n in names]
+        flat_s, sdef = jtu.tree_flatten(sbuf_trees)
+        key = (names, tuple(tuple(wshard[n].shape) for n in names),
+               tuple(str(wshard[n].dtype) for n in names), sdef,
+               base.clip_gradient, base._hyper_fingerprint(), takes_t)
+        fn = self._update_fn_cache.get(key)
+        if fn is None:
+            fn = self._build_update_fn(names, sdef, len(flat_s), takes_t)
+            self._update_fn_cache[key] = fn
+        args = ([wshard[n] for n in names] + [gshard[n] for n in names]
+                + list(flat_s)
+                + [lrs, wds, _np.float32(base.rescale_grad)]
+                + ([ts] if takes_t else []))
+        outs = fn(*args)
+        nw = len(names)
+        new_wshard = {n: outs[i] for i, n in enumerate(names)}
+        new_leaves = outs[nw:]
+        new_trees = jtu.tree_unflatten(sdef, list(new_leaves))
+        new_states = {n: self._tuplify(t)
+                      for n, t in zip(names, new_trees)}
+        return new_wshard, new_states
+
+    @staticmethod
+    def _tuplify(t):
+        if isinstance(t, list):
+            return tuple(ShardedOptimizer._tuplify(x) for x in t)
+        if isinstance(t, tuple):
+            return tuple(ShardedOptimizer._tuplify(x) for x in t)
+        return t
+
+    def _build_update_fn(self, names, sdef, ns, takes_t):
+        import jax
+        import jax.tree_util as jtu
+        from ..ndarray import _wrap
+
+        base = self.base
+        nw = len(names)
+
+        def f(*flat):
+            wb = flat[:nw]
+            gb = flat[nw:2 * nw]
+            sb = jtu.tree_unflatten(sdef, list(flat[2 * nw:2 * nw + ns]))
+            lr_args = flat[2 * nw + ns]
+            wd_args = flat[2 * nw + ns + 1]
+            rescale = flat[2 * nw + ns + 2]
+            t_args = flat[2 * nw + ns + 3] if takes_t else None
+            prev = base.rescale_grad
+            # deliberate trace-time swap (exposes the traced rescale to
+            # step_one's _preprocess), restored in finally — the same
+            # pattern as Optimizer.fused_update_all
+            base.rescale_grad = rescale  # mxlint: disable=trace-closure-mutation
+            try:
+                new_w, new_s = [], []
+                for k, name in enumerate(names):
+                    w = _wrap(wb[k])
+                    g = _wrap(gb[k])
+                    st = _wrap_state(self._tuplify(sb[k]))
+                    if takes_t:
+                        base.step_one(name, w, g, st, lr_args[k],
+                                      wd_args[k], t=t_args[k])
+                    else:
+                        base.step_one(name, w, g, st, lr_args[k],
+                                      wd_args[k])
+                    new_w.append(w._arr)
+                    new_s.append(_state_bufs(st))
+            finally:
+                base.rescale_grad = prev  # mxlint: disable=trace-closure-mutation -- restore of the trace-time swap
+            return tuple(new_w) + tuple(jtu.tree_leaves(new_s))
+
+        donate = tuple(range(nw)) + tuple(range(2 * nw, 2 * nw + ns))
+        return jax.jit(f, donate_argnums=donate)

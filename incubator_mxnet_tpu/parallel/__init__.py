@@ -28,7 +28,8 @@ import numpy as _np
 from ..base import MXNetError, get_env
 
 __all__ = [
-    "Mesh", "current_mesh", "mesh_scope", "make_mesh", "initialize",
+    "Mesh", "current_mesh", "mesh_scope", "make_mesh", "dp_mesh",
+    "initialize",
     "allreduce", "allgather", "reduce_scatter", "broadcast", "ppermute",
     "axis_is_bound", "shard", "replicate", "shard_map", "num_devices",
     "local_rank", "rank", "world_size", "DataParallel", "split_and_load",
@@ -128,6 +129,21 @@ def make_mesh(dp=None, tp=1, pp=1, sp=1, ep=1, devices=None):
         if size != 1 or name == "dp":
             axes[name] = size
     return Mesh(axes, devices)
+
+
+def dp_mesh(dp=None, axis="dp", devices=None):
+    """A bare 1-axis data-parallel `jax.sharding.Mesh` over the first `dp`
+    visible devices (all of them when None) — the mesh shape the elastic
+    ZeRO trainer (`mx.fault.elastic`) shards its (dp, L) state views
+    over. Returns a RAW jax mesh (not `parallel.Mesh`): the callers are
+    sharding/collective plumbing, not `with mesh:` scopes."""
+    import jax
+    devices = list(devices if devices is not None else jax.devices())
+    dp = len(devices) if dp is None else int(dp)
+    if dp < 1 or dp > len(devices):
+        raise MXNetError(f"dp={dp} outside [1, {len(devices)}] visible "
+                         "devices")
+    return jax.sharding.Mesh(_np.array(devices[:dp]), (axis,))
 
 
 def current_mesh():

@@ -22,12 +22,13 @@ single object could answer "where did this step's time go?". Now:
     (bench.py, estimator.fit) shares one number instead of three copies of
     3.86-GMAC hand-math.
 
-Attribution semantics (documented, not magic): `data_stall_us` and
-`allreduce_us` are time the CONSUMER thread provably spent inside the step
-window waiting (empty feed buffer; collective dispatch). `h2d_stage_us` is
-feeder-thread staging time — it overlaps compute by design, so it is
-reported alongside, never subtracted. `compute_us` is the remainder:
-`total - data_stall - allreduce`.
+Attribution semantics (documented, not magic): `data_stall_us` and the
+collective clocks (`allreduce_us`, and the ZeRO lanes `reduce_scatter_us`
+/ `allgather_us`) are time the CONSUMER thread provably spent inside the
+step window waiting (empty feed buffer; collective dispatch).
+`h2d_stage_us` is feeder-thread staging time — it overlaps compute by
+design, so it is reported alongside, never subtracted. `compute_us` is the
+remainder: `total - data_stall - allreduce - reduce_scatter - allgather`.
 """
 from __future__ import annotations
 
@@ -148,7 +149,9 @@ def _stall_counters():
     diffs: (feed stall/staging, kvstore allreduce). Missing subsystems
     read as zeros so a loop with no feed or no kvstore still reports."""
     out = {"data_stall_us": 0.0, "h2d_stage_us": 0.0, "host_transfers": 0,
-           "allreduce_us": 0.0, "allreduce_buckets": 0}
+           "allreduce_us": 0.0, "allreduce_buckets": 0,
+           "reduce_scatter_us": 0.0, "reduce_scatter_buckets": 0,
+           "allgather_us": 0.0, "allgather_buckets": 0}
     try:
         from ..io.device_feed import feed_stats
         f = feed_stats()
@@ -161,6 +164,14 @@ def _stall_counters():
         from ..kvstore import KV_STATS
         out["allreduce_us"] = KV_STATS.get("allreduce_us", 0.0)
         out["allreduce_buckets"] = KV_STATS.get("allreduce_buckets", 0)
+        # ZeRO collective lanes (mx.fault.elastic): dispatch-side clocks
+        # of the bucketed reduce-scatter / all-gather, same semantics as
+        # the allreduce clock
+        out["reduce_scatter_us"] = KV_STATS.get("reduce_scatter_us", 0.0)
+        out["reduce_scatter_buckets"] = KV_STATS.get(
+            "reduce_scatter_buckets", 0)
+        out["allgather_us"] = KV_STATS.get("allgather_us", 0.0)
+        out["allgather_buckets"] = KV_STATS.get("allgather_buckets", 0)
     except Exception:
         pass
     return out
@@ -190,7 +201,11 @@ class StepTimeline:
 
       data_stall_us   consumer blocked on an empty feed buffer (input-bound)
       allreduce_us    gradient-collective dispatch time inside the window
-      compute_us      total - data_stall - allreduce (the XLA side)
+      reduce_scatter_us / allgather_us
+                      ZeRO collective dispatch time (mx.fault.elastic
+                      bucketed reduce-scatter / param all-gather)
+      compute_us      total - data_stall - allreduce - reduce_scatter -
+                      allgather (the XLA side)
       h2d_stage_us    feeder staging incl. async H2D dispatch — overlapped
                       work, reported for visibility, never subtracted
       step_time_us    sum of the in-step spans (loop-body time only)
@@ -209,7 +224,10 @@ class StepTimeline:
         self.step_time_us = 0.0
         self.deltas = {"data_stall_us": 0.0, "h2d_stage_us": 0.0,
                        "allreduce_us": 0.0, "host_transfers": 0,
-                       "allreduce_buckets": 0}
+                       "allreduce_buckets": 0,
+                       "reduce_scatter_us": 0.0,
+                       "reduce_scatter_buckets": 0,
+                       "allgather_us": 0.0, "allgather_buckets": 0}
         self._base = None        # counters at first step entry
         self._t_first = None
         self._t_last = None
@@ -262,7 +280,9 @@ class StepTimeline:
         total = self.total_us
         stall = self.deltas["data_stall_us"]
         allred = self.deltas["allreduce_us"]
-        compute = max(0.0, total - stall - allred)
+        rs = self.deltas["reduce_scatter_us"]
+        ag = self.deltas["allgather_us"]
+        compute = max(0.0, total - stall - allred - rs - ag)
         out = {
             "name": self.name,
             "steps": self.steps,
@@ -272,10 +292,15 @@ class StepTimeline:
             if self.steps else 0.0,
             "data_stall_us": round(stall, 1),
             "allreduce_us": round(allred, 1),
+            "reduce_scatter_us": round(rs, 1),
+            "allgather_us": round(ag, 1),
             "compute_us": round(compute, 1),
             "h2d_stage_us": round(self.deltas["h2d_stage_us"], 1),
             "host_transfers": self.deltas["host_transfers"],
             "allreduce_buckets": self.deltas["allreduce_buckets"],
+            "reduce_scatter_buckets":
+                self.deltas["reduce_scatter_buckets"],
+            "allgather_buckets": self.deltas["allgather_buckets"],
             "stall_pct": round(100.0 * stall / total, 2) if total else 0.0,
             "compute_pct": round(100.0 * compute / total, 2) if total
             else 0.0,

@@ -12,23 +12,29 @@ import numpy as np
 import pytest
 
 
-def _cpu_backend_on_tiny_host():
+def _skip_cpu_convergence():
     # the suite conftest forces the CPU platform; 120 ResNet-50 steps
-    # there need a multicore host (hours on one core). On a real
-    # accelerator backend the test is cheap and always runs.
+    # there blow any CI budget regardless of the advertised core count
+    # (sandboxed many-core hosts report 24 cores and deliver a fraction
+    # of that — the old <4-core carve-out silently turned this into a
+    # >14-minute tier-1 hang). On a real accelerator backend the test is
+    # cheap and always runs; MXTPU_NIGHTLY_CPU_CONVERGENCE=1 opts a
+    # genuinely beefy CPU host back in.
     import jax
     try:
         backend = jax.default_backend()
     except RuntimeError:
         backend = "cpu"
-    return backend == "cpu" and (os.cpu_count() or 1) < 4
+    return (backend == "cpu"
+            and os.environ.get("MXTPU_NIGHTLY_CPU_CONVERGENCE") != "1")
 
 
 @pytest.mark.nightly
 @pytest.mark.skipif(
-    _cpu_backend_on_tiny_host(),
-    reason="CPU fallback platform on a <4-core host: 120 ResNet-50 train "
-           "steps take hours; the real-chip path is exercised by bench.py")
+    _skip_cpu_convergence(),
+    reason="CPU fallback platform: 120 ResNet-50 train steps blow the CI "
+           "budget (MXTPU_NIGHTLY_CPU_CONVERGENCE=1 opts in); the "
+           "real-chip path is exercised by bench.py")
 def test_resnet50_loss_trajectory_on_chip():
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import amp, gluon

@@ -101,3 +101,87 @@ def test_flagship_training_resumes_on_smaller_mesh(tmp_path):
         opt4 = tuple(tree["opt"])   # orbax restores the (m, v) pair as list
         new_params, new_opt, loss = step_fn(tree["params"], opt4, batch, t)
         assert np.isfinite(float(loss))
+
+
+# ---------------------------------------------------------------------------
+# Repartition spec leaves (ZeRO shard views; ISSUE-12 edge cases)
+# ---------------------------------------------------------------------------
+def _dp_mesh(dp):
+    import jax
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    if len(devs) < max(dp, 8):
+        pytest.skip("needs the forced 8-device mesh")
+    return Mesh(np.array(devs[:dp]), ("dp",))
+
+
+def test_repartition_uneven_shard_counts_dp3_to_2(tmp_path):
+    """dp=3 -> 2: the saved (3, L) view does not divide the new dp —
+    Repartition must drop the OLD padding and re-pad for the new dp."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from incubator_mxnet_tpu.optimizer.sharded import to_shards
+
+    numel = 10                      # -> (3, 4) padded, 2 pad elements
+    flat = np.arange(numel, dtype=np.float32) + 1.0
+    mesh3 = _dp_mesh(3)
+    state = {"m": jax.device_put(to_shards(flat, 3),
+                                 NamedSharding(mesh3, P("dp", None)))}
+    d = str(tmp_path / "ck")
+    ckpt.save_sharded(d, state, step=1)
+
+    mesh2 = _dp_mesh(2)
+    tree, step = ckpt.rescale_sharded(
+        d, mesh2, {"m": ckpt.Repartition(numel)})
+    assert step == 1
+    got = tree["m"]
+    assert got.shape == (2, 5)      # re-padded for dp=2
+    assert got.sharding.mesh.devices.size == 2
+    view = np.asarray(got).reshape(-1)
+    np.testing.assert_array_equal(view[:numel], flat)
+    np.testing.assert_array_equal(view[numel:], 0)   # fresh padding
+
+
+def test_repartition_preserves_dtype_and_scalar_leaves(tmp_path):
+    """dtype preservation (f16 shards stay f16) and scalar/0-d leaves
+    riding replicated next to Repartition leaves."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from incubator_mxnet_tpu.optimizer.sharded import to_shards
+
+    mesh8 = _dp_mesh(8)
+    flat16 = (np.arange(12, dtype=np.float16) / 8).astype(np.float16)
+    state = {
+        "m16": jax.device_put(to_shards(flat16, 8),
+                              NamedSharding(mesh8, P("dp", None))),
+        "count": jax.device_put(np.float32(17.0),
+                                NamedSharding(mesh8, P())),
+    }
+    d = str(tmp_path / "ck")
+    ckpt.save_sharded(d, state, step=2)
+
+    mesh4 = _dp_mesh(4)
+    tree, _ = ckpt.rescale_sharded(
+        d, mesh4, {"m16": ckpt.Repartition(12), "count": None})
+    assert np.asarray(tree["m16"]).dtype == np.float16
+    assert tree["m16"].shape == (4, 3)
+    np.testing.assert_array_equal(
+        np.asarray(tree["m16"]).reshape(-1)[:12], flat16)
+    assert float(tree["count"]) == 17.0     # 0-d leaf: replicated restore
+
+
+def test_repartition_validates_numel_and_axis(tmp_path):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from incubator_mxnet_tpu.optimizer.sharded import to_shards
+
+    mesh2 = _dp_mesh(2)
+    state = {"m": jax.device_put(to_shards(np.ones(6, np.float32), 2),
+                                 NamedSharding(mesh2, P("dp", None)))}
+    d = str(tmp_path / "ck")
+    ckpt.save_sharded(d, state, step=1)
+    with pytest.raises(mx.MXNetError, match="exceeds"):
+        ckpt.rescale_sharded(d, mesh2, {"m": ckpt.Repartition(99)})
+    with pytest.raises(mx.MXNetError, match="axis"):
+        ckpt.rescale_sharded(d, mesh2,
+                             {"m": ckpt.Repartition(6, axis="tp")})

@@ -3,6 +3,7 @@ watchdog, and the auto-resume training driver (ISSUE 1 acceptance: an
 injected IOError or SIGKILL at any point during a save never loses the
 previous committed checkpoint, and a restarted run_resilient reproduces the
 uninterrupted run's final parameters — same mesh and halved mesh)."""
+import json
 import os
 import subprocess
 import sys
@@ -464,6 +465,105 @@ def test_run_resilient_npz_mode_resume(tmp_path):
     assert run.resumed_from == 2
     np.testing.assert_array_equal(run.state["w"],
                                   np.arange(6, dtype=np.float64) * 0.5 ** 6)
+
+
+def test_run_resilient_persists_skip_counter_across_crash(tmp_path,
+                                                          caplog):
+    """ISSUE-12 satellite regression: pre-PR a resume RESET
+    skipped_nonfinite; now the count is committed with each manifest
+    entry and restored, and the resumed run's event log shows it."""
+    import logging
+
+    def step_fn(state, step):
+        w = np.asarray(state["w"])
+        return {"w": w * 0.5}, float(w.sum())
+
+    init = {"w": np.arange(4, dtype=np.float64) + 1.0}
+    d = str(tmp_path / "skip")
+    fault.install("resilient.loss", "nan", at=2)   # skip at step 1
+    fault.install("resilient.step", "error", at=5)  # die at step 4
+    with pytest.raises(fault.InjectedFault):
+        fault.run_resilient(step_fn, init, d, 8, ckpt_every=2,
+                            sharded=False, max_step_retries=0)
+    fault.clear()
+    entry = ckpt.latest_entry(d)
+    assert entry["step"] == 4
+    assert entry["extra"]["resilient"]["skipped_nonfinite"] == 1
+    with caplog.at_level(logging.INFO, logger="mxnet.fault"):
+        run = fault.run_resilient(step_fn, init, d, 8, ckpt_every=2,
+                                  sharded=False)
+    assert run.resumed_from == 4
+    # the counter CONTINUES from the committed value instead of resetting
+    assert run.skipped_nonfinite == 1
+    resumed = [r.getMessage() for r in caplog.records
+               if "resilient.resumed" in r.getMessage()]
+    assert resumed and '"skipped_nonfinite": 1' in resumed[0]
+
+
+def test_run_resilient_rng_state_is_crash_consistent(tmp_path):
+    """With rng= passed, random draws replay identically after a crash:
+    the RNG state is committed with each checkpoint and rewound to the
+    restored step on resume."""
+    def make_step(rng):
+        def step_fn(state, step):
+            w = np.asarray(state["w"])
+            return {"w": w * 0.5 + rng.standard_normal()}, float(w.sum())
+        return step_fn
+
+    init = {"w": np.zeros(3, np.float64)}
+    rng_ref = np.random.default_rng(42)
+    ref = fault.run_resilient(make_step(rng_ref), init,
+                              str(tmp_path / "ref"), 7, ckpt_every=2,
+                              sharded=False, rng=rng_ref)
+
+    d = str(tmp_path / "crash")
+    rng_a = np.random.default_rng(42)
+    fault.install("resilient.step", "error", at=6)
+    with pytest.raises(fault.InjectedFault):
+        fault.run_resilient(make_step(rng_a), init, d, 7, ckpt_every=2,
+                            sharded=False, max_step_retries=0, rng=rng_a)
+    fault.clear()
+    # restart with a FRESH generator: its state must be rewound to the
+    # committed step's snapshot, not the seed
+    rng_b = np.random.default_rng(42)
+    run = fault.run_resilient(make_step(rng_b), init, d, 7, ckpt_every=2,
+                              sharded=False, rng=rng_b)
+    assert run.resumed_from == 4
+    np.testing.assert_array_equal(run.state["w"], ref.state["w"])
+
+
+def test_rng_state_encode_roundtrip_both_kinds():
+    # RandomState (MT19937 tuple) and Generator (bit_generator dict)
+    rs = np.random.RandomState(7)
+    rs.randn(3)
+    snap = fault.rng_state_encode(rs)
+    rs2 = np.random.RandomState(0)
+    fault.rng_state_restore(rs2, snap)
+    np.testing.assert_array_equal(rs.randn(4), rs2.randn(4))
+
+    gen = np.random.default_rng(9)
+    gen.standard_normal(3)
+    snap = fault.rng_state_encode(gen)
+    assert json.loads(json.dumps(snap)) is not None   # JSON-safe
+    gen2 = np.random.default_rng(0)
+    fault.rng_state_restore(gen2, snap)
+    np.testing.assert_array_equal(gen.standard_normal(4),
+                                  gen2.standard_normal(4))
+
+    # non-PCG bit generators carry ndarray state (MT19937's 624-word
+    # key): the encode must still be JSON-safe and round-trip exactly
+    mt = np.random.Generator(np.random.MT19937(5))
+    mt.standard_normal(2)
+    snap = fault.rng_state_encode(mt)
+    snap = json.loads(json.dumps(snap))   # through a real JSON boundary
+    mt2 = np.random.Generator(np.random.MT19937(0))
+    fault.rng_state_restore(mt2, snap)
+    np.testing.assert_array_equal(mt.standard_normal(3),
+                                  mt2.standard_normal(3))
+    # kind mismatch is a loud error, not silent corruption
+    with pytest.raises(mx.MXNetError, match="RandomState"):
+        fault.rng_state_restore(np.random.default_rng(0),
+                                fault.rng_state_encode(rs))
 
 
 # ---------------------------------------------------------------------------

@@ -41,6 +41,14 @@ def test_two_process_dist_sync_via_launcher():
     _run_launcher(2, "dist_sync_spmd.py", "dist sync semantics OK")
 
 
+def test_two_process_barrier_timeout_names_missing_rank():
+    """Rank 1 skips the barrier; rank 0's MXNET_KVSTORE_BARRIER_TIMEOUT
+    must fire a typed BarrierTimeout NAMING rank 1 (attribution through
+    the jax.distributed coordinator KV store)."""
+    _run_launcher(2, "dist_barrier_timeout.py",
+                  "barrier timeout peer-skip OK", timeout=240)
+
+
 def test_eight_process_flagship_dp():
     """n=8 flagship DP: real transformer grads through the compressed +
     uncompressed kvstore dist paths, per-rank numerics asserted

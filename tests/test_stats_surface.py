@@ -131,3 +131,35 @@ def test_feed_occupancy_sum_advances_per_consume():
     # each sample counts the batch being taken, so the sum is >= samples
     # and <= samples * (depth + 1)
     assert d_samples <= d_sum <= d_samples * 3
+
+
+def test_kvstore_zero_collective_clocks_advance_together():
+    """The ZeRO bucketed-collective clocks (KV_STATS reduce_scatter_* /
+    allgather_*) advance as a us/buckets/bytes triplet per dispatched
+    bucket — the lanes StepTimeline diffs for elastic attribution."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from incubator_mxnet_tpu import kvstore as kv
+    from incubator_mxnet_tpu.optimizer.sharded import to_shards
+    from incubator_mxnet_tpu.parallel import dp_mesh
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs the forced 8-device mesh")
+    mesh = dp_mesh(4)
+    before = kv.KV_STATS.snapshot()
+    g = jax.device_put(np.ones((4, 6), np.float32),
+                       NamedSharding(mesh, P("dp", None)))
+    kv.reduce_scatter_buckets([g], mesh, scale=0.25)
+    s = jax.device_put(to_shards(np.arange(6, dtype=np.float32), 4),
+                       NamedSharding(mesh, P("dp", None)))
+    kv.allgather_buckets([s], [(6, (6,))], mesh)
+    after = kv.KV_STATS.snapshot()
+    assert after["reduce_scatter_buckets"] == \
+        before["reduce_scatter_buckets"] + 1
+    assert after["reduce_scatter_us"] > before["reduce_scatter_us"]
+    assert after["reduce_scatter_bytes"] == \
+        before["reduce_scatter_bytes"] + 6 * 4
+    assert after["allgather_buckets"] == before["allgather_buckets"] + 1
+    assert after["allgather_us"] > before["allgather_us"]
+    assert after["allgather_bytes"] == before["allgather_bytes"] + 6 * 4

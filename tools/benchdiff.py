@@ -67,6 +67,13 @@ TREND_KEYS = {
     "fused_step_images_per_sec": "higher",
     "fused_step_mfu": "higher",
     "fused_step_speedup_vs_unfused": "higher",
+    # elastic phase (mx.fault.elastic ZeRO trainer, PR 12): per-replica
+    # optimizer-state memory must keep dropping ~linearly with dp (a rise
+    # means shard layout or padding regressed), and the event-based
+    # reduce-scatter/backward overlap must not fall below the committed
+    # overlap_r07-class baseline
+    "elastic_mem_per_replica_mb": "lower",
+    "elastic_overlap_fraction": "higher",
     "per_dispatch_latency_us_sync": "lower",
     "per_dispatch_latency_us_chained": "lower",
     "serve_p99_ms_c32": "lower",
@@ -269,6 +276,21 @@ def self_test():
                                    fused_step_mfu=0.40))
     check("improving fused_step keys pass with improvements reported",
           rep["status"] == "ok" and len(rep["improvements"]) == 2)
+    # elastic keys (PR 12): rising per-replica state memory or a falling
+    # overlap fraction gates the trend
+    elastic_base = {"backend_ok": True, "elastic_mem_per_replica_mb": 1.0,
+                    "elastic_overlap_fraction": 1.0}
+    rep = compare(elastic_base,
+                  dict(elastic_base, elastic_mem_per_replica_mb=1.5,
+                       elastic_overlap_fraction=0.6))
+    check("elastic mem rise / overlap fall is a regression",
+          rep["status"] == "regression"
+          and {r["key"] for r in rep["regressions"]}
+          == {"elastic_mem_per_replica_mb", "elastic_overlap_fraction"})
+    rep = compare(elastic_base,
+                  dict(elastic_base, elastic_mem_per_replica_mb=0.5))
+    check("improving elastic mem passes with improvement reported",
+          rep["status"] == "ok" and len(rep["improvements"]) == 1)
     # io uint8 fast-path keys (PR 9): falling pool throughput, RISING
     # host->device bytes/img, or a falling decode share gates the trend
     io_base = {"backend_ok": True, "io_images_per_sec_uint8": 2000.0,

@@ -12,10 +12,26 @@ protocol plus auto-resume lose nothing.
 Usage:
     python tools/crashtest.py [--steps 30] [--ckpt-every 5] [--kill-at N]
                               [--dir DIR] [--seed 0]
+    python tools/crashtest.py --elastic [--resume-dp 4] [...]
 
-Exit code 0 on parity; non-zero otherwise. Registered as a slow-marked
-pytest in tests/test_fault.py so tier-1 stays fast but nightly exercises a
-real SIGKILL.
+`--elastic` switches to the distributed mode (ISSUE 12): the child trains
+the ZeRO-sharded `mx.fault.elastic` trainer on an 8-way virtual CPU mesh,
+is SIGKILLed mid-epoch via `elastic.step:<N>:kill`, and the restart —
+optionally onto a SMALLER dp via `--resume-dp` (shard repartition
+included) — must reproduce the uninterrupted run's parameters AND
+optimizer-state shards bit-exactly.
+
+Exact-arithmetic harness note: the elastic child's model is linear in the
+parameters with integer-valued per-sample gradient contributions on a
+2^-15 lattice (SGD momentum=1.0, lr=2^-2, ≤64 steps), so every partial
+sum any reduction order can form is exactly representable in float32 —
+cross-mesh reductions (dp=8 vs dp=4 group sums differently) are therefore
+BIT-IDENTICAL, and the parity check tests the checkpoint/repartition
+protocol, not float summation order.
+
+Exit code 0 on parity; non-zero otherwise. Registered as slow-marked
+pytests in tests/test_fault.py / tests/test_elastic.py so tier-1 stays
+fast but nightly exercises a real SIGKILL.
 """
 from __future__ import annotations
 
@@ -57,6 +73,55 @@ def _child(args):
     return 0
 
 
+def _elastic_child(args):
+    """Elastic-mode training subprocess: ZeRO trainer on an 8-way virtual
+    CPU mesh, exact-lattice linear model (see module docstring), dp from
+    --dp. Dumps final params + optimizer-state + accounting to
+    final.json."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    sys.path.insert(0, REPO)
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.fault import elastic
+
+    seed = args.seed
+
+    def loss_fn(p, batch):
+        # linear in w: grad wrt w is mean(c) — integer-valued data on an
+        # exact f32 lattice, so any reduction order gives identical bits
+        return jnp.mean(batch["c"] @ p["w"]) + jnp.mean(
+            batch["c"][:, :8] @ p["v"].reshape(8, 2))
+
+    def batch_fn(step):
+        r = np.random.RandomState(seed * 100003 + step)
+        return {"c": r.randint(-8, 9, (64, 24)).astype(np.float32)}
+
+    params = {"w": (np.arange(24, dtype=np.float32) - 12) / 4.0,
+              "v": np.linspace(-1, 1, 16).astype(np.float32)}
+    run = elastic.run_elastic(loss_fn, params, batch_fn, args.dir,
+                              args.steps, optimizer="sgd", dp=args.dp,
+                              ckpt_every=args.ckpt_every, keep_last=3,
+                              momentum=1.0, learning_rate=0.25)
+    out = {"resumed_from": run.resumed_from, "dp": run.trainer.dp,
+           "params": {k: v.tolist() for k, v in run.params().items()},
+           "opt": {k: [leaf.tolist() for leaf in _flat_state(v)]
+                   for k, v in run.opt_state().items()}}
+    with open(os.path.join(args.dir, "final.json"), "w") as f:
+        json.dump(out, f)
+    return 0
+
+
+def _flat_state(st):
+    if st is None:
+        return []
+    if isinstance(st, tuple):
+        return [l for s in st for l in _flat_state(s)]
+    return [st]
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--steps", type=int, default=30)
@@ -66,11 +131,20 @@ def main(argv=None):
                          "(0 = random in [2, steps-1])")
     ap.add_argument("--dir", default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--elastic", action="store_true",
+                    help="distributed mode: ZeRO elastic trainer on the "
+                         "8-way virtual CPU mesh")
+    ap.add_argument("--dp", type=int, default=8,
+                    help="elastic mode: initial dp size")
+    ap.add_argument("--resume-dp", type=int, default=None,
+                    help="elastic mode: dp size for the restarted run "
+                         "(default: same as --dp; smaller = elastic "
+                         "restart with shard repartition)")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
     if args.child:
-        return _child(args)
+        return _elastic_child(args) if args.elastic else _child(args)
 
     workdir = args.dir or tempfile.mkdtemp(prefix="mx_crashtest_")
     kill_at = args.kill_at or random.randint(2, max(2, args.steps - 1))
@@ -78,15 +152,19 @@ def main(argv=None):
                 "PYTHONPATH": REPO + os.pathsep
                 + os.environ.get("PYTHONPATH", "")}
 
-    def run_child(tag, extra_env):
+    def run_child(tag, extra_env, dp=None):
         d = os.path.join(workdir, tag)
         cmd = [sys.executable, os.path.abspath(__file__), "--child",
                "--dir", d, "--steps", str(args.steps),
                "--ckpt-every", str(args.ckpt_every),
                "--seed", str(args.seed)]
+        if args.elastic:
+            cmd += ["--elastic", "--dp", str(dp or args.dp)]
         proc = subprocess.run(cmd, env={**base_env, **extra_env},
                               capture_output=True, text=True, timeout=600)
         return d, proc
+
+    point = "elastic.step" if args.elastic else "resilient.step"
 
     # 1. uninterrupted reference
     ref_dir, proc = run_child("ref", {})
@@ -97,15 +175,16 @@ def main(argv=None):
 
     # 2. run that SIGKILLs itself mid-training
     crash_dir, proc = run_child(
-        "crash", {"MXNET_FAULT_SPEC": f"resilient.step:{kill_at}:kill"})
+        "crash", {"MXNET_FAULT_SPEC": f"{point}:{kill_at}:kill"})
     if proc.returncode == 0:
         print("crashtest: child survived its own SIGKILL?", file=sys.stderr)
         return 1
     print(f"crashtest: child SIGKILLed at step hit {kill_at} "
           f"(rc={proc.returncode})")
 
-    # 3. restart with injection disarmed: must resume and finish
-    crash_dir, proc = run_child("crash", {})
+    # 3. restart with injection disarmed: must resume and finish —
+    #    elastic mode optionally restarts onto a SMALLER dp mesh
+    crash_dir, proc = run_child("crash", {}, dp=args.resume_dp)
     if proc.returncode != 0:
         print(proc.stdout + proc.stderr, file=sys.stderr)
         print("crashtest: restarted run failed", file=sys.stderr)
@@ -121,6 +200,41 @@ def main(argv=None):
         print("crashtest: restart did not resume from a checkpoint",
               file=sys.stderr)
         return 1
+    if args.elastic:
+        if args.resume_dp and got["dp"] != args.resume_dp:
+            print(f"crashtest: restart ran dp={got['dp']}, wanted "
+                  f"{args.resume_dp}", file=sys.stderr)
+            return 1
+        if set(ref["params"]) != set(got["params"]):
+            print("crashtest: PARAM KEY SETS DIFFER", file=sys.stderr)
+            return 1
+        for name in ref["params"]:
+            if not np.array_equal(ref["params"][name],
+                                  got["params"][name]):
+                print(f"crashtest: PARAM {name} DIVERGED", file=sys.stderr)
+                return 1
+        if set(ref["opt"]) != set(got["opt"]):
+            print("crashtest: OPT STATE KEY SETS DIFFER", file=sys.stderr)
+            return 1
+        for name in ref["opt"]:
+            # leaf-count check first: a restart that silently DROPPED the
+            # optimizer state must not pass via an empty zip()
+            if len(ref["opt"][name]) != len(got["opt"].get(name, [])):
+                print(f"crashtest: OPT STATE {name} leaf count differs "
+                      f"({len(ref['opt'][name])} vs "
+                      f"{len(got['opt'].get(name, []))})", file=sys.stderr)
+                return 1
+            for i, (a, b) in enumerate(zip(ref["opt"][name],
+                                           got["opt"][name])):
+                if not np.array_equal(a, b):
+                    print(f"crashtest: OPT STATE {name}[{i}] DIVERGED",
+                          file=sys.stderr)
+                    return 1
+        print(f"crashtest: elastic parity OK over {args.steps} steps "
+              f"(kill at {kill_at}, dp {args.dp} -> "
+              f"{args.resume_dp or args.dp}, params + optimizer state "
+              f"bit-exact)")
+        return 0
     if not np.allclose(ref["w"], got["w"], rtol=0, atol=0):
         print("crashtest: FINAL PARAMS DIVERGED", file=sys.stderr)
         print(" ref:", ref["w"][:4], file=sys.stderr)
