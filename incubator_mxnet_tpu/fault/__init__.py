@@ -250,6 +250,16 @@ def _log_event(event, **fields):
         logger.info("%s %s", event, json.dumps(fields, default=str))
     except Exception:
         logger.info("%s %r", event, fields)
+    # every structured fault/restart/skip log line also feeds the flight
+    # recorder (telemetry.trace.FLIGHTREC): fault injections, worker
+    # restarts, nonfinite skips, elastic shrinks land in the post-mortem
+    # ring — and in the SIGKILL-durable spool when MXNET_FLIGHTREC_DIR is
+    # set — without each call site having to know about it
+    try:
+        from ..telemetry.trace import flightrec_record
+        flightrec_record("fault", event, **fields)
+    except Exception:
+        pass
 
 
 def _poison_nan(value):
@@ -354,6 +364,18 @@ def retrying(max_attempts=3, backoff=0.05, max_backoff=2.0,
     return deco
 
 
+def _flightrec_watchdog(message):
+    """Black-box the stall before WatchdogTimeout unwinds the stack: the
+    ring names the spans that were open when the region blew its budget.
+    Crash-path code — must never raise."""
+    try:
+        from ..telemetry.trace import flightrec_record, flightrec_maybe_dump
+        flightrec_record("watchdog", message)
+        flightrec_maybe_dump("watchdog")
+    except Exception:
+        pass
+
+
 @contextmanager
 def watchdog(seconds, message=None):
     """Bound the wall-clock time of a region.
@@ -371,13 +393,27 @@ def watchdog(seconds, message=None):
     import signal
     main = threading.current_thread() is threading.main_thread()
     if main and hasattr(signal, "setitimer"):
+        fired = [False]
+
         def _handler(signum, frame):
+            fired[0] = True
             raise WatchdogTimeout(msg)
         prev_handler = signal.signal(signal.SIGALRM, _handler)
         outer_delay, _ = signal.setitimer(signal.ITIMER_REAL, seconds)
         t0 = time.monotonic()
         try:
             yield
+        except WatchdogTimeout:
+            # black-box HERE, not in the signal handler: by the time the
+            # exception unwound to this frame every lock the interrupted
+            # code held (incl. the flight recorder's own) is released —
+            # recording inside the handler could deadlock on it. Only
+            # when OUR timer fired: a nested inner watchdog's expiry
+            # unwinding through this frame must not be re-attributed to
+            # this (never-expired) region
+            if fired[0]:
+                _flightrec_watchdog(msg)
+            raise
         finally:
             signal.setitimer(signal.ITIMER_REAL, 0)
             signal.signal(signal.SIGALRM, prev_handler)
@@ -394,6 +430,7 @@ def watchdog(seconds, message=None):
         finally:
             timer.cancel()
         if expired.is_set():
+            _flightrec_watchdog(msg)
             raise WatchdogTimeout(msg)
 
 
@@ -603,7 +640,11 @@ def run_resilient(step_fn, state, ckpt_dir, num_steps, *, ckpt_every=10,
     uninterrupted run would have made. Returns a ResilientRun.
     """
     from .. import checkpoint as ckpt
+    from ..telemetry import install_crash_hooks, span as _span
 
+    # a resilient run should always leave a black box (hooks are no-ops
+    # unless MXNET_FLIGHTREC_DIR is set)
+    install_crash_hooks()
     run = ResilientRun()
     entry = ckpt.latest_entry(ckpt_dir)
     if entry is not None:
@@ -652,9 +693,13 @@ def run_resilient(step_fn, state, ckpt_dir, num_steps, *, ckpt_every=10,
         run.step_retries += 1
 
     def _attempt(step):
-        with watchdog(watchdog_seconds):
-            inject("resilient.step")
-            return step_fn(state, step)
+        # span OUTSIDE the watchdog: its span_open flight-recorder event
+        # (step index included) is on disk before the step body runs, so
+        # a SIGKILL mid-step leaves a black box naming the in-flight step
+        with _span("resilient.step", step=step):
+            with watchdog(watchdog_seconds):
+                inject("resilient.step")
+                return step_fn(state, step)
 
     run_step = retrying(max_attempts=max_step_retries + 1,
                         backoff=retry_backoff, retry_on=tuple(retry_on),

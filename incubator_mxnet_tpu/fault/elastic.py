@@ -313,6 +313,18 @@ class ElasticTrainer:
                 who = (f"rank(s) {stalled} unresponsive" if stalled
                        else "every rank answered the probe "
                             "(transient stall)")
+                # black-box the attribution before the raise unwinds:
+                # the flight recorder names the stalled rank(s) even if
+                # the driver's recovery path swallows this exception
+                try:
+                    from ..telemetry.trace import (flightrec_record,
+                                                   flightrec_maybe_dump)
+                    flightrec_record("collective_timeout", point,
+                                     stalled_ranks=stalled, dp=self.dp,
+                                     timeout_s=timeout)
+                    flightrec_maybe_dump("straggler")
+                except Exception:
+                    pass
                 raise StragglerTimeout(
                     f"collective {point!r} stalled past {timeout:.3g}s; "
                     f"{who}", report=report, stalled_ranks=stalled)
@@ -695,7 +707,11 @@ def run_elastic(loss_fn, params, batch_fn, ckpt_dir, num_steps, *,
         protocol. Returns an ElasticRun.
     """
     from .. import checkpoint as ckpt
+    from ..telemetry import install_crash_hooks, span as _span
 
+    # an elastic run should always leave a black box (hooks are no-ops
+    # unless MXNET_FLIGHTREC_DIR is set)
+    install_crash_hooks()
     run = ElasticRun()
     shrink_to = shrink_to or (lambda d: d // 2)
     kw = dict(collective_timeout=collective_timeout,
@@ -733,7 +749,12 @@ def run_elastic(loss_fn, params, batch_fn, ckpt_dir, num_steps, *,
     step = completed
     while step < num_steps:
         try:
-            with _watchdog(watchdog_seconds):
+            # span OUTSIDE the watchdog: the span_open flight-recorder
+            # event (step + dp) hits the spool before the step body runs,
+            # so a SIGKILL mid-step leaves a black box naming the
+            # in-flight step and mesh (crashtest --flightrec asserts it)
+            with _span("elastic.step", step=step, dp=trainer.dp), \
+                    _watchdog(watchdog_seconds):
                 if trainer._pending_gather:
                     # worker lost mid-gather last attempt: the donated
                     # update already happened — finish the gather only

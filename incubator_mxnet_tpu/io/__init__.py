@@ -796,11 +796,13 @@ class ImageRecordIter(DataIter):
         to a bounded number of CONSECUTIVE times, re-raise the original
         exception once the budget is exhausted."""
         from .. import fault as _fault
+        from ..telemetry import trace as _trace
         while True:
             try:
                 _fault.inject("io.imagerec")
                 job = self._pool.submit(self._batch_ids(), idx,
-                                        self._epoch_seed())
+                                        self._epoch_seed(),
+                                        ctx=_trace.current_context())
             except (IOError, OSError, TimeoutError) as e:
                 if self._restarts < self._max_restarts:
                     self._restarts += 1

@@ -27,6 +27,7 @@ shard split across any number of workers reproduces the identical batch.
 import json
 import os
 import sys
+import time
 
 
 def _load_standalone(name, path):
@@ -133,6 +134,7 @@ def main():
         start, count, seed = cmd["start"], cmd["count"], cmd["seed"]
         images, labels, indices = slots[s]
         out = {"batch": b, "slot": s, "start": start}
+        t0 = time.perf_counter_ns()
         try:
             idx = indices[start:start + count]
             if native is not None:
@@ -176,8 +178,18 @@ def main():
                 # aggregates these into io_stats(), so stage attribution
                 # survives the process boundary
                 out["stages"] = nat.imagerec_stage_stats(reset=True)
+            else:
+                # PIL path: no native clocks — the whole shard's wall
+                # time IS the decode stage, so attribution (and the
+                # worker trace lane) still survives the boundary
+                out["stages"] = {
+                    "decode_ns": time.perf_counter_ns() - t0,
+                    "decoded_records": int(count)}
         except BaseException as e:
             out["error"] = f"{type(e).__name__}: {e}"
+        # shard wall time: the parent renders it as this worker's lane in
+        # the consuming iterator's Chrome trace
+        out["dur_ns"] = time.perf_counter_ns() - t0
         reply(out)
 
     shm.close()

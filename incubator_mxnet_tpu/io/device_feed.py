@@ -250,8 +250,15 @@ class DeviceFeed:
             self._shard_cache = {}
         q = self._queue = _queue.Queue(maxsize=self._depth)
         stop = self._stop = threading.Event()
+        # trace propagation across the thread hop: a fresh thread starts
+        # with an EMPTY contextvars context, so without this capture the
+        # feeder's `feed.stage` spans would render parentless instead of
+        # nesting under the consumer's step (the ctx travels as a Thread
+        # arg — ordered by Thread.start, no shared attribute)
+        from ..telemetry import trace as _trace
+        ctx = _trace.current_context()
         self._thread = threading.Thread(
-            target=self._worker, args=(q, stop), daemon=True,
+            target=self._worker, args=(q, stop, ctx), daemon=True,
             name="mx-device-feed")
         self._thread.start()
 
@@ -337,8 +344,12 @@ class DeviceFeed:
             pass
 
     # -- feeder thread --------------------------------------------------
-    def _worker(self, q, stop):
-        from ..telemetry import record_span
+    def _worker(self, q, stop, ctx=None):
+        from ..telemetry import record_span, trace as _trace
+        if ctx is not None:
+            # adopt the consumer's trace context: feed.stage spans nest
+            # under the step that was open when this epoch started
+            _trace.attach(ctx)
         fetch = _fetch_with_restarts(self._source, "io.device_feed",
                                      self._max_restarts,
                                      on_restart=lambda: _bump("restarts"))

@@ -36,7 +36,12 @@ import numpy as _np
 from ..base import MXNetError, get_env
 from ..deploy import _np_dtype
 from .. import fault as _fault
+from ..telemetry import record_span, trace as _trace
 from .metrics import ServeMetrics, SERVE_STATS
+
+
+def _profiler_on():
+    return _trace._profiler_running()
 
 __all__ = [
     "ServeError", "QueueFullError", "RequestTimeout", "ServerClosed",
@@ -204,13 +209,17 @@ class CallableModel:
 # server
 # ---------------------------------------------------------------------------
 class _Request:
-    __slots__ = ("inputs", "future", "deadline", "t_submit")
+    __slots__ = ("inputs", "future", "deadline", "t_submit", "ctx")
 
-    def __init__(self, inputs, deadline):
+    def __init__(self, inputs, deadline, ctx=None):
         self.inputs = inputs
         self.future = Future()
         self.deadline = deadline
         self.t_submit = time.perf_counter()
+        # the request's root TraceContext ("serve.request"): minted on the
+        # caller thread, carried across the queue so batcher-side spans
+        # (queue-wait / execute / reply) land in the SAME trace
+        self.ctx = ctx
 
 
 class Server:
@@ -305,6 +314,9 @@ class Server:
                 logging.getLogger("mx.serve").warning(
                     "metrics endpoint on port %s unavailable (%s); "
                     "serving continues without /metrics", port, e)
+        # flight-recorder crash hooks (no-ops unless MXNET_FLIGHTREC_DIR):
+        # a served process should always leave a black box
+        _trace.install_crash_hooks()
         self._started = True
         self._thread.start()
         return self
@@ -370,30 +382,71 @@ class Server:
         after close()."""
         if not self._started:
             raise ServeError("Server.start() (or `with Server(...)`) first")
+        t_enter = time.perf_counter()
         rows = self._check_row(inputs)
         _fault.inject("serve.enqueue")
         dl = (deadline_ms / 1e3 if deadline_ms is not None
               else self.default_deadline_s)
+        # one request = one trace: the root "serve.request" context is
+        # minted HERE on the caller thread (child of any ambient span,
+        # else a new sampled root) and rides the queue — every later
+        # stage's span carries the same trace_id across the thread hop.
+        # Minted only while a COLLECTOR can consume the ids (profiler /
+        # flightrec spool / explicit MXNET_TRACE_SAMPLE — see
+        # trace.request_root): ids nobody can see are pure per-request
+        # cost on a GIL-saturated server, and MXNET_TELEMETRY=0 disables
+        # tracing outright — the always-on default stays within the ≤2%
+        # A/B guard.
+        ctx = _trace.request_root("serve.request")
         req = _Request(rows, None if dl is None
-                       else time.perf_counter() + dl)
+                       else time.perf_counter() + dl, ctx=ctx)
         shed_req = None
+        rejected_depth = None
         with self._cv:
             if self._closing:
                 raise ServerClosed("server is closed")
             if len(self._queue) >= self.max_queue:
                 if self.overload_policy == "reject":
-                    self.metrics.count("rejected")
-                    raise QueueFullError(
-                        f"queue full ({self.max_queue}); request rejected",
-                        policy="reject")
-                shed_req = self._queue.popleft()
-            self._queue.append(req)
-            depth = len(self._queue)
-            self._cv.notify()
+                    rejected_depth = len(self._queue)
+                else:
+                    shed_req = self._queue.popleft()
+            if rejected_depth is None:
+                self._queue.append(req)
+                depth = len(self._queue)
+                self._cv.notify()
+        if rejected_depth is not None:
+            # flight-recorder I/O (incl. a rate-limited dump) OUTSIDE the
+            # server lock: an overload black box must not stall admission
+            self.metrics.count("rejected")
+            _trace.flightrec_record(
+                "serve.reject", self.name, depth=rejected_depth,
+                trace_id=ctx.trace_id if ctx else None)
+            _trace.flightrec_maybe_dump("serve.overload")
+            raise QueueFullError(
+                f"queue full ({self.max_queue}); request rejected",
+                policy="reject")
         self.metrics.count("requests")
         self.metrics.set_queue_depth(depth)
+        if ctx is not None and _profiler_on():
+            # caller-thread stage span: admission + enqueue cost, the
+            # first node under serve.request (the thread-boundary anchor).
+            # Stage-level spans only record while a trace is being
+            # COLLECTED (profiler running): at thousands of requests/s
+            # their histogram value is nil next to the timeline's
+            # wait/exec totals, and the always-on path must stay ≤2%
+            # overhead — the per-request root span below carries the
+            # aggregate either way
+            record_span("serve.enqueue",
+                        (time.perf_counter() - t_enter) * 1e6,
+                        ts_us=t_enter * 1e6, cat="serve",
+                        ctx=_trace.child_context(ctx, "serve.enqueue"),
+                        queue_depth=depth)
         if shed_req is not None:
             self.metrics.count("shed")
+            _trace.flightrec_record(
+                "serve.shed", self.name, depth=depth,
+                trace_id=shed_req.ctx.trace_id if shed_req.ctx else None)
+            _trace.flightrec_maybe_dump("serve.overload")
             _fail(shed_req, QueueFullError(
                 f"queue full ({self.max_queue}); oldest request shed",
                 policy="shed"))
@@ -460,6 +513,10 @@ class Server:
             for req in batch:
                 if req.deadline is not None and now > req.deadline:
                     self.metrics.count("timeouts")
+                    _trace.flightrec_record(
+                        "serve.timeout", self.name,
+                        waited_ms=round((now - req.t_submit) * 1e3, 1),
+                        trace_id=req.ctx.trace_id if req.ctx else None)
                     _fail(req, RequestTimeout(
                         "deadline expired after "
                         f"{(now - req.t_submit) * 1e3:.1f}ms in queue"))
@@ -493,12 +550,35 @@ class Server:
             for req in batch:
                 _fail(req, err)
             return
-        exec_ms = (time.perf_counter() - t0) * 1e3
+        t_exec_end = time.perf_counter()
+        exec_ms = (t_exec_end - t0) * 1e3
         # queue wait summed over the batch's requests: the request-timeline
         # split (queued vs executing) Server.stats()["timeline"] reports
         wait_ms = sum((t0 - req.t_submit) * 1e3 for req in batch)
-        self.metrics.observe_batch(bucket, n, exec_ms, depth,
-                                   queue_wait_ms=wait_ms)
+        self.metrics.observe_batch(
+            bucket, n, exec_ms, depth, queue_wait_ms=wait_ms,
+            member_traces=[req.ctx.trace_id for req in batch if req.ctx])
+        # batcher-thread stage spans, one pair per traced request: the
+        # queue-wait (t_submit -> batch assembly done) and this batch's
+        # execution window, both children of the request's root context —
+        # with the caller-side serve.enqueue span they make the trace
+        # cross the submit -> batcher/executor thread boundary. Only
+        # while the profiler collects (see serve.enqueue above).
+        if _profiler_on():
+            for req in batch:
+                if req.ctx is None:
+                    continue
+                record_span("serve.queue_wait",
+                            (t0 - req.t_submit) * 1e6,
+                            ts_us=req.t_submit * 1e6, cat="serve",
+                            ctx=_trace.child_context(req.ctx,
+                                                     "serve.queue_wait"),
+                            bucket=bucket)
+                record_span("serve.execute", exec_ms * 1e3,
+                            ts_us=t0 * 1e6, cat="serve",
+                            ctx=_trace.child_context(req.ctx,
+                                                     "serve.execute"),
+                            bucket=bucket, batch_n=n)
         try:
             _fault.inject("serve.reply")
         except BaseException as e:
@@ -516,7 +596,34 @@ class Server:
             if req.future.set_running_or_notify_cancel():
                 req.future.set_result(row)
             self.metrics.count("replies")
-            self.metrics.observe_latency((done - req.t_submit) * 1e3)
+            # latency/margins use the SHARED pre-loop timestamp:
+            # set_result() runs client done-callbacks inline, and a
+            # per-iteration clock would bill earlier members' callback
+            # time to later members as server latency
+            total_ms = (done - req.t_submit) * 1e3
+            self.metrics.observe_latency(total_ms)
+            if req.ctx is not None and _profiler_on():
+                record_span("serve.reply",
+                            (time.perf_counter() - done) * 1e6,
+                            ts_us=done * 1e6, cat="serve",
+                            ctx=_trace.child_context(req.ctx,
+                                                     "serve.reply"))
+                # close the request's root span: enqueue -> reply, the
+                # ONE trace the whole request renders as. Span records
+                # only while a trace is being collected — the always-on
+                # request-latency aggregate is ServeMetrics (p50/p95/p99
+                # + the slowest table), so the steady-state tracing cost
+                # stays at one context mint per request (the ≤2% A/B)
+                record_span("serve.request", total_ms * 1e3,
+                            ts_us=req.t_submit * 1e6, cat="serve",
+                            ctx=req.ctx, bucket=bucket)
+            self.metrics.observe_request(
+                total_ms,
+                trace_id=req.ctx.trace_id if req.ctx else None,
+                queue_wait_ms=(t0 - req.t_submit) * 1e3,
+                exec_ms=exec_ms, batch_size=n,
+                deadline_margin_ms=((req.deadline - done) * 1e3
+                                    if req.deadline is not None else None))
 
 
 def _fail(req, exc):

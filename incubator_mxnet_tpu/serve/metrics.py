@@ -25,6 +25,7 @@ lane — the serving analog of the reference's per-worker device lanes.
 """
 from __future__ import annotations
 
+import heapq
 import threading
 import time
 from collections import deque
@@ -82,6 +83,7 @@ class ServeMetrics:
     returns plain data safe to json.dumps."""
 
     LATENCY_WINDOW = 4096    # bounded reservoir: recent-request percentiles
+    SLOWEST_K = 8            # top-K slowest-request table in the timeline
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -97,6 +99,11 @@ class ServeMetrics:
         # vs total batch EXECUTION time (the compute side)
         self.queue_wait_ms_total = 0.0
         self.exec_ms_total = 0.0
+        # top-K slowest requests: min-heap of (total_ms, seq, row) — the
+        # per-request forensics (trace id, wait/exec split, batch size,
+        # deadline margin) percentiles cannot carry
+        self._slowest = []
+        self._slow_seq = 0
 
     def count(self, key, n=1):
         with self._lock:
@@ -111,10 +118,12 @@ class ServeMetrics:
                 self.queue_depth_max = depth
 
     def observe_batch(self, bucket, occupancy, exec_ms, queue_depth,
-                      queue_wait_ms=0.0):
+                      queue_wait_ms=0.0, member_traces=None):
         """One executed batch: occupancy rows served out of `bucket` slots.
         `queue_wait_ms` is the SUM over the batch's requests of their time
-        spent queued (request-timeline attribution: wait vs compute)."""
+        spent queued (request-timeline attribution: wait vs compute).
+        `member_traces` is the list of the batch's member request trace
+        ids — the batch span links its N members in the Chrome trace."""
         pad = bucket - occupancy
         with self._lock:
             self.counters["batches"] += 1
@@ -132,15 +141,54 @@ class ServeMetrics:
             SERVE_STATS["batches"] += 1
             SERVE_STATS["padded_rows"] += pad
         # unified span lane: `span.duration_us{name="serve.batch"}` in the
-        # registry + a "serve.batch" Chrome-trace event while profiling
-        from ..telemetry import record_span
-        record_span("serve.batch", exec_ms * 1000.0, cat="serve",
-                    bucket=bucket, occupancy=occupancy,
-                    queue_depth=queue_depth)
+        # registry + a "serve.batch" Chrome-trace event while profiling.
+        # The batch span LINKS its member requests: their trace ids ride
+        # in args (capped — a bucket-256 batch must not bloat the event).
+        # Recorded only while a collector is active: this runs ON the
+        # batcher thread — the serving pipeline's serialization point —
+        # at thousands of batches/s, where each ~10us record measurably
+        # cuts throughput (the ≤2% A/B guard); the always-on batch
+        # aggregates live in this object and SERVE_STATS either way
+        from ..telemetry import record_span, trace as _trace
+        if _trace.enabled() and _trace.collector_active():
+            extra = {}
+            if member_traces:
+                extra["member_traces"] = ",".join(member_traces[:16])
+                extra["n_member_traces"] = len(member_traces)
+            record_span("serve.batch", exec_ms * 1000.0, cat="serve",
+                        bucket=bucket, occupancy=occupancy,
+                        queue_depth=queue_depth, **extra)
 
     def observe_latency(self, ms):
         with self._lock:
             self._lat_ms.append(ms)
+
+    def observe_request(self, total_ms, trace_id=None, queue_wait_ms=0.0,
+                        exec_ms=0.0, batch_size=None,
+                        deadline_margin_ms=None):
+        """Feed the top-K slowest-request table, keeping only the K
+        slowest seen (bounded min-heap). Runs on the batcher thread per
+        reply: admission is checked FIRST and the row dict is built only
+        for the rare request that actually displaces one — steady state
+        pays a compare, not a 7-key dict + four round()s."""
+        with self._lock:
+            if len(self._slowest) >= self.SLOWEST_K \
+                    and total_ms <= self._slowest[0][0]:
+                return
+            row = {"total_ms": round(total_ms, 3),
+                   "trace_id": trace_id,
+                   "queue_wait_ms": round(queue_wait_ms, 3),
+                   "exec_ms": round(exec_ms, 3),
+                   "batch_size": batch_size,
+                   "deadline_margin_ms": (round(deadline_margin_ms, 3)
+                                          if deadline_margin_ms is not None
+                                          else None)}
+            self._slow_seq += 1
+            item = (total_ms, self._slow_seq, row)
+            if len(self._slowest) < self.SLOWEST_K:
+                heapq.heappush(self._slowest, item)
+            else:
+                heapq.heapreplace(self._slowest, item)
 
     def snapshot(self):
         with self._lock:
@@ -153,6 +201,8 @@ class ServeMetrics:
             depth, depth_max = self.queue_depth, self.queue_depth_max
             wait_ms = self.queue_wait_ms_total
             exec_ms = self.exec_ms_total
+            slowest = [row for _, _, row in
+                       sorted(self._slowest, reverse=True)]
         out = dict(counters)
         out["queue_depth"] = depth
         out["queue_depth_max"] = depth_max
@@ -173,6 +223,11 @@ class ServeMetrics:
             "queue_wait_pct": round(100.0 * wait_ms / busy, 2) if busy
             else 0.0,
             "exec_pct": round(100.0 * exec_ms / busy, 2) if busy else 0.0,
+            # top-K slowest requests, slowest first: the per-request
+            # forensics row (trace id -> grep the Chrome trace / flight
+            # recorder; wait-vs-exec split; batch size; how close the
+            # deadline came)
+            "slowest": slowest,
         }
         return out
 

@@ -16,6 +16,9 @@ KVStore server profiling):
                                       allreduce breakdown from live counters
   telemetry.model_flops(...)          XLA-counted MFU numerator
   telemetry.start_metrics_server(port)  /metrics HTTP endpoint
+  telemetry.trace                     end-to-end request tracing
+                                      (TraceContext / attach) + the crash
+                                      flight recorder (flightrec_*)
 
 The legacy surfaces keep working: `profiler.dispatch_stats()`,
 `profiler.serve_stats()` and `profiler.feed_stats()` are shims over
@@ -34,6 +37,11 @@ from .registry import (Counter, Gauge, Histogram, StatsGroup, Registry,
                        REGISTRY, counter, gauge, histogram, stats_group,
                        snapshot, snapshot_json, prometheus_text,
                        DEFAULT_BUCKETS)
+from . import trace
+from .trace import (TraceContext, current_context, attach, detach,
+                    attached, new_context, child_context,
+                    flightrec_record, flightrec_dump, flightrec_maybe_dump,
+                    flightrec_events, install_crash_hooks, FLIGHTREC)
 from .steptrace import (span, current_span, record_span, StepTimeline,
                         model_flops, block_fwd_flops, cost_flops,
                         device_peak_flops)
@@ -46,6 +54,10 @@ __all__ = [
     "block_fwd_flops", "cost_flops", "device_peak_flops",
     "metrics_text", "scalar_snapshot", "start_metrics_server",
     "ensure_metrics_server",
+    "trace", "TraceContext", "current_context", "attach", "detach",
+    "attached", "new_context", "child_context", "flightrec_record",
+    "flightrec_dump", "flightrec_maybe_dump", "flightrec_events",
+    "install_crash_hooks", "FLIGHTREC",
 ]
 
 _register_env("MXNET_TELEMETRY", bool, True,

@@ -4,10 +4,13 @@ Before this module the Chrome-trace lanes (`serve.batch`, `io.feed`,
 `feed.stage`) each hand-rolled their `profiler.record_event` call and no
 single object could answer "where did this step's time go?". Now:
 
-  * `span(name, **attrs)` — nesting-aware tracer. Every span lands in the
-    profiler's Chrome-trace buffer (cat "span", with its parent's name in
-    args so the tree reconstructs) AND in the registry histogram
-    `span.duration_us{name=...}`, so `profiler.dump()` shows the lane and
+  * `span(name, **attrs)` — nesting-aware tracer. Nesting rides the trace
+    ContextVar (`telemetry.trace`), so it follows `trace.attach(ctx)`
+    across thread hops and every span carries trace/span/parent ids.
+    Every span lands in the profiler's Chrome-trace buffer (cat "span",
+    with its parent's name in args so the tree reconstructs), in the
+    registry histogram `span.duration_us{name=...}`, and in the flight
+    recorder, so `profiler.dump()` shows the lane and
     `telemetry.snapshot()` shows the aggregate without re-parsing traces.
 
   * `StepTimeline` — the per-step breakdown a train loop or server wants:
@@ -32,12 +35,12 @@ remainder: `total - data_stall - allreduce - reduce_scatter - allgather`.
 """
 from __future__ import annotations
 
-import threading
 import weakref
 from collections import OrderedDict
 
 from ..base import MXNetError, get_env
 from .registry import REGISTRY
+from . import trace as _trace
 
 __all__ = ["span", "current_span", "record_span", "StepTimeline",
            "model_flops", "block_fwd_flops", "cost_flops",
@@ -52,52 +55,95 @@ SPAN_COUNT = REGISTRY.counter(
     "span.count", help="telemetry.span completions by span name",
     labels=("name",))
 
-_tls = threading.local()
+_enabled = _trace.enabled
 
 
-def _enabled():
-    return get_env("MXNET_TELEMETRY", True, typ=bool)
+# span name -> (bound duration histogram, bound counter): the label-value
+# resolution is a dict+tuple build per call — memoized off the hot path
+# (span names are a small closed set; labeled children are never removed)
+_bound_memo = {}
 
-
-def _stack():
-    st = getattr(_tls, "stack", None)
-    if st is None:
-        st = _tls.stack = []
-    return st
+# flight-recorder duration floor for CLOSED spans (see record_span): only
+# spans at least this long are black-box-worthy; span_open events are
+# never floored
+FLIGHTREC_SPAN_FLOOR_US = 50_000.0
 
 
 def current_span():
-    """Name of the innermost open span on this thread, or None."""
-    st = getattr(_tls, "stack", None)
-    return st[-1] if st else None
+    """Name of the innermost open span on this execution context (follows
+    an attached TraceContext across thread hops), or None."""
+    ctx = _trace.current_context()
+    return ctx.name if ctx is not None else None
 
 
-def record_span(name, dur_us, ts_us=None, cat="span", **attrs):
+def record_span(name, dur_us, ts_us=None, cat="span", ctx=None, **attrs):
     """Record an externally-timed span: the one implementation behind
     every Chrome-trace lane (`serve.batch`, `io.feed`, `feed.stage`, and
     `with span(...)` itself). Feeds the `span.duration_us{name=...}`
-    histogram always (when telemetry is on) and the profiler's
-    Chrome-trace buffer when the profiler is running."""
+    histogram always (when telemetry is on), the flight-recorder ring,
+    and the profiler's Chrome-trace buffer when the profiler is running.
+
+    Trace linkage: pass `ctx` (a TraceContext) to record AS that node of
+    a request tree; with no `ctx`, the ambient `trace.current_context()`
+    — if any — becomes the parent and a fresh child id is minted. Either
+    way the trace/span/parent ids land in the event args, so the
+    cross-thread tree reassembles from the exported trace JSON."""
     if not _enabled():
         return
-    SPAN_DURATION.labels(name=name).observe(dur_us)
-    SPAN_COUNT.labels(name=name).inc()
-    from .. import profiler
-    if profiler.is_running():
-        profiler.record_event(name, cat, dur_us, ts_us=ts_us, args=attrs)
+    bounds = _bound_memo.get(name)
+    if bounds is None:
+        bounds = _bound_memo[name] = (SPAN_DURATION.labels(name=name),
+                                      SPAN_COUNT.labels(name=name))
+    bounds[0].observe(dur_us)
+    bounds[1].inc()
+    if ctx is None:
+        ambient = _trace.current_context()
+        if ambient is not None:
+            ctx = _trace.child_context(ambient, name)
+    if ctx is not None:
+        attrs.setdefault("trace_id", ctx.trace_id)
+        attrs.setdefault("span_id", ctx.span_id)
+        if ctx.parent_span_id is not None:
+            attrs.setdefault("parent_span_id", ctx.parent_span_id)
+        if ctx.parent_name is not None:
+            attrs.setdefault("parent", ctx.parent_name)
+        _trace.TRACE_STATS["spans"] += 1  # mxlint: disable=lock-shared-mutation -- documented lock-free diagnostics (DISPATCH_STATS pattern)
+    if dur_us >= FLIGHTREC_SPAN_FLOOR_US:
+        # duration floor: step/request-scale spans are black-box-worthy;
+        # sub-50ms spans at thousands/sec would evict the interesting
+        # history from the bounded ring in well under a second (and cost
+        # a spool write each when MXNET_FLIGHTREC_DIR is set). Span OPEN
+        # events (the in-flight marker) are not floored — only `span`
+        # class entries emit them, at step scale.
+        _trace.flightrec_record("span", name, dur_us=round(dur_us, 1),
+                                **attrs)
+    # cached module ref, not `from .. import profiler`: record_span runs
+    # per batch on the serving path and the import machinery costs ~1us
+    # + import-lock traffic per call
+    if _trace._profiler_running():
+        _trace._profiler_mod[0].record_event(name, cat, dur_us,
+                                             ts_us=ts_us, args=attrs)
 
 
 class span:
     """`with telemetry.span("train.step", step=n):` — time a region.
 
-    Nesting is tracked per thread: the Chrome-trace event carries the
-    enclosing span's name in `args["parent"]` and the registry histogram
-    `span.duration_us{name=...}` aggregates durations. A span is cheap
-    when `MXNET_TELEMETRY=0` (no clock reads, no records) and never
-    touches jax. Reentrant and exception-safe (the span closes on the
-    error path too, so traces stay balanced)."""
+    Nesting is tracked through the trace ContextVar: entering mints a
+    child `TraceContext` of whatever is current (starting a new trace at
+    the root, subject to MXNET_TRACE_SAMPLE), so the Chrome-trace event
+    carries the enclosing span's name in `args["parent"]` PLUS the
+    trace/span/parent ids, and — after `trace.attach(ctx)` on a worker
+    thread — nesting survives thread hops. The registry histogram
+    `span.duration_us{name=...}` aggregates durations, and the open/close
+    pair feeds the flight recorder (an in-flight span at process death is
+    named by its `span_open` spool line). A span is cheap when
+    `MXNET_TELEMETRY=0` (no clock reads, no records) and never touches
+    jax. Reentrant and exception-safe (the span closes on the error path
+    too — ContextVar tokens reset correctly even when an inner span
+    leaked open, so traces stay balanced)."""
 
-    __slots__ = ("name", "attrs", "_t0", "_parent", "_armed", "_dur")
+    __slots__ = ("name", "attrs", "_t0", "_parent", "_armed", "_dur",
+                 "_ctx", "_token")
 
     def __init__(self, name, **attrs):
         self.name = name
@@ -106,15 +152,32 @@ class span:
         self._parent = None
         self._armed = False
         self._dur = None
+        self._ctx = None
+        self._token = None
 
     def __enter__(self):
         self._armed = _enabled()
         if not self._armed:
             return self
         from .. import profiler
-        st = _stack()
-        self._parent = st[-1] if st else None
-        st.append(self.name)
+        raw = _trace._raw_context()
+        if raw is _trace.NOT_SAMPLED:
+            # inside a sampled-out trace: inherit the decision — minting
+            # a fresh root per inner span would fill the Chrome trace
+            # with orphan mid-request fragments and count one "trace"
+            # per span (head sampling samples TREES, not spans)
+            self._parent = None
+            self._ctx = None
+        else:
+            self._parent = raw.name if raw is not None else None
+            self._ctx = _trace.child_context(raw, self.name)
+            if self._ctx is not None:
+                self._token = _trace._push(self._ctx)
+                _trace.flightrec_record("span_open", self.name,
+                                        **self.attrs)
+            elif raw is None:
+                # root draw came up sampled-out: mark the subtree
+                self._token = _trace._push(_trace.NOT_SAMPLED)
         self._t0 = profiler._now_us()
         return self
 
@@ -123,25 +186,27 @@ class span:
             return False
         from .. import profiler
         t1 = profiler._now_us()
-        st = _stack()
-        if self.name in st:
-            # normally st[-1] == self.name; popping through deeper names
-            # self-heals the stack when an inner span leaked open on an
-            # exception path, so nesting stays sane for the rest of the
-            # thread's life
-            while st and st.pop() != self.name:
-                pass
+        if self._token is not None:
+            _trace._reset(self._token)
+            self._token = None
         attrs = dict(self.attrs)
         if self._parent is not None:
             attrs["parent"] = self._parent
         self._dur = t1 - self._t0
-        record_span(self.name, self._dur, ts_us=self._t0, **attrs)
+        record_span(self.name, self._dur, ts_us=self._t0, ctx=self._ctx,
+                    **attrs)
         return False
 
     @property
     def duration_us(self):
         """Set only after exit (None while open or telemetry disabled)."""
         return self._dur
+
+    @property
+    def context(self):
+        """The span's TraceContext (None before entry, when telemetry is
+        off, or when the root was sampled out)."""
+        return self._ctx
 
 
 def _stall_counters():

@@ -41,3 +41,14 @@ def _seed_all(request):
     _np.random.seed(seed % (2 ** 31))
     mx.seed(seed)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _fresh_trace_env_memo():
+    """The tracing layer TTL-caches MXNET_TELEMETRY/MXNET_TRACE_SAMPLE
+    (50ms, hot-path cost): expire around every test so a monkeypatched
+    value from one test can never leak into the next."""
+    from incubator_mxnet_tpu.telemetry import trace
+    trace._expire_env_memo()
+    yield
+    trace._expire_env_memo()

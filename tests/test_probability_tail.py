@@ -157,7 +157,12 @@ def test_relaxed_bernoulli():
     v = np.linspace(0.02, 0.98, 25)
     _assert_logprob_matches(d, t, v, rtol=1e-3, atol=1e-4)
     s = _np_of(d.sample((4000,)))
-    assert ((s > 0) & (s < 1)).all()
+    # closed bounds: sigmoid((logit + logistic)/T) SATURATES to exactly
+    # 0.0/1.0 in f32 for tail draws (|x| ≳ 17), so a strict open-interval
+    # check flips on the per-process seed (torch f32 saturates the same
+    # way); the interior must still hold for essentially every sample
+    assert ((s >= 0) & (s <= 1)).all()
+    assert ((s > 0) & (s < 1)).mean() > 0.999
     want = t.sample((4000,)).numpy()
     assert abs(s.mean() - want.mean()) < 0.05
 

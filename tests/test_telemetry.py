@@ -271,6 +271,7 @@ def test_record_event_timestamps_monotonic_across_threads():
 
 def test_spans_disabled_by_env(monkeypatch):
     monkeypatch.setenv("MXNET_TELEMETRY", "0")
+    telemetry.trace._expire_env_memo()   # the knob is TTL-cached (50ms)
     before = telemetry.snapshot().get('span.count{name="off.span"}', 0)
     with telemetry.span("off.span"):
         pass

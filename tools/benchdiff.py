@@ -77,6 +77,12 @@ TREND_KEYS = {
     "per_dispatch_latency_us_sync": "lower",
     "per_dispatch_latency_us_chained": "lower",
     "serve_p99_ms_c32": "lower",
+    # open-loop serving sweep (PR 13, mx.telemetry.trace): the saturation
+    # knee of the offered-load curve must not move left, and the tail at
+    # the 0.8x-knee operating point must not grow — the two numbers
+    # SLO-aware admission will be judged against
+    "serve_knee_rps": "higher",
+    "serve_p99_ms_at_0p8_knee": "lower",
 }
 
 DEFAULT_THRESHOLD = 0.10
@@ -307,6 +313,20 @@ def self_test():
     rep = compare(io_base, dict(io_base, io_images_per_sec_uint8=3000.0,
                                 io_host_bytes_per_img_uint8=110000.0))
     check("improving uint8 io keys pass with improvements reported",
+          rep["status"] == "ok" and len(rep["improvements"]) == 2)
+    # open-loop serving keys (PR 13): a leftward knee or a fatter tail at
+    # the 0.8x-knee operating point gates the trend
+    ol_base = {"backend_ok": True, "serve_knee_rps": 100.0,
+               "serve_p99_ms_at_0p8_knee": 50.0}
+    rep = compare(ol_base, dict(ol_base, serve_knee_rps=80.0,
+                                serve_p99_ms_at_0p8_knee=80.0))
+    check("open-loop knee drop / 0.8x-knee p99 rise is a regression",
+          rep["status"] == "regression"
+          and {r["key"] for r in rep["regressions"]}
+          == {"serve_knee_rps", "serve_p99_ms_at_0p8_knee"})
+    rep = compare(ol_base, dict(ol_base, serve_knee_rps=130.0,
+                                serve_p99_ms_at_0p8_knee=40.0))
+    check("improving open-loop keys pass with improvements reported",
           rep["status"] == "ok" and len(rep["improvements"]) == 2)
     missing_only_new = {"backend_ok": True,
                         "io_pipeline_images_per_sec": 700.0}

@@ -13,6 +13,15 @@ Usage:
     python tools/crashtest.py [--steps 30] [--ckpt-every 5] [--kill-at N]
                               [--dir DIR] [--seed 0]
     python tools/crashtest.py --elastic [--resume-dp 4] [...]
+    python tools/crashtest.py --flightrec [--steps 12] [...]
+
+`--flightrec` tests the flight recorder's SIGKILL parity (ISSUE 13): the
+elastic child runs with `MXNET_FLIGHTREC_DIR` set, so every span open /
+fault event is spooled as a flushed JSONL line; the child SIGKILLs itself
+mid-step and the parent asserts the spool landed, every line parses as
+JSON, and the tail names the in-flight step + mesh (the `elastic.step`
+span_open with its `step`/`dp` fields) and the injected kill — a dead
+process leaves a black box, with no handler having run.
 
 `--elastic` switches to the distributed mode (ISSUE 12): the child trains
 the ZeRO-sharded `mx.fault.elastic` trainer on an 8-way virtual CPU mesh,
@@ -122,6 +131,71 @@ def _flat_state(st):
     return [st]
 
 
+def _flightrec_mode(workdir, kill_at, run_child, point):
+    """SIGKILL a flight-recorded elastic run and audit its black box."""
+    import glob
+
+    rec_dir = os.path.join(workdir, "flightrec")
+    _d, proc = run_child("crash", {
+        "MXNET_FAULT_SPEC": f"{point}:{kill_at}:kill",
+        "MXNET_FLIGHTREC_DIR": rec_dir})
+    if proc.returncode == 0:
+        print("crashtest: child survived its own SIGKILL?", file=sys.stderr)
+        return 1
+    print(f"crashtest: child SIGKILLed at step hit {kill_at} "
+          f"(rc={proc.returncode})")
+
+    spools = glob.glob(os.path.join(rec_dir, "flightrec-*.jsonl"))
+    if not spools:
+        print(f"crashtest: NO flight-recorder spool in {rec_dir}",
+              file=sys.stderr)
+        return 1
+    events = []
+    for path in spools:
+        with open(path) as f:
+            for ln, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    print(f"crashtest: {path}:{ln} is not valid JSON: "
+                          f"{line[:120]}", file=sys.stderr)
+                    return 1
+    if not events:
+        print("crashtest: spool parsed but holds zero events",
+              file=sys.stderr)
+        return 1
+
+    # the tail must name the IN-FLIGHT step: the last elastic.step
+    # span_open (the span never closed — the process died inside it)
+    step_opens = [e for e in events
+                  if e.get("kind") == "span_open" and e.get("name") == point]
+    if not step_opens:
+        print(f"crashtest: no span_open for {point!r} in the spool",
+              file=sys.stderr)
+        return 1
+    last = step_opens[-1]
+    if "step" not in last or "dp" not in last:
+        print(f"crashtest: in-flight {point} event lacks step/dp: {last}",
+              file=sys.stderr)
+        return 1
+    injected = [e for e in events
+                if e.get("name") == "fault.injected"
+                and e.get("point") == point]
+    if not injected:
+        print("crashtest: the injected-kill fault event is missing from "
+              "the spool", file=sys.stderr)
+        return 1
+    tail_idx = {id(e): i for i, e in enumerate(events)}
+    print(f"crashtest: flight recorder OK — {len(events)} spooled events, "
+          f"in-flight {point} at step {last['step']} on dp={last['dp']} "
+          f"(spool line {tail_idx[id(last)] + 1}/{len(events)}), "
+          f"kill injected at hit {injected[-1].get('hit')}")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--steps", type=int, default=30)
@@ -140,8 +214,14 @@ def main(argv=None):
                     help="elastic mode: dp size for the restarted run "
                          "(default: same as --dp; smaller = elastic "
                          "restart with shard repartition)")
+    ap.add_argument("--flightrec", action="store_true",
+                    help="flight-recorder SIGKILL-parity mode: kill an "
+                         "elastic run mid-step, assert the JSONL spool "
+                         "names the in-flight step/mesh")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    if args.flightrec:
+        args.elastic = True
 
     if args.child:
         return _elastic_child(args) if args.elastic else _child(args)
@@ -165,6 +245,9 @@ def main(argv=None):
         return d, proc
 
     point = "elastic.step" if args.elastic else "resilient.step"
+
+    if args.flightrec:
+        return _flightrec_mode(workdir, kill_at, run_child, point)
 
     # 1. uninterrupted reference
     ref_dir, proc = run_child("ref", {})
