@@ -491,6 +491,17 @@ def bench_serve_openloop():
                              "--duration", "1.5"])
 
 
+def bench_serve_continuous(quick=True):
+    """Continuous-batching A/B (serve_bench --autoregressive): the
+    iteration-level engine vs the PR-3 static batcher on the same
+    decoder, plus the persistent-compilation-cache warm-replica
+    measurement. Returns the bench JSON dict or None."""
+    args = ["--autoregressive", "--duration", "2.0" if quick else "6.0"]
+    if quick:
+        args.append("--quick")
+    return _run_serve_bench(args, timeout=900)
+
+
 def bench_serve_trace_ab():
     """Traced-vs-untraced A/B (MXNET_TELEMETRY on vs off): the overhead
     guard for the tracing layer — tracing may not cost more than ~2%.
@@ -677,6 +688,30 @@ def _phase_serve():
     ab = bench_serve_trace_ab()
     if ab is not None:
         out.update(ab)
+    return out
+
+
+def _phase_serve_continuous(quick=False):
+    """Continuous (iteration-level) batching trend row: decode tokens/s
+    and TTFT p99 through the ContinuousEngine (benchdiff-gated), the
+    speedup over the static batcher, the zero-retrace observable, and
+    the warm-replica compile-skip factor."""
+    r = bench_serve_continuous(quick=quick)
+    if r is None:
+        return {}
+    out = {}
+    for k in ("serve_decode_tokens_per_sec", "serve_ttft_p99_ms",
+              "serve_continuous_speedup_vs_static",
+              "serve_compile_cache_warm_speedup",
+              "compile_cache_cold_warmup_s",
+              "compile_cache_warm_warmup_s"):
+        if r.get(k) is not None:
+            out[k] = r[k]
+    ct = r.get("continuous", {})
+    for k in ("retraces_after_warmup", "mean_active_slots",
+              "tpot_p50_ms", "tpot_p99_ms", "requests_per_sec"):
+        if ct.get(k) is not None:
+            out[f"serve_continuous_{k}"] = ct[k]
     return out
 
 
@@ -897,6 +932,7 @@ PHASES = [
     ("io", _phase_io),
     ("input_pipeline", _phase_input_pipeline),
     ("serve", _phase_serve),
+    ("serve_continuous", _phase_serve_continuous),
     ("elastic", _phase_elastic),
     ("offenders", _phase_offenders),
     ("fused_sweep", _phase_fused_sweep),
@@ -941,6 +977,12 @@ def _phase_elastic_quick():
     return _phase_elastic(quick=True)
 
 
+def _phase_serve_continuous_quick():
+    # same keys, tiny decoder + short windows: the tier-1 smoke exercises
+    # engine + static A/B + compile-cache skip end to end
+    return _phase_serve_continuous(quick=True)
+
+
 QUICK_PHASES = {
     "dispatch": _phase_dispatch_quick,
     "train32": _phase_train32_quick,
@@ -948,6 +990,7 @@ QUICK_PHASES = {
     "offenders": _phase_offenders_quick,
     "fused_sweep": _phase_fused_sweep_quick,
     "elastic": _phase_elastic_quick,
+    "serve_continuous": _phase_serve_continuous_quick,
 }
 
 # Per-phase subprocess timeouts, seconds. MXNET_BENCH_PHASE_TIMEOUT (one
@@ -955,8 +998,8 @@ QUICK_PHASES = {
 PHASE_TIMEOUTS = {
     "dispatch": 300, "eager": 900, "train32": 1500, "train128": 1500,
     "infer": 900, "io": 700, "input_pipeline": 700, "serve": 700,
-    "elastic": 700, "offenders": 700, "fused_sweep": 2000, "calib": 900,
-    "xla_flops": 600,
+    "serve_continuous": 900, "elastic": 700, "offenders": 700,
+    "fused_sweep": 2000, "calib": 900, "xla_flops": 600,
 }
 PHASE_TIMEOUT_DEFAULT_S = 900
 

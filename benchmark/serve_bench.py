@@ -30,6 +30,17 @@ noise — AND p99 within 3x of the lightest rate's AND drops <= 1%).
 capacity, so the sweep must extend well past 1x to cross the knee).
 The committed sweep lives in benchmark/results/serve_openloop_r13.json.
 
+Autoregressive mode (`--autoregressive`, ISSUE 14): continuous
+(iteration-level) batching vs the PR-3 static batcher on the SAME
+decoder math — per-request token counts are heavy-tailed (truncated
+exponential), so the static batcher pays its structural worst case
+(every batch row decodes t_max steps; TTFT = whole-reply latency) while
+`serve.ContinuousEngine` admits/retires per iteration. Reports decode
+tokens/s, TTFT/TPOT p50/p99, the zero-retrace assertion, and the
+`MXNET_COMPILE_CACHE_DIR` warm-replica compile skip; with `--open-loop`,
+a Poisson TTFT-vs-offered-rate sweep of the engine. Committed artifact:
+benchmark/results/serve_continuous_r14.json.
+
 Model: ResNet-18 (thumbnail stem, NCHW, 32x32) exported per bucket; --quick
 swaps in a small MLP and shorter runs for the CI smoke. Writes a JSON
 artifact; the committed closed-loop before/after pair lives in
@@ -41,6 +52,8 @@ Usage:
   python benchmark/serve_bench.py --modes serial           # baseline only
   python benchmark/serve_bench.py --open-loop --rates auto # Poisson sweep
   python benchmark/serve_bench.py --open-loop --rates 20,40,80,160
+  python benchmark/serve_bench.py --autoregressive          # continuous A/B
+  python benchmark/serve_bench.py --autoregressive --open-loop --rates auto
 """
 import argparse
 import json
@@ -504,6 +517,362 @@ def bench_trace_ab(model, sample, concurrency, pairs=8, window_s=0.75,
             "trace_ab_sampled_pair_overheads_pct": s_pairs}
 
 
+# ---------------------------------------------------------------------------
+# autoregressive serving: continuous (iteration-level) batching vs the PR-3
+# static batcher on the SAME model math (ISSUE 14)
+# ---------------------------------------------------------------------------
+def _build_autoreg(quick):
+    """Decoder config + a seeded workload of (prompt, max_new) pairs.
+
+    Generation lengths are HEAVY-TAILED (truncated exponential — the
+    fleet-realistic shape: most replies short, a tail of long ones).
+    `t_max` is the static batcher's obligatory worst case: a static
+    batch cannot retire a row early, so every member decodes to the
+    longest request the service accepts, and the tail sets the bill for
+    everyone — exactly the structural cost iteration-level batching
+    removes."""
+    from incubator_mxnet_tpu import serve
+    if quick:
+        cfg = serve.DecoderConfig(vocab=128, embed=32, layers=2, heads=4,
+                                  head_dim=8, max_len=48)
+        max_prompt, n_work = 12, 64
+        new_lo, new_scale = 2, 8
+    else:
+        cfg = serve.DecoderConfig(vocab=256, embed=64, layers=3, heads=4,
+                                  head_dim=16, max_len=96)
+        max_prompt, n_work = 16, 256
+        new_lo, new_scale = 4, 20
+    t_max = cfg.max_len - max_prompt
+    model = serve.CachedDecoder(cfg, seed=7)
+    rng = np.random.RandomState(23)
+    workload = []
+    for _ in range(n_work):
+        plen = int(rng.randint(3, max_prompt + 1))
+        max_new = new_lo + min(int(rng.exponential(new_scale)),
+                               t_max - new_lo)
+        workload.append((
+            rng.randint(1, cfg.vocab, size=plen).astype(np.int32),
+            max_new))
+    return model, workload, max_prompt, t_max
+
+
+def _make_static_generate(model, max_prompt, t_max):
+    """The static-batching baseline's callable: prefill + a fixed
+    `t_max`-step `lax.scan` decode over an in-program KV cache, using the
+    SAME compiled math as the continuous engine (serve.continuous's
+    prefill/decode builders), so the A/B measures the SCHEDULER, not the
+    model. Every batch row decodes all t_max steps — the structural
+    static-batching waste (rows wanting fewer tokens still pay t_max;
+    pad rows pay it too)."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.serve.continuous import (_make_prefill,
+                                                      _make_decode)
+    cfg = model.config
+    # same windowed prefill as the engine (fair A/B: both sides pay
+    # O(max_prompt^2) prefill attention, not O(max_len^2))
+    prefill = _make_prefill(cfg, window=max_prompt)
+    decode = _make_decode(cfg)
+    params = model.params
+
+    def gen(prompts, plens):
+        # prompts (B, max_prompt) int32, plens (B,) int32
+        B = prompts.shape[0]
+        shape = (B + 1, cfg.layers, cfg.max_len, cfg.heads, cfg.head_dim)
+        k = jnp.zeros(shape, dtype=cfg.dtype)
+        v = jnp.zeros(shape, dtype=cfg.dtype)
+        plens = jnp.maximum(plens, 1)       # pad rows: keep math benign
+        k, v, first = prefill(params, k, v, prompts, plens,
+                              jnp.arange(B))
+
+        def step(carry, _):
+            k, v, last, lens = carry
+            k, v, toks, _ = decode(params, k, v, last, lens,
+                                   jnp.ones((B,), dtype=jnp.int32))
+            nxt = toks[0]
+            return (k, v, nxt, lens + 1), nxt
+
+        (_, _, _, _), rest = jax.lax.scan(
+            step, (k, v, first, plens), None, length=t_max - 1)
+        return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+    return gen
+
+
+def _drive_autoreg(submit_fn, workload, concurrency, duration_s,
+                   warmup_s=1.0):
+    """Closed-loop autoregressive load: `concurrency` clients each
+    running one request at a time. `submit_fn(i)` blocks until request
+    i's tokens arrive and returns the USEFUL token count (what the
+    client asked for). Returns (completed, tokens, lats_ms, errors) for
+    requests fully inside the measured window."""
+    stop = threading.Event()
+    lk = threading.Lock()
+    lats, errors, tokens = [], {}, [0]
+    window = [float("inf"), float("-inf")]
+
+    def client(tid):
+        i = tid
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                n_tok = submit_fn(i)
+            except Exception as e:
+                with lk:
+                    k = type(e).__name__
+                    errors[k] = errors.get(k, 0) + 1
+                time.sleep(0.001)
+                continue
+            finally:
+                i += concurrency
+            t1 = time.perf_counter()
+            if t0 >= window[0] and t1 <= window[1]:
+                with lk:
+                    lats.append((t1 - t0) * 1e3)
+                    tokens[0] += n_tok
+
+    threads = [threading.Thread(target=client, args=(t,), daemon=True)
+               for t in range(concurrency)]
+    for t in threads:
+        t.start()
+    time.sleep(warmup_s)
+    t_start = time.perf_counter()
+    window[0] = t_start
+    window[1] = t_start + duration_s
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    return len(lats), tokens[0], lats, errors
+
+
+def bench_autoreg_static(model, workload, max_prompt, t_max, concurrency,
+                         duration_s, batch_timeout_ms):
+    """The PR-3 static batcher serving the autoregressive model: one
+    request = one full generation, batched onto power-of-two buckets.
+    TTFT == total latency (all tokens arrive at once) and every batch
+    row pays t_max decode steps — the two structural costs continuous
+    batching removes."""
+    from incubator_mxnet_tpu import serve
+    buckets = [1, 2, 4, 8] if t_max <= 16 else [1, 2, 4, 8, 16, 32]
+    cm = serve.CallableModel(
+        _make_static_generate(model, max_prompt, t_max), buckets,
+        [((max_prompt,), "int32"), ((), "int32")])
+    with serve.Server(cm, batch_timeout_ms=batch_timeout_ms,
+                      max_queue=max(256, 8 * concurrency)) as srv:
+        def submit(i):
+            prompt, max_new = workload[i % len(workload)]
+            row = np.zeros((max_prompt,), np.int32)
+            row[:prompt.size] = prompt
+            srv.predict(row, np.int32(prompt.size), timeout=120)
+            return max_new           # useful tokens (rest is overrun)
+
+        done, tokens, lats, errors = _drive_autoreg(
+            submit, workload, concurrency, duration_s)
+        st = srv.stats()
+    lat_sorted = sorted(lats)
+    out = {"mode": "static_batcher",
+           "requests_per_sec": round(done / duration_s, 2),
+           "decode_tokens_per_sec": round(tokens / duration_s, 2),
+           "completed": done, "errors": errors,
+           "t_max_steps": t_max,
+           "programs_compiled": st["programs_compiled"],
+           "compile_cache_size_final": st["compile_cache_size"],
+           # all tokens arrive with the reply: TTFT == TPOT*n == latency
+           "ttft_p50_ms": _percentile_of(lat_sorted, 50),
+           "ttft_p99_ms": _percentile_of(lat_sorted, 99),
+           "e2e_p50_ms": _percentile_of(lat_sorted, 50),
+           "e2e_p99_ms": _percentile_of(lat_sorted, 99)}
+    return out
+
+
+def bench_autoreg_continuous(model, workload, concurrency, duration_s,
+                             max_slots=None, max_prompt=None):
+    """The continuous engine on the same workload: per-iteration
+    admit/retire, deadline-aware slot grants, zero retraces asserted."""
+    from incubator_mxnet_tpu import serve
+    eng = serve.ContinuousEngine(
+        model, max_slots=max_slots, prefill_window=max_prompt,
+        max_queue=max(256, 8 * concurrency)).start()
+    try:
+        def submit(i):
+            prompt, max_new = workload[i % len(workload)]
+            out = eng.generate(prompt, max_new, timeout=120)
+            return int(out.size)
+
+        done, tokens, lats, errors = _drive_autoreg(
+            submit, workload, concurrency, duration_s)
+        eng.assert_no_retraces()
+        st = eng.stats()
+    finally:
+        eng.close()
+    lat_sorted = sorted(lats)
+    out = {"mode": "continuous",
+           "requests_per_sec": round(done / duration_s, 2),
+           "decode_tokens_per_sec": round(tokens / duration_s, 2),
+           "completed": done, "errors": errors,
+           "max_slots": st["pool"]["max_slots"],
+           "mean_active_slots": st["mean_active_slots"],
+           "decode_iterations": st["decode_iterations"],
+           "prefill_batches": st["prefill_batches"],
+           "programs_compiled": st["programs_compiled"],
+           "compile_cache_size_final": st["compile_cache_size"],
+           "retraces_after_warmup": st["retraces_after_warmup"],
+           "ttft_p50_ms": st["ttft_p50_ms"],
+           "ttft_p99_ms": st["ttft_p99_ms"],
+           "tpot_p50_ms": st["tpot_p50_ms"],
+           "tpot_p99_ms": st["tpot_p99_ms"],
+           "e2e_p50_ms": _percentile_of(lat_sorted, 50),
+           "e2e_p99_ms": _percentile_of(lat_sorted, 99)}
+    return out
+
+
+def bench_autoreg_open_loop(model, workload, rates, duration_s, seed=11,
+                            max_slots=None, max_prompt=None):
+    """Open-loop Poisson sweep against the continuous engine (the PR-13
+    arrival generator aimed at the autoregressive path): per offered
+    rate — achieved req/s, decode tokens/s, TTFT/TPOT p50/p99, drop
+    accounting. A fresh engine per rate gives clean per-rate reservoirs;
+    the model's jit cache is shared, so no recompiles."""
+    from incubator_mxnet_tpu import serve
+    rows = []
+    for rate in sorted(rates):
+        eng = serve.ContinuousEngine(model, max_slots=max_slots,
+                                     prefill_window=max_prompt,
+                                     max_queue=512).start()
+        try:
+            rng = np.random.RandomState(int(seed * 100003 + rate))
+            n = max(8, int(round(rate * duration_s)))
+            gaps = rng.exponential(1.0 / rate, size=n)
+            lk = threading.Lock()
+            lats, drops = [], {}
+            futures = []
+            t0 = time.perf_counter()
+            arrival = t0
+            for i in range(n):
+                arrival += gaps[i]
+                now = time.perf_counter()
+                if arrival > now:
+                    time.sleep(arrival - now)
+                prompt, max_new = workload[i % len(workload)]
+                t_arr = arrival
+                try:
+                    fut = eng.submit(prompt, max_new)
+                except Exception as e:
+                    with lk:
+                        k = type(e).__name__
+                        drops[k] = drops.get(k, 0) + 1
+                    continue
+
+                def _done(f, t_arr=t_arr):
+                    t1 = time.perf_counter()
+                    try:
+                        f.result()
+                    except Exception as e:
+                        with lk:
+                            k = type(e).__name__
+                            drops[k] = drops.get(k, 0) + 1
+                    else:
+                        with lk:
+                            lats.append((t1 - t_arr) * 1e3)
+
+                fut.add_done_callback(_done)
+                futures.append(fut)
+            deadline = time.perf_counter() + max(30.0, 2 * duration_s)
+            for f in futures:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    f.result(timeout=remaining)
+                except Exception:
+                    pass
+            wall = time.perf_counter() - t0
+            eng.assert_no_retraces()
+            st = eng.stats()
+        finally:
+            eng.close()
+        with lk:
+            lat_sorted = sorted(lats)
+            drops_by = dict(drops)
+        dropped = sum(drops_by.values())
+        row = {"offered_rps": round(float(rate), 2), "sent": n,
+               "completed": len(lat_sorted),
+               "achieved_rps": round(len(lat_sorted) / wall, 2),
+               "decode_tokens_per_sec": round(
+                   st["decode_tokens"] / wall, 2),
+               "dropped": dropped, "drops_by_kind": drops_by,
+               "drop_rate": round(dropped / n, 4),
+               "mean_active_slots": st["mean_active_slots"],
+               "ttft_p50_ms": st["ttft_p50_ms"],
+               "ttft_p99_ms": st["ttft_p99_ms"],
+               "tpot_p50_ms": st["tpot_p50_ms"],
+               "tpot_p99_ms": st["tpot_p99_ms"],
+               "e2e_p50_ms": _percentile_of(lat_sorted, 50),
+               "e2e_p99_ms": _percentile_of(lat_sorted, 99),
+               "wall_s": round(wall, 2)}
+        rows.append(row)
+        print(f"autoreg open-loop {row['offered_rps']:>7.1f} req/s "
+              f"offered  achieved {row['achieved_rps']:>7.1f}  "
+              f"tok/s {row['decode_tokens_per_sec']:>8.1f}  "
+              f"ttft p99 {row['ttft_p99_ms'] or 0:>8.1f}ms  "
+              f"drops {dropped}")
+    return rows
+
+
+def bench_compile_cache_skip(quick):
+    """Warm-replica start: with MXNET_COMPILE_CACHE_DIR set, build an
+    engine (cold — compiles AND serializes both programs), then drop
+    jax's in-memory caches (what a fresh replica process starts without)
+    and build it again — the second warmup deserializes from the
+    persistent cache instead of recompiling. Reports both warmup times;
+    the acceptance is warm << cold."""
+    import tempfile
+    import jax
+    from incubator_mxnet_tpu import serve
+    from incubator_mxnet_tpu import deploy
+
+    cfg = (serve.DecoderConfig(vocab=128, embed=32, layers=2, heads=4,
+                               head_dim=8, max_len=40) if quick else
+           serve.DecoderConfig(vocab=256, embed=64, layers=3, heads=4,
+                               head_dim=16, max_len=80))
+    out = {}
+    with tempfile.TemporaryDirectory(prefix="mx_compile_cache_") as d:
+        saved = os.environ.get("MXNET_COMPILE_CACHE_DIR")
+        saved_armed = deploy._COMPILE_CACHE_ARMED[0]
+        os.environ["MXNET_COMPILE_CACHE_DIR"] = d
+        deploy._COMPILE_CACHE_ARMED[0] = False
+        try:
+            model = serve.CachedDecoder(cfg, seed=5)
+            eng = serve.ContinuousEngine(model, max_slots=4).start()
+            eng.close()
+            out["compile_cache_cold_warmup_s"] = eng.warmup_s
+            out["compile_cache_entries"] = len(os.listdir(d))
+            # a fresh replica's state: no in-memory jit cache, same
+            # persistent dir
+            jax.clear_caches()
+            model2 = serve.CachedDecoder(cfg, seed=5)
+            eng2 = serve.ContinuousEngine(model2, max_slots=4).start()
+            eng2.close()
+            out["compile_cache_warm_warmup_s"] = eng2.warmup_s
+            if eng2.warmup_s and eng2.warmup_s > 0:
+                out["serve_compile_cache_warm_speedup"] = round(
+                    eng.warmup_s / eng2.warmup_s, 2)
+        finally:
+            if saved is None:
+                os.environ.pop("MXNET_COMPILE_CACHE_DIR", None)
+            else:
+                os.environ["MXNET_COMPILE_CACHE_DIR"] = saved
+            deploy._COMPILE_CACHE_ARMED[0] = saved_armed
+            # point jax away from the about-to-vanish temp dir (a write
+            # into a deleted dir would warn on every later compile)
+            try:
+                jax.config.update("jax_compilation_cache_dir", saved)
+            except Exception:
+                pass
+    return out
+
+
 def _auto_rates(model, sample, concurrency, batch_timeout_ms):
     """Calibrate a short closed-loop run and sweep 0.3x..2.6x around its
     throughput: clearly-underloaded through clearly-saturated."""
@@ -530,6 +899,14 @@ def main():
     ap.add_argument("--open-loop", action="store_true",
                     help="Poisson offered-load sweep instead of the "
                          "closed-loop modes")
+    ap.add_argument("--autoregressive", action="store_true",
+                    help="autoregressive serving A/B: continuous "
+                         "(iteration-level) batching vs the static "
+                         "batcher on the same decoder; with --open-loop, "
+                         "a Poisson TTFT/TPOT sweep of the engine")
+    ap.add_argument("--max-slots", type=int, default=None,
+                    help="continuous engine KV slots "
+                         "(default MXNET_SERVE_MAX_SLOTS)")
     ap.add_argument("--rates", default="auto",
                     help="open-loop offered rates (req/s), comma list or "
                          "'auto' (closed-loop calibration x 0.3..2.6)")
@@ -562,6 +939,90 @@ def main():
             json.dump(out, f, indent=1)
         print(json.dumps(out))
         return 1
+
+    if args.autoregressive:
+        out = {"meta": {"bench": "serve_bench", "mode": "autoregressive",
+                        "quick": bool(args.quick),
+                        "concurrency": args.concurrency,
+                        "duration_s": duration,
+                        "host_cores": os.cpu_count(),
+                        "platform": "cpu",
+                        "batch_timeout_ms": args.batch_timeout_ms}}
+        model, workload, max_prompt, t_max = _build_autoreg(args.quick)
+        # slot count defaults to the client concurrency (capped): the
+        # engine's continuous occupancy is the point of the A/B
+        slots = args.max_slots or min(32, args.concurrency)
+        out["meta"]["max_slots"] = slots
+        out["meta"]["model"] = model.config.as_dict()
+        out["meta"]["workload"] = {
+            "n": len(workload), "max_prompt": max_prompt,
+            "t_max": t_max,
+            "mean_new_tokens": round(float(np.mean(
+                [m for _, m in workload])), 2)}
+        if args.open_loop:
+            out["meta"]["arrival_seed"] = args.seed
+            if args.rates.strip() == "auto":
+                # calibrate from a short continuous closed-loop run:
+                # requests/s at saturation, swept 0.3x..2.0x
+                cal = bench_autoreg_continuous(
+                    model, workload, args.concurrency,
+                    max(2.0, duration / 3), max_slots=slots,
+                    max_prompt=max_prompt)
+                base = max(1.0, cal["requests_per_sec"])
+                rates = [round(base * f, 1)
+                         for f in (0.3, 0.5, 0.7, 1.0, 1.4, 2.0)]
+                out["meta"]["closed_loop_calibration_rps"] = base
+            else:
+                rates = [float(r) for r in args.rates.split(",")
+                         if r.strip()]
+            out["meta"]["rates"] = rates
+            out["autoreg_open_loop"] = bench_autoreg_open_loop(
+                model, workload, rates, duration, seed=args.seed,
+                max_slots=slots, max_prompt=max_prompt)
+        st = bench_autoreg_static(model, workload, max_prompt, t_max,
+                                  args.concurrency, duration,
+                                  args.batch_timeout_ms)
+        print(f"static    {st['decode_tokens_per_sec']:>9.1f} tok/s  "
+              f"{st['requests_per_sec']:>7.1f} req/s  "
+              f"ttft p99 {st['ttft_p99_ms'] or 0:.0f}ms")
+        ct = bench_autoreg_continuous(model, workload, args.concurrency,
+                                      duration, max_slots=slots,
+                                      max_prompt=max_prompt)
+        print(f"continuous{ct['decode_tokens_per_sec']:>9.1f} tok/s  "
+              f"{ct['requests_per_sec']:>7.1f} req/s  "
+              f"ttft p99 {ct['ttft_p99_ms'] or 0:.0f}ms  "
+              f"retraces {ct['retraces_after_warmup']}")
+        out["static"] = st
+        out["continuous"] = ct
+        if st["decode_tokens_per_sec"]:
+            out["serve_continuous_speedup_vs_static"] = round(
+                ct["decode_tokens_per_sec"] / st["decode_tokens_per_sec"],
+                2)
+            print(f"continuous batching speedup: "
+                  f"{out['serve_continuous_speedup_vs_static']}x "
+                  f"decode tokens/s")
+        # benchdiff trend keys
+        out["serve_decode_tokens_per_sec"] = ct["decode_tokens_per_sec"]
+        out["serve_ttft_p99_ms"] = ct["ttft_p99_ms"]
+        cc = bench_compile_cache_skip(args.quick)
+        out.update(cc)
+        if cc.get("serve_compile_cache_warm_speedup"):
+            print(f"compile cache: cold warmup "
+                  f"{cc['compile_cache_cold_warmup_s']}s -> warm "
+                  f"{cc['compile_cache_warm_warmup_s']}s "
+                  f"({cc['serve_compile_cache_warm_speedup']}x)")
+        out["backend_ok"] = True
+        try:
+            from incubator_mxnet_tpu import telemetry
+            out["telemetry"] = telemetry.scalar_snapshot()
+        except Exception:
+            pass
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {args.out}")
+        return 0
 
     with tempfile.TemporaryDirectory(prefix="serve_bench_") as d:
         model, sample, buckets = _build_and_export(args.quick, d)

@@ -27,7 +27,55 @@ import os
 
 import numpy as _np
 
-from .base import MXNetError
+from .base import MXNetError, _register_env
+
+_register_env("MXNET_COMPILE_CACHE_DIR", str, None,
+              "Directory for jax's persistent compilation cache: every "
+              "jit compile serializes its executable there, and a later "
+              "process (replica, restart) DESERIALIZES instead of "
+              "recompiling — replica warmup becomes O(load), not "
+              "O(compile). Armed at the first ExportedModel load or "
+              "serve.CachedDecoder build; share the dir across replicas")
+
+# armed-once latch: jax.config.update is process-global, and re-applying
+# it per model load would spam config churn
+_COMPILE_CACHE_ARMED = [False]
+
+
+def maybe_enable_compile_cache():
+    """Wire `MXNET_COMPILE_CACHE_DIR` onto jax's persistent compilation
+    cache (idempotent; no-op when the env is unset). Must run BEFORE the
+    first compile of the programs it should cover — ExportedModel and
+    serve.CachedDecoder call it in their constructors. The min-time /
+    min-size thresholds are zeroed so even small serving programs (bucket
+    MLPs, decode steps) persist: replica warmup is the target, and a
+    second replica should skip EVERY compile, not just the slow ones.
+    Returns True when the cache is armed."""
+    if _COMPILE_CACHE_ARMED[0]:
+        return True
+    d = os.environ.get("MXNET_COMPILE_CACHE_DIR")
+    if not d:
+        return False
+    import jax
+    jax.config.update("jax_compilation_cache_dir", d)
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0),
+                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(knob, val)
+        except AttributeError:        # older jax: threshold knob absent
+            pass
+    # jax initializes its cache backend lazily at the FIRST compile and
+    # then never re-reads the dir config: a process that compiled
+    # anything before arming would silently keep running cache-less.
+    # Reset forces re-initialization against the new dir.
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
+    except Exception:
+        pass
+    _COMPILE_CACHE_ARMED[0] = True
+    return True
 
 # Reference dtype codes (mshadow/base.h kFloat32..; c_api callers use these
 # integers on the wire). bfloat16 appended at its reference index (12).
@@ -97,6 +145,10 @@ class ExportedModel:
 
         import jax
         import jax.export as jexp
+        # persistent-compilation-cache wiring: with MXNET_COMPILE_CACHE_DIR
+        # set, this artifact's bucket program compiles once per FLEET, not
+        # once per replica (armed before the jit below ever compiles)
+        maybe_enable_compile_cache()
         with open(jaxport, "rb") as f:
             self._exported = jexp.deserialize(f.read())
         loaded = _np.load(params, allow_pickle=False)

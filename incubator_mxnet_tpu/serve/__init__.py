@@ -24,6 +24,21 @@ layer above it:
                         MXNET_METRICS_PORT at `Server.start()`) serves it
                         at `/metrics`
 
+Autoregressive (stateful) serving — continuous batching (ISSUE 14):
+
+  serve.ContinuousEngine  iteration-level batching decode engine:
+                        requests admit/retire PER MODEL ITERATION over a
+                        slotted KV-cache pool; two fixed-shape compiled
+                        programs (prefill + multi-step decode) serve
+                        every mixed batch — zero retraces after warmup;
+                        deadline-aware slot grants (SLO-aware admission)
+  serve.KVCachePool     preallocated `(max_slots+1, layers, max_len,
+                        heads, head_dim)` KV slab + claim/free slots;
+                        typed `SlotsFullError` on exhaustion
+  serve.CachedDecoder   the bundled cached-KV transformer decoder model
+                        (greedy, deterministic) the engine drives; see
+                        docs/SERVING.md "Continuous batching"
+
 Overload behavior is explicit, not emergent: admission control bounds the
 queue (`MXNET_SERVE_MAX_QUEUE`), the overload policy picks reject-newest
 or shed-oldest (`MXNET_SERVE_OVERLOAD_POLICY`), per-request deadlines fail
@@ -40,12 +55,20 @@ from .batcher import (ServeError, QueueFullError, RequestTimeout,
                       ServerClosed, BucketedModel, CallableModel, Server,
                       pick_bucket)
 from .metrics import SERVE_STATS, ServeMetrics, serve_stats as stats
+from .kv_pool import (KVCachePool, SlotsFullError, KVPOOL_STATS,
+                      kvpool_stats)
+from .continuous import (ContinuousEngine, CachedDecoder, DecoderConfig,
+                         init_decoder_params)
 
 __all__ = [
     "Server", "BucketedModel", "CallableModel", "pick_bucket",
     "ServeError", "QueueFullError", "RequestTimeout", "ServerClosed",
     "ServeMetrics", "SERVE_STATS", "stats",
     "metrics_text", "start_metrics_server",
+    # continuous (iteration-level) batching
+    "ContinuousEngine", "CachedDecoder", "DecoderConfig",
+    "init_decoder_params", "KVCachePool", "SlotsFullError",
+    "KVPOOL_STATS", "kvpool_stats",
 ]
 
 _register_env("MXNET_SERVE_MAX_QUEUE", int, 256,
@@ -56,3 +79,9 @@ _register_env("MXNET_SERVE_DEADLINE_MS", float, None,
               "Default per-request queue deadline (unset = none)")
 _register_env("MXNET_SERVE_OVERLOAD_POLICY", str, "reject",
               "Queue-full behavior: 'reject' (newest) or 'shed' (oldest)")
+_register_env("MXNET_SERVE_MAX_SLOTS", int, 8,
+              "KV-cache slots in the continuous-batching engine = max "
+              "concurrently-decoding requests (serve.KVCachePool)")
+_register_env("MXNET_SERVE_PREFILL_BUDGET", int, 256,
+              "Max prompt tokens prefilled per engine iteration "
+              "(bounds prefill's added latency on in-flight decode)")

@@ -543,6 +543,24 @@ class Server:
                     a[i] = req.inputs[j]
                 arrs.append(a)
             outs = self.model.run_batch(bucket, arrs)
+            # pad-row mask: inputs were zero-padded from n rows up to
+            # `bucket`, so output rows [n:] are PAD GARBAGE (whatever the
+            # model computed on zero rows). Slicing to [:n] here — not at
+            # reply indexing — makes the boundary explicit and checkable:
+            # an output whose leading dim is not the bucket has no
+            # row<->request correspondence at all (e.g. a model that
+            # reduces over the batch), and indexing it per-request would
+            # silently hand every requester data mixing in pad rows. Fail
+            # the batch with a typed error instead.
+            bad = [tuple(getattr(o, "shape", ())) for o in outs
+                   if getattr(o, "shape", None) is None
+                   or len(o.shape) == 0 or o.shape[0] != bucket]
+            if bad:
+                raise ServeError(
+                    f"model output shapes {bad} do not carry the batch "
+                    f"dim (bucket {bucket}): pad rows cannot be masked "
+                    f"off, refusing to reply with pad-contaminated data")
+            outs = tuple(o[:n] for o in outs)
         except BaseException as e:
             self.metrics.count("errors", n)
             err = e if isinstance(e, MXNetError) else ServeError(

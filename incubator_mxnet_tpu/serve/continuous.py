@@ -1,0 +1,963 @@
+"""Continuous (iteration-level) batching for autoregressive models.
+
+`serve.Server` (PR 3) batches STATELESS single-shot requests: a request
+joins exactly one batch, the batch runs one program, done. Autoregressive
+models break that shape — one request is N sequential decode iterations
+over private KV state, requests finish at different times, and a static
+batch must run every member to the LONGEST member's length while admitted
+work waits whole batches. This module is the Orca-style answer, rebuilt
+JAX-native per the PAPER.md survey (one compiled decode program, state as
+plain device buffers, zero retraces):
+
+  * **Slot memory** (`serve.kv_pool.KVCachePool`): a fixed-shape KV slab
+    carved once; each admitted request claims a slot ROW; join/leave is
+    host bookkeeping and can never change a compiled program's shapes.
+  * **Two fixed-shape programs** compiled once at warmup and reused for
+    every mixed batch: `prefill` (writes a claimed slot's prompt KV page +
+    emits the first token, pad lanes scatter into the pool's garbage row)
+    and `decode` (steps ALL slots one token — inactive slots are masked
+    lanes, their writes land in the garbage row). Both donate the KV
+    buffers, so updates are in-place `dynamic_update_slice` scatters on
+    accelerators (the `.at[rows, layer, pos].set(...)` idiom).
+  * **Iteration-level scheduling**: every engine iteration first retires
+    finished requests (their slots free IMMEDIATELY, not at batch end),
+    then admits waiting requests under a prefill token budget
+    (`MXNET_SERVE_PREFILL_BUDGET` — bounds how much prefill work may
+    delay in-flight decode iterations), then runs one decode step for
+    every active slot. Admission is DEADLINE-AWARE, not FIFO: waiting
+    requests are granted slots earliest-deadline-first (SLO-aware
+    admission over the PR-3 deadline plumbing), and a request whose
+    deadline expires while waiting fails fast with `RequestTimeout`.
+  * **Zero retraces after warmup** is asserted the PR-3 way: the
+    `programs_compiled` counter and `compile_cache_size()` must stay flat
+    over any join/leave pattern (tests/test_continuous.py drives ragged
+    mixed traffic and checks both).
+  * **O(load) warmup**: `deploy.maybe_enable_compile_cache()` wires
+    `MXNET_COMPILE_CACHE_DIR` onto jax's persistent compilation cache
+    before the first compile, so a second replica (or a restart) loads
+    the serialized executables instead of recompiling — measured by
+    `benchmark/serve_bench.py --autoregressive` (compile-skip section).
+
+Tracing: one request = ONE trace across its N iterations. The root
+`serve.request` context is minted at `submit()` (PR-13 plumbing); the
+engine records `serve.prefill` (admission -> first token) and
+`serve.decode` (first -> last token, N iterations) as children, and
+closes the root at retirement — while the profiler collects, the whole
+request renders as a single tree in the Chrome trace.
+
+The bundled `CachedDecoder` is a small pre-norm transformer decoder over
+the slot pool (greedy argmax decoding, deterministic) — the LLM-shaped
+model side for tests and the bench; any object with the same
+`prefill`/`decode`/`compile_cache_size` contract serves.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as _np
+
+from ..base import MXNetError, get_env
+from .. import fault as _fault
+from ..telemetry import record_span, trace as _trace
+from .batcher import (ServeError, QueueFullError, RequestTimeout,
+                      ServerClosed, _fail, _profiler_on)
+from .metrics import SERVE_STATS, _STATS_LOCK, percentile
+from .kv_pool import KVCachePool, SlotsFullError
+
+__all__ = ["DecoderConfig", "CachedDecoder", "ContinuousEngine",
+           "init_decoder_params"]
+
+
+# ---------------------------------------------------------------------------
+# model: a small cached-KV transformer decoder (greedy, deterministic)
+# ---------------------------------------------------------------------------
+class DecoderConfig:
+    """Static shape/config record for `CachedDecoder` (all ints; nothing
+    here ever becomes a tracer)."""
+
+    def __init__(self, vocab=256, embed=64, layers=2, heads=4,
+                 head_dim=16, mlp_hidden=None, max_len=128,
+                 dtype="float32"):
+        self.vocab = int(vocab)
+        self.embed = int(embed)
+        self.layers = int(layers)
+        self.heads = int(heads)
+        self.head_dim = int(head_dim)
+        self.mlp_hidden = int(mlp_hidden if mlp_hidden is not None
+                              else 4 * embed)
+        self.max_len = int(max_len)
+        self.dtype = str(dtype)
+        if self.heads * self.head_dim != self.embed:
+            raise ServeError(
+                f"heads*head_dim ({self.heads}x{self.head_dim}) must "
+                f"equal embed ({self.embed})")
+
+    def as_dict(self):
+        return {k: getattr(self, k) for k in
+                ("vocab", "embed", "layers", "heads", "head_dim",
+                 "mlp_hidden", "max_len", "dtype")}
+
+
+def init_decoder_params(config, seed=0):
+    """Deterministic random params (pytree of jnp arrays, layer-stacked
+    on a leading L axis so the layer loop indexes one buffer)."""
+    import jax
+    import jax.numpy as jnp
+    c = config
+    keys = jax.random.split(jax.random.PRNGKey(seed), 8)
+    s = 1.0 / _np.sqrt(c.embed)
+    m = 1.0 / _np.sqrt(c.mlp_hidden)
+
+    def rnd(key, shape, scale):
+        return (jax.random.normal(key, shape) * scale).astype(c.dtype)
+
+    return {
+        "emb": rnd(keys[0], (c.vocab, c.embed), 1.0),
+        "pos": rnd(keys[1], (c.max_len, c.embed), 0.1),
+        "wq": rnd(keys[2], (c.layers, c.embed, c.embed), s),
+        "wk": rnd(keys[3], (c.layers, c.embed, c.embed), s),
+        "wv": rnd(keys[4], (c.layers, c.embed, c.embed), s),
+        "wo": rnd(keys[5], (c.layers, c.embed, c.embed), s),
+        "w1": rnd(keys[6], (c.layers, c.embed, c.mlp_hidden), s),
+        "w2": rnd(keys[7], (c.layers, c.mlp_hidden, c.embed), m),
+        "ln1": jnp.ones((c.layers, c.embed), dtype=c.dtype),
+        "ln2": jnp.ones((c.layers, c.embed), dtype=c.dtype),
+        "lnf": jnp.ones((c.embed,), dtype=c.dtype),
+    }
+
+
+def _rmsnorm(x, scale):
+    import jax.numpy as jnp
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * scale / jnp.sqrt(var + 1e-6)
+
+
+def _make_prefill(config, window=None):
+    """Build the prefill step: full causal forward over the padded prompt
+    page, KV written into the claimed slot rows, first token emitted.
+
+    Shapes are FIXED by (P lanes, window, pool rows): the compiled
+    program is reused for every admission wave — a lane that has no
+    request this wave carries slot_row = garbage and its writes vanish.
+
+    `window` (default max_len) is the prompt page width: attention and
+    the KV write cover positions [0, window), so a serving config with
+    short prompts pays O(window^2), not O(max_len^2), per wave. Slot
+    positions past the window keep the PREVIOUS tenant's bytes — that is
+    safe by the decode mask (reads clamp to the current request's
+    `[0, cur_len]`), and exactly what the poison-fill isolation test
+    proves."""
+    import jax
+    import jax.numpy as jnp
+    c = config
+    W = int(window if window is not None else c.max_len)
+    if not 1 <= W <= c.max_len:
+        raise ServeError(f"prefill window {W} outside [1, {c.max_len}]")
+    scale = 1.0 / _np.sqrt(c.head_dim)
+
+    def prefill(params, k_cache, v_cache, tokens, lengths, slot_rows):
+        # tokens (P, W) int32, lengths (P,) int32, slot_rows (P,) int32
+        P = tokens.shape[0]
+        x = params["emb"][tokens] + params["pos"][None, :W]
+        pos = jnp.arange(W)
+        key_valid = pos[None, :] < lengths[:, None]            # (P, W)
+        causal = pos[:, None] >= pos[None, :]                  # (W, W)
+        mask = causal[None, None] & key_valid[:, None, None]   # (P,1,W,W)
+        for l in range(c.layers):
+            h = _rmsnorm(x, params["ln1"][l])
+            q = (h @ params["wq"][l]).reshape(P, W, c.heads, c.head_dim)
+            k = (h @ params["wk"][l]).reshape(P, W, c.heads, c.head_dim)
+            v = (h @ params["wv"][l]).reshape(P, W, c.heads, c.head_dim)
+            # positions past `lengths` hold pad-token KV, positions past
+            # the window hold the previous tenant's bytes; both are
+            # unreachable through the decode mask
+            k_cache = k_cache.at[slot_rows, l, :W].set(k)
+            v_cache = v_cache.at[slot_rows, l, :W].set(v)
+            scores = jnp.einsum("pqhd,pkhd->phqk", q, k) * scale
+            scores = jnp.where(mask, scores, -1e30)
+            att = jnp.einsum("phqk,pkhd->pqhd",
+                             jax.nn.softmax(scores, axis=-1), v)
+            x = x + att.reshape(P, W, c.embed) @ params["wo"][l]
+            h2 = _rmsnorm(x, params["ln2"][l])
+            x = x + jax.nn.gelu(h2 @ params["w1"][l]) @ params["w2"][l]
+        xf = _rmsnorm(x, params["lnf"])
+        last = xf[jnp.arange(P), jnp.maximum(lengths - 1, 0)]   # (P, E)
+        logits = last @ params["emb"].T
+        first_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return k_cache, v_cache, first_tok
+
+    return prefill
+
+
+def _make_decode(config, steps=1, eos_id=None):
+    """Build the decode step: EVERY pool slot advances up to `steps`
+    tokens inside ONE compiled program (`lax.scan` over the micro-step).
+    Fixed (S,) shapes and a FIXED step count, so join/leave — and lanes
+    finishing mid-scan — never change the program; inactive lanes
+    (steps_left == 0) write to the garbage row and their outputs are
+    ignored. `steps > 1` amortizes the per-dispatch host cost over K
+    tokens (the engine's `decode_steps` knob): admission/retirement move
+    to wave granularity, TTFT stays prefill-bound.
+
+    Signature: `decode(params, k_cache, v_cache, tokens, lengths,
+    steps_left) -> (k_cache, v_cache, out_tokens (steps, S), emitted)`.
+    `emitted[s]` is the EXACT number of tokens lane s produced this wave
+    (rows [0:emitted] of its column) — counted in-scan, because deriving
+    it from the steps_left delta would overcount when `eos_id` zeroes a
+    lane's remaining budget mid-wave."""
+    import jax
+    import jax.numpy as jnp
+    c = config
+    scale = 1.0 / _np.sqrt(c.head_dim)
+
+    def micro(params, k_cache, v_cache, tokens, lengths, active):
+        # one token for every active lane. tokens (S,) int32 last emitted
+        # token; lengths (S,) int32 current cache length (the new token's
+        # KV lands at position `lengths`); active (S,) bool
+        S = tokens.shape[0]
+        T = c.max_len
+        rows = jnp.where(active, jnp.arange(S), S)       # garbage row = S
+        wpos = jnp.clip(lengths, 0, T - 1)
+        x = params["emb"][tokens] + params["pos"][wpos]  # (S, E)
+        # attention reads positions 0..lengths INCLUSIVE (the new token's
+        # KV is written before the read); anything past that — pad-token
+        # KV from prefill or a previous tenant's garbage — is masked
+        tmask = jnp.arange(T)[None, :] <= lengths[:, None]   # (S, T)
+        for l in range(c.layers):
+            h = _rmsnorm(x, params["ln1"][l])
+            q = (h @ params["wq"][l]).reshape(S, c.heads, c.head_dim)
+            k = (h @ params["wk"][l]).reshape(S, c.heads, c.head_dim)
+            v = (h @ params["wv"][l]).reshape(S, c.heads, c.head_dim)
+            k_cache = k_cache.at[rows, l, wpos].set(k)
+            v_cache = v_cache.at[rows, l, wpos].set(v)
+            K = k_cache[:S, l]                           # (S, T, H, D)
+            V = v_cache[:S, l]
+            scores = jnp.einsum("shd,sthd->sht", q, K) * scale
+            scores = jnp.where(tmask[:, None, :], scores, -1e30)
+            att = jnp.einsum("sht,sthd->shd",
+                             jax.nn.softmax(scores, axis=-1), V)
+            x = x + att.reshape(S, c.embed) @ params["wo"][l]
+            h2 = _rmsnorm(x, params["ln2"][l])
+            x = x + jax.nn.gelu(h2 @ params["w1"][l]) @ params["w2"][l]
+        logits = _rmsnorm(x, params["lnf"]) @ params["emb"].T
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return k_cache, v_cache, jnp.where(active, nxt, 0)
+
+    def decode(params, k_cache, v_cache, tokens, lengths, steps_left):
+        def step(carry, _):
+            k_cache, v_cache, last, lens, left, emitted = carry
+            act = left > 0
+            k_cache, v_cache, nxt = micro(params, k_cache, v_cache,
+                                          last, lens, act)
+            new_left = jnp.where(act, left - 1, left)
+            if eos_id is not None:
+                new_left = jnp.where(act & (nxt == eos_id), 0, new_left)
+            lens = jnp.where(act, lens + 1, lens)
+            last = jnp.where(act, nxt, last)
+            emitted = emitted + act.astype(jnp.int32)
+            return (k_cache, v_cache, last, lens, new_left, emitted), nxt
+
+        zero = jnp.zeros_like(steps_left)
+        (k_cache, v_cache, _, _, _, emitted), toks = jax.lax.scan(
+            step, (k_cache, v_cache, tokens, lengths, steps_left, zero),
+            None, length=steps)
+        return k_cache, v_cache, toks, emitted
+
+    return decode
+
+
+class CachedDecoder:
+    """The model side of the continuous engine: two jitted programs over
+    a KV slot pool. Programs are shape-generic in the POOL (the garbage
+    row is `k_cache.shape[0] - 1` at trace time), so one CachedDecoder
+    serves pools of any slot count — each pool size compiles once.
+
+    `params=` shares weights across instances (e.g. a reference decoder
+    for tests); `seed=` controls the deterministic random init.
+    """
+
+    def __init__(self, config, params=None, seed=0):
+        import jax
+        from ..deploy import maybe_enable_compile_cache
+        # arm the persistent compilation cache BEFORE the first compile:
+        # warm replicas deserialize instead of recompiling
+        maybe_enable_compile_cache()
+        self.config = config
+        self.params = params if params is not None \
+            else init_decoder_params(config, seed)
+        # programs keyed by their trace-time constants (prefill window /
+        # decode scan length + eos), each its own jit: built once per
+        # engine at construction — steady state replays, never re-builds
+        self._prefills = {}
+        self._decodes = {}
+        self._prefill = self.prefill_program(config.max_len)
+        self._decode = self.decode_program(1, None)
+
+    def new_pool(self, max_slots=None, dtype=None):
+        c = self.config
+        return KVCachePool(max_slots, layers=c.layers, max_len=c.max_len,
+                           heads=c.heads, head_dim=c.head_dim,
+                           dtype=dtype or c.dtype)
+
+    def prefill_program(self, window):
+        """The jitted prefill program for a prompt-page width."""
+        import jax
+        key = int(window)
+        fn = self._prefills.get(key)
+        if fn is None:
+            fn = jax.jit(_make_prefill(self.config, window=key),
+                         donate_argnums=(1, 2))
+            self._prefills[key] = fn
+        return fn
+
+    def decode_program(self, steps, eos_id=None):
+        """The jitted decode program for a (steps, eos) variant (built
+        and memoized on first request; the engine asks once at init)."""
+        import jax
+        key = (int(steps), eos_id)
+        fn = self._decodes.get(key)
+        if fn is None:
+            fn = jax.jit(_make_decode(self.config, steps=key[0],
+                                      eos_id=eos_id),
+                         donate_argnums=(1, 2))
+            self._decodes[key] = fn
+        return fn
+
+    def prefill(self, k_cache, v_cache, tokens, lengths, slot_rows):
+        # window inferred from the token page width (a compiled program
+        # exists per width; the engine always sends its own window)
+        return self.prefill_program(tokens.shape[1])(
+            self.params, k_cache, v_cache, tokens, lengths, slot_rows)
+
+    def decode(self, k_cache, v_cache, tokens, lengths, steps_left,
+               steps=1, eos_id=None):
+        return self.decode_program(steps, eos_id)(
+            self.params, k_cache, v_cache, tokens, lengths, steps_left)
+
+    def compile_cache_size(self):
+        """Total compiled programs across every jit (-1 unknown) — the
+        zero-retrace observable (≙ ExportedModel.compile_cache_size)."""
+        fns = list(self._prefills.values()) + list(self._decodes.values())
+        sizes = [int(getattr(f, "_cache_size", lambda: -1)())
+                 for f in fns]
+        if any(s < 0 for s in sizes):
+            return -1
+        return sum(sizes)
+
+    def reference_generate(self, prompt, max_new_tokens, eos_id=None,
+                           window=None):
+        """Greedy generation through a PRIVATE 1-slot pool — the
+        scheduling-free reference the engine's mixed-batch outputs must
+        match token-for-token (tests). Uses the same compiled math; pass
+        the engine's `prefill_window` so the prefill page width (and so
+        the float-op layout) matches bit-for-bit."""
+        import jax.numpy as jnp
+        pool = self.new_pool(max_slots=1)
+        W = int(window if window is not None else self.config.max_len)
+        plen = len(prompt)
+        if plen < 1 or plen > W or plen >= self.config.max_len:
+            raise ServeError(
+                f"prompt length {plen} outside [1, min(window={W}, "
+                f"max_len-1={self.config.max_len - 1})]")
+        toks = _np.zeros((1, W), dtype=_np.int32)
+        toks[0, :plen] = prompt
+        k, v, first = self.prefill(
+            pool.k, pool.v, jnp.asarray(toks),
+            jnp.asarray([plen], dtype=jnp.int32),
+            jnp.asarray([0], dtype=jnp.int32))
+        pool.swap_buffers(k, v)
+        out = [int(first[0])]
+        cache_len = plen
+        while (len(out) < max_new_tokens
+               and (eos_id is None or out[-1] != eos_id)
+               and cache_len + 1 < self.config.max_len):
+            k, v, toks, _ = self.decode(
+                pool.k, pool.v,
+                jnp.asarray([out[-1]], dtype=jnp.int32),
+                jnp.asarray([cache_len], dtype=jnp.int32),
+                jnp.asarray([1], dtype=jnp.int32))
+            pool.swap_buffers(k, v)
+            out.append(int(toks[0, 0]))
+            cache_len += 1
+        return _np.asarray(out, dtype=_np.int32)
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+class _GenRequest:
+    __slots__ = ("prompt", "max_new", "future", "deadline", "t_submit",
+                 "ctx", "slot", "generated", "cache_len", "t_first",
+                 "t_last")
+
+    def __init__(self, prompt, max_new, deadline, ctx):
+        self.prompt = prompt                 # np.int32 (plen,)
+        self.max_new = max_new
+        self.future = Future()
+        self.deadline = deadline             # perf_counter deadline or None
+        self.t_submit = time.perf_counter()
+        self.ctx = ctx                       # serve.request root context
+        self.slot = None
+        self.generated = []
+        self.cache_len = 0
+        self.t_first = None                  # first token (TTFT anchor)
+        self.t_last = None
+
+    def sort_key(self):
+        """Earliest-deadline-first; deadline-less requests rank after
+        every deadline-holder, FIFO among themselves."""
+        return (self.deadline is None,
+                self.deadline if self.deadline is not None
+                else self.t_submit,
+                self.t_submit)
+
+
+class ContinuousEngine:
+    """Iteration-level batching decode engine over a `CachedDecoder`.
+
+    ::
+
+        model = serve.CachedDecoder(serve.DecoderConfig(max_len=64))
+        with serve.ContinuousEngine(model, max_slots=8) as eng:
+            fut = eng.submit([3, 14, 15], max_new_tokens=16)
+            tokens = fut.result()            # np.int32 generated ids
+
+    Knobs (constructor arg > MXNET_SERVE_* env > default):
+
+      max_slots        KV slots = max concurrently-decoding requests
+      prefill_budget   max prompt TOKENS prefilled per engine iteration
+                       (bounds how long admission may stall in-flight
+                       decode; >= 1 request always admitted when a slot
+                       is free)
+      prefill_lanes    FIXED lane count of the prefill program (default
+                       min(max_slots, 8)): its cost is paid in full per
+                       admission wave regardless of how many lanes carry
+                       real requests, so it is sized for the admission
+                       RATE, not the pool — a max_slots-wide prefill
+                       would bill a 1-request wave the whole pool's
+                       prefill FLOPs
+      max_queue        waiting-request bound (admission control; reuses
+                       MXNET_SERVE_MAX_QUEUE; reject-newest)
+      default_deadline_ms  queue deadline (MXNET_SERVE_DEADLINE_MS);
+                       expiry while WAITING fails fast with
+                       RequestTimeout — admitted requests always finish
+
+    Exactly one scheduler thread runs the compiled steps, so the donated
+    KV buffers have a single writer; submit() is safe from any thread.
+    """
+
+    def __init__(self, model, *, max_slots=None, prefill_budget=None,
+                 prefill_lanes=None, prefill_window=None, decode_steps=4,
+                 max_queue=None, default_deadline_ms=None, eos_id=None,
+                 name="serve.continuous"):
+        self.model = model
+        self.name = name
+        self.eos_id = eos_id
+        self.pool = model.new_pool(max_slots)
+        self.max_slots = self.pool.max_slots
+        # micro-iterations per compiled decode dispatch: >1 amortizes the
+        # host round-trip over K tokens; admission/retirement happen at
+        # wave granularity (a lane finishing mid-wave holds its slot
+        # until the wave ends, never computes past its budget)
+        self.decode_steps = max(1, int(decode_steps))
+        self._decode_prog = model.decode_program(self.decode_steps,
+                                                 eos_id)
+        # prompt page width: prompts are bounded by it, and the prefill
+        # program pays O(window^2) attention instead of O(max_len^2) —
+        # size it to the served prompt distribution, not the page
+        self.prefill_window = int(
+            prefill_window if prefill_window is not None
+            else model.config.max_len)
+        if not 1 <= self.prefill_window <= model.config.max_len:
+            raise ServeError(
+                f"prefill_window must be in [1, max_len], got "
+                f"{self.prefill_window}")
+        self._prefill_prog = model.prefill_program(self.prefill_window)
+        self.prefill_budget = int(
+            prefill_budget if prefill_budget is not None
+            else get_env("MXNET_SERVE_PREFILL_BUDGET", 256, typ=int))
+        if self.prefill_budget < 1:
+            raise ServeError("prefill_budget must be >= 1")
+        self.prefill_lanes = int(prefill_lanes if prefill_lanes is not None
+                                 else min(self.max_slots, 8))
+        if not 1 <= self.prefill_lanes <= self.max_slots:
+            raise ServeError(
+                f"prefill_lanes must be in [1, max_slots], got "
+                f"{self.prefill_lanes}")
+        self.max_queue = int(
+            max_queue if max_queue is not None
+            else get_env("MXNET_SERVE_MAX_QUEUE", 256, typ=int))
+        dl = (default_deadline_ms if default_deadline_ms is not None
+              else get_env("MXNET_SERVE_DEADLINE_MS", typ=float))
+        self.default_deadline_s = None if dl is None else float(dl) / 1e3
+        self.max_len = model.config.max_len
+
+        self._cv = threading.Condition()
+        self._waiting = deque()              # submitted, no slot yet
+        self._running = {}                   # slot -> _GenRequest
+        self._closing = False
+        self._drain = True
+        self._started = False
+        self._warm_cache_size = None
+        self.warmup_s = None
+        self._thread = threading.Thread(
+            target=self._loop, name=f"{name}-scheduler", daemon=True)
+
+        # per-engine metrics (all mutation under _mlock)
+        self._mlock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._counters = {k: 0 for k in (
+            "requests", "replies", "rejected", "timeouts", "errors",
+            "admitted", "retired", "decode_iterations", "decode_tokens",
+            "prefill_tokens", "prefill_batches", "programs_compiled",
+            "active_sum")}
+        self._ttft_ms = deque(maxlen=4096)
+        self._tpot_ms = deque(maxlen=4096)
+        self._e2e_ms = deque(maxlen=4096)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, warmup=True):
+        """Compile (or persistent-cache-load) both step programs before
+        traffic, then start the scheduler thread. Returns self; records
+        the warm compile-cache size for the zero-retrace assertion."""
+        if self._started:
+            return self
+        t0 = time.perf_counter()
+        if warmup:
+            self._warmup()
+        with self._cv:
+            self._warm_cache_size = self.model.compile_cache_size()
+            self._started = True
+        self.warmup_s = round(time.perf_counter() - t0, 3)
+        _trace.install_crash_hooks()
+        self._thread.start()
+        return self
+
+    def _warmup(self):
+        """One garbage-lane prefill + one all-inactive decode: compiles
+        (or loads from MXNET_COMPILE_CACHE_DIR) both programs without
+        touching any real slot."""
+        import jax.numpy as jnp
+        g = self.pool.garbage_row
+        P = self.prefill_lanes
+        k, v, _ = self._prefill_prog(
+            self.model.params, self.pool.k, self.pool.v,
+            jnp.zeros((P, self.prefill_window), dtype=jnp.int32),
+            jnp.ones((P,), dtype=jnp.int32),
+            jnp.full((P,), g, dtype=jnp.int32))
+        self.pool.swap_buffers(k, v)
+        k, v, _, _ = self._decode_prog(
+            self.model.params, self.pool.k, self.pool.v,
+            jnp.zeros((self.max_slots,), dtype=jnp.int32),
+            jnp.zeros((self.max_slots,), dtype=jnp.int32),
+            jnp.zeros((self.max_slots,), dtype=jnp.int32))
+        self.pool.swap_buffers(k, v)
+        # wait for the compiles to actually finish so warmup_s is honest
+        k.block_until_ready()
+        self._count("programs_compiled", 2)
+
+    def __enter__(self):
+        return self.start()
+
+    def close(self, drain=True, timeout=60.0):
+        """Stop the scheduler. `drain=True` finishes admitted AND waiting
+        requests first; `drain=False` fails the waiting queue (admitted
+        requests still finish — their slots hold real state)."""
+        with self._cv:
+            if not self._closing:
+                self._closing = True
+                self._drain = drain
+                pending = [] if drain else list(self._waiting)
+                if not drain:
+                    self._waiting.clear()
+            else:
+                pending = []
+            self._cv.notify_all()
+        for req in pending:
+            _fail(req, ServerClosed("engine closed before admission"))
+        if self._started:
+            self._thread.join(timeout=timeout)
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- submission --------------------------------------------------------
+    def submit(self, prompt_tokens, max_new_tokens=16, deadline_ms=None):
+        """Enqueue one generation request; returns a Future resolving to
+        the np.int32 array of generated token ids (greedy; cut at
+        `eos_id`, `max_new_tokens`, or a full KV page)."""
+        if not self._started:
+            raise ServeError(
+                "ContinuousEngine.start() (or `with engine:`) first")
+        prompt = _np.asarray(prompt_tokens, dtype=_np.int32).ravel()
+        if prompt.size < 1:
+            raise ServeError("prompt must have at least one token")
+        if prompt.size >= self.max_len:
+            raise ServeError(
+                f"prompt length {prompt.size} >= max_len {self.max_len} "
+                f"(one slot page holds prompt + generated tokens)")
+        if prompt.size > self.prefill_window:
+            raise ServeError(
+                f"prompt length {prompt.size} > prefill_window "
+                f"{self.prefill_window} (raise the engine's "
+                f"prefill_window for longer prompts)")
+        if max_new_tokens < 1:
+            raise ServeError("max_new_tokens must be >= 1")
+        _fault.inject("serve.enqueue")
+        dl = (deadline_ms / 1e3 if deadline_ms is not None
+              else self.default_deadline_s)
+        ctx = _trace.request_root("serve.request")
+        req = _GenRequest(prompt, int(max_new_tokens),
+                          None if dl is None
+                          else time.perf_counter() + dl, ctx)
+        with self._cv:
+            if self._closing:
+                raise ServerClosed("engine is closed")
+            if len(self._waiting) >= self.max_queue:
+                depth = len(self._waiting)
+                rejected = True
+            else:
+                rejected = False
+                self._waiting.append(req)
+                self._cv.notify()
+        if rejected:
+            self._count("rejected")
+            _trace.flightrec_record(
+                "serve.reject", self.name, depth=depth,
+                trace_id=ctx.trace_id if ctx else None)
+            _trace.flightrec_maybe_dump("serve.overload")
+            raise QueueFullError(
+                f"waiting queue full ({self.max_queue}); request "
+                f"rejected", policy="reject")
+        self._count("requests")
+        return req.future
+
+    def generate(self, prompt_tokens, max_new_tokens=16, timeout=None,
+                 deadline_ms=None):
+        """submit() + wait."""
+        return self.submit(prompt_tokens, max_new_tokens,
+                           deadline_ms=deadline_ms).result(timeout=timeout)
+
+    # -- metrics -----------------------------------------------------------
+    def _count(self, key, n=1):
+        with self._mlock:
+            self._counters[key] += n
+        stats_key = _ENGINE_TO_SERVE_KEY.get(key)
+        if stats_key is not None:
+            with _STATS_LOCK:
+                SERVE_STATS[stats_key] += n
+
+    def compile_cache_size(self):
+        return self.model.compile_cache_size()
+
+    def retraces_after_warmup(self):
+        """Compiled-program growth since start() — MUST be 0 in steady
+        state (-1 when the jax version hides the counter)."""
+        if self._warm_cache_size is None or self._warm_cache_size < 0:
+            return -1
+        now = self.model.compile_cache_size()
+        return -1 if now < 0 else now - self._warm_cache_size
+
+    def assert_no_retraces(self):
+        r = self.retraces_after_warmup()
+        if r > 0:
+            raise MXNetError(
+                f"continuous engine retraced {r} program(s) after warmup "
+                f"— a shape leaked into the compiled step")
+        return r
+
+    def stats(self):
+        """Plain-data snapshot: counters, slot occupancy, TTFT/TPOT
+        percentiles, decode tokens/s, and the zero-retrace observables."""
+        with self._mlock:
+            c = dict(self._counters)
+            ttft = sorted(self._ttft_ms)
+            tpot = sorted(self._tpot_ms)
+            e2e = sorted(self._e2e_ms)
+            elapsed = time.perf_counter() - self._t0
+        out = dict(c)
+        out["elapsed_s"] = round(elapsed, 3)
+        out["decode_tokens_per_sec"] = round(
+            c["decode_tokens"] / elapsed, 2) if elapsed > 0 else 0.0
+        out["mean_active_slots"] = round(
+            c["active_sum"] / c["decode_iterations"], 3) \
+            if c["decode_iterations"] else 0.0
+        for nm, vals in (("ttft", ttft), ("tpot", tpot), ("e2e", e2e)):
+            for q in (50, 99):
+                v = percentile(vals, q)
+                out[f"{nm}_p{q}_ms"] = round(v, 3) if v is not None \
+                    else None
+        out["pool"] = self.pool.stats()
+        out["decode_steps"] = self.decode_steps
+        out["prefill_lanes"] = self.prefill_lanes
+        out["prefill_window"] = self.prefill_window
+        out["compile_cache_size"] = self.compile_cache_size()
+        out["retraces_after_warmup"] = self.retraces_after_warmup()
+        return out
+
+    # -- scheduler ---------------------------------------------------------
+    def _loop(self):
+        import jax.numpy as jnp
+        while True:
+            with self._cv:
+                while (not self._waiting and not self._running
+                       and not self._closing):
+                    self._cv.wait()
+                if self._closing and not self._running \
+                        and (not self._drain or not self._waiting):
+                    for req in self._waiting:
+                        _fail(req, ServerClosed(
+                            "engine closed before admission"))
+                    self._waiting.clear()
+                    return
+                admitted, expired = self._admit_locked()
+            # expired waiters resolve OUTSIDE self._cv: Future callbacks
+            # run inline and may re-enter submit()
+            now = time.perf_counter()
+            for req in expired:
+                self._count("timeouts")
+                _trace.flightrec_record(
+                    "serve.timeout", self.name,
+                    waited_ms=round((now - req.t_submit) * 1e3, 1),
+                    trace_id=req.ctx.trace_id if req.ctx else None)
+                _fail(req, RequestTimeout(
+                    f"deadline expired after "
+                    f"{(now - req.t_submit) * 1e3:.1f}ms waiting for a "
+                    f"KV slot"))
+            if not admitted and not expired and not self._running:
+                # waiting requests exist but no slot freed up (something
+                # outside the engine holds claims): timed wait, re-check —
+                # never a busy spin
+                with self._cv:
+                    if self._waiting and not self._running:
+                        self._cv.wait(timeout=0.005)
+                continue
+            try:
+                if admitted:
+                    self._run_prefill(admitted, jnp)
+                if self._running:
+                    self._run_decode(jnp)
+            except BaseException as e:
+                # a step failure fails the IN-FLIGHT requests, frees
+                # their slots, and the engine keeps serving (the PR-3
+                # batch-error contract)
+                err = e if isinstance(e, MXNetError) else ServeError(
+                    f"engine step failed: {type(e).__name__}: {e}")
+                with self._cv:
+                    doomed = list(self._running.values())
+                    self._running.clear()
+                for req in doomed:
+                    if req.slot is not None:
+                        self.pool.free(req.slot)
+                    _fail(req, err)
+                self._count("errors", len(doomed))
+                # the step programs DONATE the KV buffers: an exception
+                # raised mid-execution (after donation) leaves pool.k/v
+                # invalidated — fresh buffers or every later wave dies
+                # on 'Array has been deleted'. Every in-flight request
+                # was just failed, so zeroed slabs are the correct state.
+                self.pool.reallocate()
+
+    def _admit_locked(self):
+        """Deadline-aware admission (runs under self._cv): drop expired
+        waiters from the queue, then grant free slots
+        earliest-deadline-first within the prefill token budget. Returns
+        (admitted, expired); the caller resolves expired futures off-lock."""
+        now = time.perf_counter()
+        expired = [r for r in self._waiting
+                   if r.deadline is not None and now > r.deadline]
+        if expired:
+            dropset = set(id(r) for r in expired)
+            self._waiting = deque(r for r in self._waiting  # mxlint: disable=lock-shared-mutation -- _admit_locked runs with self._cv held by its only caller (_loop)
+                                  if id(r) not in dropset)
+        admitted = []
+        budget = self.prefill_budget
+        free = self.pool.free_count()
+        if free and self._waiting:
+            ranked = sorted(self._waiting, key=_GenRequest.sort_key)
+            for req in ranked:
+                if not free or len(admitted) >= self.prefill_lanes:
+                    break
+                cost = int(req.prompt.size)
+                if admitted and budget - cost < 0:
+                    break               # budget spent; next iteration
+                try:
+                    req.slot = self.pool.claim()
+                except SlotsFullError:   # raced a test's direct claim
+                    break
+                free -= 1
+                budget -= cost
+                admitted.append(req)
+            if admitted:
+                dropset = set(id(r) for r in admitted)
+                self._waiting = deque(r for r in self._waiting  # mxlint: disable=lock-shared-mutation -- _admit_locked runs with self._cv held by its only caller (_loop)
+                                      if id(r) not in dropset)
+        for req in admitted:
+            self._running[req.slot] = req  # mxlint: disable=lock-shared-mutation -- _admit_locked runs with self._cv held by its only caller (_loop)
+        return admitted, expired
+
+    def _run_prefill(self, admitted, jnp):
+        """One fixed-shape prefill wave for the just-admitted requests."""
+        _fault.inject("serve.execute")
+        P = self.prefill_lanes
+        g = self.pool.garbage_row
+        toks = _np.zeros((P, self.prefill_window), dtype=_np.int32)
+        lens = _np.ones((P,), dtype=_np.int32)
+        rows = _np.full((P,), g, dtype=_np.int32)
+        for i, req in enumerate(admitted):
+            toks[i, :req.prompt.size] = req.prompt
+            lens[i] = req.prompt.size
+            rows[i] = req.slot
+        t0 = time.perf_counter()
+        k, v, first = self._prefill_prog(
+            self.model.params, self.pool.k, self.pool.v,
+            jnp.asarray(toks), jnp.asarray(lens), jnp.asarray(rows))
+        self.pool.swap_buffers(k, v)
+        first_host = _np.asarray(first)
+        now = time.perf_counter()
+        n_tokens = int(sum(r.prompt.size for r in admitted))
+        self._count("admitted", len(admitted))
+        self._count("prefill_batches")
+        self._count("prefill_tokens", n_tokens)
+        prof = _profiler_on()
+        done = []
+        for i, req in enumerate(admitted):
+            req.cache_len = int(req.prompt.size)
+            req.generated.append(int(first_host[i]))
+            req.t_first = req.t_last = now
+            with self._mlock:
+                self._ttft_ms.append((now - req.t_submit) * 1e3)
+            if req.ctx is not None and prof:
+                # admission -> first token, child of the request root:
+                # iteration 0 of the request's one trace
+                record_span("serve.prefill", (now - req.t_submit) * 1e6,
+                            ts_us=req.t_submit * 1e6, cat="serve",
+                            ctx=_trace.child_context(req.ctx,
+                                                     "serve.prefill"),
+                            prompt_tokens=req.prompt.size,
+                            slot=req.slot)
+            if self._finished(req):
+                done.append(req)
+        if _trace.enabled() and _trace.collector_active():
+            record_span("serve.prefill_batch", (now - t0) * 1e6,
+                        ts_us=t0 * 1e6, cat="serve",
+                        requests=len(admitted), tokens=n_tokens)
+        self._retire(done)
+
+    def _run_decode(self, jnp):
+        """ONE decode wave: every active slot advances up to
+        `decode_steps` tokens through the compiled multi-step program."""
+        S = self.max_slots
+        toks = _np.zeros((S,), dtype=_np.int32)
+        lens = _np.zeros((S,), dtype=_np.int32)
+        left = _np.zeros((S,), dtype=_np.int32)
+        with self._cv:
+            running = dict(self._running)
+        for slot, req in running.items():
+            toks[slot] = req.generated[-1]
+            lens[slot] = req.cache_len
+            # this wave's per-lane budget: what the request still wants,
+            # capped by its page space. The cap mirrors _finished's
+            # `cache_len + 1 >= max_len` stop: the K=1 engine (and the
+            # reference) emit their last token FROM state max_len - 2,
+            # so a multi-step wave may advance cache_len at most to
+            # max_len - 1 — not max_len, which would emit one extra
+            # token and break the K-invariance contract
+            left[slot] = min(req.max_new - len(req.generated),
+                             self.max_len - 1 - req.cache_len)
+        t0 = time.perf_counter()
+        k, v, out_toks, emitted = self._decode_prog(
+            self.model.params, self.pool.k, self.pool.v,
+            jnp.asarray(toks), jnp.asarray(lens), jnp.asarray(left))
+        self.pool.swap_buffers(k, v)
+        out_host = _np.asarray(out_toks)            # (decode_steps, S)
+        emitted_host = _np.asarray(emitted)
+        now = time.perf_counter()
+        n_active = len(running)
+        n_tokens = 0
+        done = []
+        for slot, req in running.items():
+            n_new = int(emitted_host[slot])
+            if n_new > 0:
+                req.generated.extend(
+                    int(t) for t in out_host[:n_new, slot])
+                req.cache_len += n_new
+                req.t_last = now
+                n_tokens += n_new
+            if self._finished(req):
+                done.append(req)
+        self._count("decode_iterations")
+        self._count("decode_tokens", n_tokens)
+        self._count("active_sum", n_active)
+        if _trace.enabled() and _trace.collector_active():
+            record_span("serve.decode_batch", (now - t0) * 1e6,
+                        ts_us=t0 * 1e6, cat="serve", active=n_active,
+                        tokens=n_tokens, steps=self.decode_steps)
+        self._retire(done)
+
+    def _finished(self, req):
+        if len(req.generated) >= req.max_new:
+            return True
+        if self.eos_id is not None and req.generated[-1] == self.eos_id:
+            return True
+        # page full: the NEXT decode would write past the slot
+        return req.cache_len + 1 >= self.max_len
+
+    def _retire(self, done):
+        """Free slots and resolve futures; one request's whole life —
+        prefill + N decode iterations — closes as ONE trace here."""
+        if not done:
+            return
+        prof = _profiler_on()
+        for req in done:
+            with self._cv:
+                self._running.pop(req.slot, None)
+            self.pool.free(req.slot)
+            out = _np.asarray(req.generated, dtype=_np.int32)
+            if self.eos_id is not None:
+                hits = _np.nonzero(out == self.eos_id)[0]
+                if hits.size:
+                    out = out[:int(hits[0]) + 1]
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_result(out)
+            now = time.perf_counter()
+            total_ms = (now - req.t_submit) * 1e3
+            with self._mlock:
+                self._e2e_ms.append(total_ms)
+                if len(req.generated) > 1 and req.t_first is not None:
+                    self._tpot_ms.append(
+                        (req.t_last - req.t_first) * 1e3
+                        / (len(req.generated) - 1))
+            self._count("replies")
+            self._count("retired")
+            if req.ctx is not None and prof:
+                if req.t_first is not None and req.t_last > req.t_first:
+                    # first -> last token: the N decode iterations as one
+                    # node (per-iteration spans at thousands of tokens/s
+                    # would swamp the trace; the batch lane has them)
+                    record_span("serve.decode",
+                                (req.t_last - req.t_first) * 1e6,
+                                ts_us=req.t_first * 1e6, cat="serve",
+                                ctx=_trace.child_context(req.ctx,
+                                                         "serve.decode"),
+                                tokens=len(req.generated), slot=req.slot)
+                record_span("serve.request", total_ms * 1e3,
+                            ts_us=req.t_submit * 1e6, cat="serve",
+                            ctx=req.ctx, tokens=len(req.generated))
+
+
+# engine counter -> process-wide SERVE_STATS key (profiler.serve_stats()):
+# the decode_* family is the continuous-batching analog of the PR-3 rows
+_ENGINE_TO_SERVE_KEY = {
+    "requests": "requests", "replies": "replies",
+    "rejected": "rejected", "timeouts": "timeouts", "errors": "errors",
+    "programs_compiled": "programs_compiled",
+    "decode_iterations": "decode_iterations",
+    "decode_tokens": "decode_tokens",
+    "prefill_tokens": "decode_prefill_tokens",
+    "admitted": "decode_admitted",
+    "retired": "decode_retired",
+}

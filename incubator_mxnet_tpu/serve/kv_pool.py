@@ -1,0 +1,205 @@
+"""Slotted KV-cache pool for the continuous-batching decode engine.
+
+vLLM-style slot memory rebuilt JAX-native (PAPER.md's CachedOp/imperative
+survey: state must live as plain sharded buffers a single compiled program
+reads and writes, never as per-request Python objects the tracer sees):
+
+  * ONE pair of fixed-shape device buffers carved at startup —
+    `k`/`v` of shape `(max_slots + 1, layers, max_len, heads, head_dim)`.
+    Slot granularity: each admitted request claims one row (its whole
+    `max_len` page); row `max_slots` is the GARBAGE ROW, a write target
+    for the pad lanes of a fixed-shape scatter (a prefill program always
+    writes `P` rows — inactive lanes land in garbage instead of branching,
+    which would retrace).
+  * Claim/free is pure host bookkeeping under one lock: the buffers never
+    reallocate, so join/leave can never change a compiled program's
+    shapes — the zero-retrace contract of `serve.continuous`.
+  * Stale bytes are a CORRECTNESS boundary, not a hygiene one: a freed
+    slot's cache rows are NOT zeroed (that would cost a device write per
+    retire). Instead the attention masks in `serve.continuous` clamp
+    every read to `[0, cur_len]` of the CURRENT request, so a reused slot
+    cannot read its predecessor's cache. `poison()` exists so tests can
+    prove that: fill the slab with a sentinel, run a request through a
+    reused slot, and check the output matches a fresh-pool reference
+    bit-for-bit (tests/test_continuous.py).
+
+Exhaustion is typed: `claim()` past capacity raises `SlotsFullError`
+(a `ServeError`), the admission signal the engine's deadline-aware
+scheduler acts on instead of blocking.
+
+Counters: `KVPOOL_STATS` ("kvpool" stats group — `profiler`-style surface
+via `serve.kv_pool.kvpool_stats()`; catalog in docs/OBSERVABILITY.md).
+"""
+from __future__ import annotations
+
+import threading
+
+from ..base import get_env
+from ..telemetry.registry import stats_group as _stats_group
+from .batcher import ServeError
+
+__all__ = ["SlotsFullError", "KVCachePool", "KVPOOL_STATS", "kvpool_stats"]
+
+
+class SlotsFullError(ServeError):
+    """`claim()` found no free KV slot: the pool is at capacity. The
+    engine's admission loop treats this as "stay queued" (and fails the
+    request only when its deadline expires); direct callers get a typed,
+    actionable error instead of an index out of range."""
+
+
+# Guards every KVPOOL_STATS mutation AND the free-list bookkeeping of all
+# pools (claim/free are rare, request-scale events — one shared lock keeps
+# snapshot+reset atomic exactly like serve/metrics.py's _STATS_LOCK).
+_STATS_LOCK = threading.Lock()
+
+KVPOOL_STATS = _stats_group("kvpool", {
+    "claims": 0,       # slots successfully claimed
+    "frees": 0,        # slots returned to the pool
+    "exhausted": 0,    # claim() attempts that found no free slot
+}, lock=_STATS_LOCK,
+    help="KV-cache slot-pool counters (serve.kv_pool.kvpool_stats)")
+
+
+def kvpool_stats(reset=False):
+    """Process-wide KV-pool counter snapshot (atomic with the optional
+    reset, the serve_stats() contract)."""
+    return KVPOOL_STATS.snapshot(reset=reset)
+
+
+class KVCachePool:
+    """Preallocated KV-cache slab + slot claim/free bookkeeping.
+
+    ::
+
+        pool = KVCachePool(max_slots=8, layers=2, max_len=128,
+                           heads=4, head_dim=16)
+        slot = pool.claim()          # 0 <= slot < max_slots
+        ...                          # compiled steps read/write pool.k/v
+        pool.free(slot)
+
+    The device buffers `k` and `v` are plain jax arrays the engine's
+    donated step programs consume and replace (`swap_buffers`), so
+    updates are in-place on accelerators. `garbage_row == max_slots` is
+    the scatter target for inactive lanes.
+
+    Thread safety: `claim`/`free`/`free_count`/`in_use` take the module
+    lock (the engine claims on its scheduler thread while tests hammer
+    from many); buffer access is single-writer by the engine contract
+    (exactly one scheduler thread runs the compiled steps).
+    """
+
+    def __init__(self, max_slots=None, *, layers, max_len, heads,
+                 head_dim, dtype="float32", allocate=True):
+        self.max_slots = int(
+            max_slots if max_slots is not None
+            else get_env("MXNET_SERVE_MAX_SLOTS", 8, typ=int))
+        if self.max_slots < 1:
+            raise ServeError("KVCachePool needs max_slots >= 1")
+        self.layers = int(layers)
+        self.max_len = int(max_len)
+        self.heads = int(heads)
+        self.head_dim = int(head_dim)
+        self.dtype = str(dtype)
+        # LIFO free list: a just-freed slot is re-claimed first, which is
+        # exactly what the poison-fill reuse test needs to exercise
+        self._free = list(range(self.max_slots - 1, -1, -1))
+        self._claimed = set()
+        self.k = self.v = None
+        if allocate:
+            self._allocate()
+
+    # -- buffers -----------------------------------------------------------
+    @property
+    def shape(self):
+        """Slab shape incl. the garbage row (the compiled-program view)."""
+        return (self.max_slots + 1, self.layers, self.max_len,
+                self.heads, self.head_dim)
+
+    @property
+    def garbage_row(self):
+        """Scatter target for a fixed-shape step's inactive lanes."""
+        return self.max_slots
+
+    def _allocate(self):
+        import jax.numpy as jnp
+        self.k = jnp.zeros(self.shape, dtype=self.dtype)
+        self.v = jnp.zeros(self.shape, dtype=self.dtype)
+
+    def reallocate(self):
+        """Replace the slab with fresh zeroed buffers. The engine's
+        step-failure path needs this: the compiled programs DONATE the
+        buffers, so an exception raised mid-execution leaves `k`/`v`
+        pointing at already-invalidated arrays — without reallocation
+        every later wave would die on 'Array has been deleted'."""
+        self._allocate()
+
+    def nbytes(self):
+        """Host-visible size of the slab pair (capacity-planning aid)."""
+        import numpy as _np
+        import ml_dtypes  # noqa: F401  (bf16 dtype string resolution)
+        try:
+            itemsize = _np.dtype(self.dtype).itemsize
+        except TypeError:
+            itemsize = 2      # bfloat16
+        n = 1
+        for d in self.shape:
+            n *= d
+        return 2 * n * itemsize
+
+    def swap_buffers(self, k, v):
+        """Install the step program's output buffers (the donated-update
+        swap idiom: the old arrays were consumed by donation)."""
+        self.k, self.v = k, v
+
+    def poison(self, value=1e9):
+        """Overwrite the WHOLE slab with a sentinel. Test hook for the
+        slot-reuse isolation contract: after poisoning, any read that
+        escapes the `[0, cur_len]` mask shows up as the sentinel in the
+        output. Never called on the serving path."""
+        import jax.numpy as jnp
+        self.k = jnp.full(self.shape, value, dtype=self.dtype)
+        self.v = jnp.full(self.shape, value, dtype=self.dtype)
+
+    # -- slot bookkeeping --------------------------------------------------
+    def claim(self):
+        """Take a free slot (int in [0, max_slots)); raises SlotsFullError
+        when the pool is exhausted."""
+        with _STATS_LOCK:
+            if not self._free:
+                KVPOOL_STATS["exhausted"] += 1
+                raise SlotsFullError(
+                    f"all {self.max_slots} KV slots are claimed")
+            slot = self._free.pop()
+            self._claimed.add(slot)
+            KVPOOL_STATS["claims"] += 1
+            return slot
+
+    def free(self, slot):
+        """Return a slot. Double-free (or freeing an unclaimed slot) is a
+        bookkeeping bug upstream and raises ServeError rather than
+        silently handing one slot to two requests."""
+        slot = int(slot)
+        with _STATS_LOCK:
+            if slot not in self._claimed:
+                raise ServeError(
+                    f"KV slot {slot} is not claimed (double free?)")
+            self._claimed.remove(slot)
+            self._free.append(slot)
+            KVPOOL_STATS["frees"] += 1
+
+    def free_count(self):
+        with _STATS_LOCK:
+            return len(self._free)
+
+    def in_use(self):
+        with _STATS_LOCK:
+            return sorted(self._claimed)
+
+    def stats(self):
+        """Plain-data snapshot of this pool's occupancy."""
+        with _STATS_LOCK:
+            used = len(self._claimed)
+        return {"max_slots": self.max_slots, "in_use": used,
+                "free": self.max_slots - used,
+                "slab_bytes": self.nbytes() if self.k is not None else 0}

@@ -45,6 +45,13 @@ __all__ = ["SERVE_STATS", "ServeMetrics", "serve_stats", "percentile"]
 #   padded_rows     pad rows added to round batches up to their bucket
 #   programs_compiled  first-execution compiles (bucket warmups); steady
 #                      state MUST hold this flat (zero-retrace contract)
+# Continuous-batching family (serve/continuous.py ContinuousEngine):
+#   decode_iterations     engine decode steps executed (each advances
+#                         EVERY active KV slot one token)
+#   decode_tokens         tokens generated across all requests
+#   decode_prefill_tokens prompt tokens prefilled into KV slots
+#   decode_admitted       requests granted a KV slot (deadline-aware)
+#   decode_retired        requests finished and their slot freed
 # Guards every SERVE_STATS mutation (all Server instances, all threads).
 _STATS_LOCK = threading.Lock()
 
@@ -56,6 +63,9 @@ SERVE_STATS = _stats_group("serve", {
     "requests": 0, "replies": 0, "rejected": 0, "shed": 0,
     "timeouts": 0, "errors": 0, "batches": 0, "padded_rows": 0,
     "programs_compiled": 0,
+    "decode_iterations": 0, "decode_tokens": 0,
+    "decode_prefill_tokens": 0, "decode_admitted": 0,
+    "decode_retired": 0,
 }, lock=_STATS_LOCK,
     help="process-wide serving counters (profiler.serve_stats)")
 

@@ -1,0 +1,532 @@
+"""serve.continuous: iteration-level batching over slotted KV-cache pools.
+
+Contracts under test (ISSUE 14 acceptance):
+  * mixed ragged traffic through the engine produces token-for-token the
+    same outputs as a scheduling-free single-slot reference decode
+  * ZERO retraces after warmup over any join/leave pattern, observed via
+    the PR-3 `programs_compiled` counter AND `compile_cache_size()`
+  * KV-slot lifecycle: claim/free under concurrent hammering, typed
+    `SlotsFullError` on exhaustion, and slot REUSE cannot read a prior
+    request's cache (poison-fill + value check — the mask contract)
+  * deadline-aware admission: waiting deadline-holders get slots before
+    FIFO order; a deadline that expires while WAITING fails fast
+  * one request = ONE trace across its N iterations (serve.request root
+    with serve.prefill / serve.decode children, same trace id)
+  * `MXNET_COMPILE_CACHE_DIR` makes a warm replica skip compilation
+  * PR-3 pad-row mask regression: outputs that cannot be pad-masked
+    fail typed instead of leaking pad garbage (tests/test_serve.py side
+    covers the server; here the engine never pads replies by design)
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import profiler, serve
+from incubator_mxnet_tpu.serve.kv_pool import KVPOOL_STATS
+
+
+CFG = dict(vocab=64, embed=32, layers=2, heads=4, head_dim=8, max_len=48)
+
+
+@pytest.fixture(scope="module")
+def decoder():
+    """One small CachedDecoder + a weight-sharing reference twin (its own
+    jits, so reference calls never touch the engine's compile caches)."""
+    cfg = serve.DecoderConfig(**CFG)
+    model = serve.CachedDecoder(cfg, seed=3)
+    ref = serve.CachedDecoder(cfg, params=model.params)
+    return model, ref
+
+
+def _workload(n, seed=0, vocab=64, max_new_hi=20):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(1, vocab, size=rng.randint(2, 12)).tolist(),
+             int(rng.randint(1, max_new_hi))) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# correctness + zero retraces
+# ---------------------------------------------------------------------------
+def test_engine_matches_reference_and_never_retraces(decoder):
+    model, ref = decoder
+    work = _workload(16)
+    before = profiler.serve_stats()
+    with serve.ContinuousEngine(model, max_slots=4, decode_steps=3) as eng:
+        warm_ccs = eng.compile_cache_size()
+        warm_programs = profiler.serve_stats()["programs_compiled"]
+        futs = [eng.submit(p, m) for p, m in work]
+        outs = [f.result(timeout=120) for f in futs]
+        st = eng.stats()
+        # join/leave churned the mixed batch every iteration; the two
+        # compiled programs must have been enough for all of it
+        assert eng.assert_no_retraces() == 0
+        assert eng.compile_cache_size() == warm_ccs
+        assert profiler.serve_stats()["programs_compiled"] == warm_programs
+    for (p, m), o in zip(work, outs):
+        np.testing.assert_array_equal(
+            o, ref.reference_generate(p, m),
+            err_msg=f"engine output diverged for prompt {p} max_new {m}")
+        assert len(o) == m
+    # decode_* counter family moved (stats-key + catalog contract):
+    # "decode_iterations", "decode_tokens", "decode_prefill_tokens",
+    # "decode_admitted", "decode_retired" aggregate process-wide
+    after = profiler.serve_stats()
+    assert after["decode_retired"] - before["decode_retired"] == 16
+    assert after["decode_admitted"] - before["decode_admitted"] == 16
+    assert after["decode_tokens"] - before["decode_tokens"] \
+        == sum(m for _, m in work) - 16      # first tokens come from prefill
+    assert after["decode_prefill_tokens"] - before["decode_prefill_tokens"] \
+        == sum(len(p) for p, _ in work)
+    assert after["decode_iterations"] > before["decode_iterations"]
+    assert st["decode_tokens_per_sec"] > 0
+    assert st["ttft_p50_ms"] is not None
+    assert json.dumps(st)
+
+
+def test_multi_step_decode_equals_single_step(decoder):
+    """decode_steps is pure amortization: K=1 and K=6 produce identical
+    tokens (the scan replays the exact single-step math)."""
+    model, ref = decoder
+    work = _workload(6, seed=5)
+    outs = {}
+    for steps in (1, 6):
+        with serve.ContinuousEngine(model, max_slots=2,
+                                    decode_steps=steps) as eng:
+            outs[steps] = [eng.generate(p, m, timeout=120)
+                           for p, m in work]
+    for a, b in zip(outs[1], outs[6]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_eos_stops_generation_and_frees_early(decoder):
+    model, ref = decoder
+    prompt, max_new = [7, 3, 19], 16
+    base = ref.reference_generate(prompt, max_new)
+    # pick a token the model actually emits mid-sequence as the eos
+    eos = int(base[len(base) // 2])
+    expect = ref.reference_generate(prompt, max_new, eos_id=eos)
+    assert len(expect) < len(base)
+    eng = serve.ContinuousEngine(model, max_slots=2, decode_steps=4,
+                                 eos_id=eos).start()
+    try:
+        out = eng.generate(prompt, max_new, timeout=120)
+    finally:
+        eng.close()
+    np.testing.assert_array_equal(out, expect)
+    assert out[-1] == eos
+
+
+def test_eos_mid_wave_keeps_exact_token_accounting(decoder):
+    """Regression: eos zeroes a lane's remaining budget in-scan, so
+    deriving per-lane emission from the steps_left delta OVERCOUNTED
+    (inflating cache_len, appending garbage 0-tokens, and keeping the
+    slot past eos). The scan now counts emitted tokens exactly."""
+    model, ref = decoder
+    prompt, max_new = [7, 3, 19], 16
+    base = ref.reference_generate(prompt, max_new)
+    eos = int(base[len(base) // 2])
+    expect = ref.reference_generate(prompt, max_new, eos_id=eos)
+    # decode_steps far larger than the post-eos remainder: eos fires
+    # mid-wave with budget left
+    eng = serve.ContinuousEngine(model, max_slots=2, decode_steps=8,
+                                 eos_id=eos).start()
+    try:
+        out = eng.generate(prompt, max_new, timeout=120)
+        st = eng.stats()
+    finally:
+        eng.close()
+    np.testing.assert_array_equal(out, expect)
+    # exact accounting: the only decode tokens are the reply minus the
+    # prefill-emitted first token — no phantom post-eos tokens
+    assert st["decode_tokens"] == len(out) - 1
+    assert st["replies"] == 1 and st["pool"]["in_use"] == 0
+
+
+def test_page_full_token_count_is_decode_steps_invariant(decoder):
+    """Regression: the per-wave page-space cap allowed one token more
+    than _finished/reference at a full page, so the token COUNT depended
+    on decode_steps. K must be pure amortization."""
+    cfg = serve.DecoderConfig(**dict(CFG, max_len=12))
+    model = serve.CachedDecoder(cfg, seed=3)
+    ref = serve.CachedDecoder(cfg, params=model.params)
+    prompt, max_new = [7, 3, 19], 30           # page-limited, not count-
+    expect = ref.reference_generate(prompt, max_new)
+    for steps in (1, 7):
+        with serve.ContinuousEngine(model, max_slots=2,
+                                    decode_steps=steps) as eng:
+            out = eng.generate(prompt, max_new, timeout=120)
+        np.testing.assert_array_equal(
+            out, expect, err_msg=f"decode_steps={steps} diverged at "
+            f"page-full from the reference")
+
+
+def test_step_failure_after_donation_engine_keeps_serving(decoder):
+    """Regression: the compiled steps DONATE the pool buffers; an
+    exception raised mid-execution (after donation) used to leave
+    pool.k/v invalidated, killing every later wave. The failure path
+    now reallocates the slab."""
+    model, ref = decoder
+    eng = serve.ContinuousEngine(model, max_slots=2,
+                                 decode_steps=2).start()
+    real = eng._decode_prog
+
+    def boom_after_donation(params, k, v, *rest):
+        real(params, k, v, *rest)    # consumes (donates) k and v
+        raise RuntimeError("transient failure after donation")
+
+    try:
+        eng._decode_prog = boom_after_donation
+        f = eng.submit([1, 2, 3], 6)
+        with pytest.raises(serve.ServeError, match="engine step failed"):
+            f.result(timeout=60)
+        eng._decode_prog = real
+        # the engine must keep serving correct results on fresh buffers
+        out = eng.generate([4, 5], 5, timeout=60)
+        st = eng.stats()
+    finally:
+        eng.close()
+    np.testing.assert_array_equal(out, ref.reference_generate([4, 5], 5))
+    assert st["errors"] == 1 and st["replies"] == 1
+
+
+def test_prefill_window_bounds_prompt(decoder):
+    model, _ = decoder
+    with serve.ContinuousEngine(model, max_slots=2,
+                                prefill_window=8) as eng:
+        with pytest.raises(serve.ServeError, match="prefill_window"):
+            eng.submit(list(range(1, 12)), 4)
+        assert eng.generate([1, 2, 3], 4, timeout=60).size == 4
+
+
+# ---------------------------------------------------------------------------
+# KV-slot lifecycle
+# ---------------------------------------------------------------------------
+def test_kv_pool_claim_free_and_typed_exhaustion():
+    pool = serve.KVCachePool(max_slots=3, layers=1, max_len=8, heads=2,
+                             head_dim=4, allocate=False)
+    before = serve.kvpool_stats()
+    slots = [pool.claim() for _ in range(3)]
+    assert sorted(slots) == [0, 1, 2]
+    assert pool.free_count() == 0
+    with pytest.raises(serve.SlotsFullError):
+        pool.claim()
+    # SlotsFullError is a typed ServeError (admission can catch it)
+    assert issubclass(serve.SlotsFullError, serve.ServeError)
+    pool.free(slots[0])
+    assert pool.free_count() == 1
+    with pytest.raises(serve.ServeError, match="double free"):
+        pool.free(slots[0])
+    after = serve.kvpool_stats()
+    # "claims" / "frees" / "exhausted" process-wide counters moved
+    assert after["claims"] - before["claims"] == 3
+    assert after["frees"] - before["frees"] == 1
+    assert after["exhausted"] - before["exhausted"] == 1
+    assert KVPOOL_STATS["claims"] >= 3
+    st = pool.stats()
+    assert st["in_use"] == 2 and st["free"] == 1 and st["max_slots"] == 3
+
+
+def test_kv_pool_concurrent_claim_free_hammer():
+    """8 threads churn claim/free; bookkeeping stays exact: no slot is
+    ever handed to two holders, counts balance, capacity is respected."""
+    pool = serve.KVCachePool(max_slots=4, layers=1, max_len=8, heads=2,
+                             head_dim=4, allocate=False)
+    errs, held_twice = [], []
+    lock = threading.Lock()
+    held = set()
+
+    def hammer(tid):
+        rng = np.random.RandomState(tid)
+        try:
+            for _ in range(300):
+                try:
+                    s = pool.claim()
+                except serve.SlotsFullError:
+                    continue
+                with lock:
+                    if s in held:
+                        held_twice.append(s)
+                    held.add(s)
+                if rng.rand() < 0.5:
+                    time.sleep(0)
+                with lock:
+                    held.discard(s)
+                pool.free(s)
+        except BaseException as e:   # pragma: no cover - diagnostics
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errs, errs
+    assert not held_twice, f"slots double-claimed: {held_twice}"
+    assert pool.free_count() == 4 and pool.in_use() == []
+
+
+def test_slot_reuse_cannot_read_prior_request_cache(decoder):
+    """Poison-fill + value check: fill the WHOLE slab with a sentinel,
+    then run a request through a reused slot — output must match the
+    fresh-pool reference bit-for-bit, proving no read escapes the
+    current request's [0, cur_len] window (prefill_window < max_len, so
+    the page is NOT fully overwritten at claim: only the mask protects
+    the tail)."""
+    model, ref = decoder
+    eng = serve.ContinuousEngine(model, max_slots=1, prefill_window=16,
+                                 decode_steps=2).start()
+    try:
+        # tenant 1 dirties slot 0 with its own KV
+        eng.generate([9, 8, 7, 6], 10, timeout=60)
+        assert eng.pool.in_use() == []
+        # now poison EVERYTHING the compiled programs could read
+        eng.pool.poison(1e9)
+        out = eng.generate([1, 2, 3], 8, timeout=60)
+    finally:
+        eng.close()
+    np.testing.assert_array_equal(
+        out, ref.reference_generate([1, 2, 3], 8, window=16),
+        err_msg="reused slot leaked a prior tenant's cache into decode")
+
+
+def test_requests_queue_when_slots_full_then_complete(decoder):
+    model, ref = decoder
+    work = _workload(10, seed=9)
+    with serve.ContinuousEngine(model, max_slots=2,
+                                decode_steps=2) as eng:
+        futs = [eng.submit(p, m) for p, m in work]
+        outs = [f.result(timeout=120) for f in futs]
+        st = eng.stats()
+    assert st["pool"]["in_use"] == 0
+    assert st["replies"] == 10
+    for (p, m), o in zip(work, outs):
+        np.testing.assert_array_equal(o, ref.reference_generate(p, m))
+
+
+# ---------------------------------------------------------------------------
+# SLO-aware admission
+# ---------------------------------------------------------------------------
+def test_deadline_aware_slot_grant_beats_fifo(decoder):
+    """With the pool exhausted, a LATER-submitted request holding a
+    deadline is granted the next slot before an earlier deadline-less
+    one. The slot is held by a DIRECT pool claim (no request timing to
+    race): admission can only happen after the test frees it."""
+    model, _ = decoder
+    eng = serve.ContinuousEngine(model, max_slots=1, prefill_lanes=1,
+                                 decode_steps=1).start()
+    order = []
+    lock = threading.Lock()
+    try:
+        held = eng.pool.claim()                    # engine cannot admit
+        fifo = eng.submit([1, 2], 4)               # waiting, no deadline
+        slo = eng.submit([3, 4], 4, deadline_ms=30000)   # waiting, SLO
+
+        def watch(name, fut):
+            fut.result(timeout=120)
+            with lock:
+                order.append(name)
+
+        ts = [threading.Thread(target=watch, args=(n, f))
+              for n, f in (("fifo", fifo), ("slo", slo))]
+        for t in ts:
+            t.start()
+        time.sleep(0.05)                           # both demonstrably wait
+        eng.pool.free(held)
+        for t in ts:
+            t.join(timeout=120)
+    finally:
+        eng.close()
+    assert order and order[0] == "slo", \
+        f"deadline-holder was not granted the slot first: {order}"
+
+
+def test_deadline_expires_while_waiting_for_slot(decoder):
+    model, _ = decoder
+    before = profiler.serve_stats()["timeouts"]
+    eng = serve.ContinuousEngine(model, max_slots=1, prefill_lanes=1,
+                                 decode_steps=1).start()
+    try:
+        held = eng.pool.claim()                    # engine cannot admit
+        doomed = eng.submit([1, 2], 4, deadline_ms=15)
+        with pytest.raises(serve.RequestTimeout, match="KV slot"):
+            doomed.result(timeout=60)
+        eng.pool.free(held)
+        # the engine keeps serving after the expiry
+        assert eng.generate([3, 3], 3, timeout=60).size == 3
+    finally:
+        eng.close()
+    assert profiler.serve_stats()["timeouts"] == before + 1
+
+
+def test_queue_full_rejects_typed(decoder):
+    model, _ = decoder
+    eng = serve.ContinuousEngine(model, max_slots=1, prefill_lanes=1,
+                                 max_queue=2, decode_steps=1).start()
+    try:
+        futs = [eng.submit([5, 5], 40)]
+        rejected = 0
+        for _ in range(12):
+            try:
+                futs.append(eng.submit([1, 2], 2))
+            except serve.QueueFullError as e:
+                assert e.policy == "reject"
+                rejected += 1
+        assert rejected > 0
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        eng.close()
+
+
+def test_closed_engine_rejects_and_drains(decoder):
+    model, ref = decoder
+    eng = serve.ContinuousEngine(model, max_slots=2).start()
+    futs = [eng.submit(p, m) for p, m in _workload(6, seed=2)]
+    eng.close(drain=True)
+    assert all(f.exception() is None for f in futs)
+    with pytest.raises(serve.ServerClosed):
+        eng.submit([1, 2], 4)
+
+
+# ---------------------------------------------------------------------------
+# tracing: one request = one trace across N iterations
+# ---------------------------------------------------------------------------
+def test_one_trace_across_iterations(decoder, tmp_path):
+    model, _ = decoder
+    profiler.start()
+    try:
+        with serve.ContinuousEngine(model, max_slots=2,
+                                    decode_steps=2) as eng:
+            futs = [eng.submit([3, 1, 4], 9), eng.submit([2, 7], 7)]
+            for f in futs:
+                f.result(timeout=120)
+            st = eng.stats()
+            assert st["decode_iterations"] >= 2
+    finally:
+        profiler.stop()
+    f = str(tmp_path / "trace.json")
+    profiler.dump(filename=f)
+    events = json.load(open(f))["traceEvents"]
+    roots = [e for e in events if e["name"] == "serve.request"
+             and "tokens" in e.get("args", {})]
+    assert len(roots) == 2
+    tids = {e["args"]["trace_id"] for e in roots}
+    assert len(tids) == 2, "each request must be its own trace"
+    for root in roots:
+        tid = root["args"]["trace_id"]
+        span_id = root["args"]["span_id"]
+        prefill = [e for e in events if e["name"] == "serve.prefill"
+                   and e["args"].get("trace_id") == tid]
+        decode = [e for e in events if e["name"] == "serve.decode"
+                  and e["args"].get("trace_id") == tid]
+        # admission->first-token and first->last-token (N iterations)
+        # both hang off the SAME request root: one trace, N iterations
+        assert len(prefill) == 1 and len(decode) == 1
+        assert prefill[0]["args"]["parent_span_id"] == span_id
+        assert decode[0]["args"]["parent_span_id"] == span_id
+        assert decode[0]["args"]["tokens"] == root["args"]["tokens"]
+    # the engine's wave lanes recorded too (collector was active)
+    assert any(e["name"] == "serve.decode_batch" for e in events)
+    assert any(e["name"] == "serve.prefill_batch" for e in events)
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache: warm replica skips compile
+# ---------------------------------------------------------------------------
+_REPLICA_PROG = r"""
+import sys
+from incubator_mxnet_tpu import serve
+cfg = serve.DecoderConfig(vocab=64, embed=32, layers=2, heads=4,
+                          head_dim=8, max_len=48)
+model = serve.CachedDecoder(cfg, seed=11)
+eng = serve.ContinuousEngine(model, max_slots=2).start()
+out = eng.generate([1, 2, 3], 5, timeout=60)
+eng.close()
+print("WARMUP_S", eng.warmup_s)
+print("TOKENS", ",".join(str(t) for t in out))
+"""
+
+
+def test_compile_cache_dir_warms_second_replica(tmp_path):
+    """Two FRESH processes sharing one MXNET_COMPILE_CACHE_DIR — the
+    real replica semantics: the first compiles and serializes, the
+    second deserializes. (In-process clear_caches() would corrupt live
+    compiled programs elsewhere in the suite; replicas are processes.)"""
+    d = str(tmp_path / "cc")
+    os.makedirs(d)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_COMPILE_CACHE_DIR=d)
+
+    def replica():
+        r = subprocess.run([sys.executable, "-c", _REPLICA_PROG],
+                           env=env, capture_output=True, text=True,
+                           timeout=300)
+        assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+        warm_s = float(r.stdout.split("WARMUP_S")[1].split()[0])
+        toks = r.stdout.split("TOKENS")[1].split()[0]
+        return warm_s, toks
+
+    cold, toks_cold = replica()
+    assert len(os.listdir(d)) > 0, \
+        "no executables persisted to MXNET_COMPILE_CACHE_DIR"
+    warm, toks_warm = replica()
+    # same executables -> same tokens; the warm replica deserializes
+    # instead of compiling. On a busy CI host we only assert it is NOT
+    # SLOWER (the committed bench artifact carries the measured speedup)
+    assert toks_cold == toks_warm
+    assert warm <= cold * 1.2, (cold, warm)
+
+
+# ---------------------------------------------------------------------------
+# bench smoke + committed artifact acceptance
+# ---------------------------------------------------------------------------
+def test_serve_bench_autoregressive_quick_smoke(tmp_path):
+    out = tmp_path / "autoreg.json"
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmark", "serve_bench.py")
+    r = subprocess.run(
+        [sys.executable, script, "--autoregressive", "--quick",
+         "--duration", "1.0", "--out", str(out)],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    data = json.loads(out.read_text())
+    assert data["backend_ok"] is True
+    assert data["meta"]["mode"] == "autoregressive"
+    assert data["continuous"]["decode_tokens_per_sec"] > 0
+    assert data["continuous"]["retraces_after_warmup"] == 0
+    assert data["static"]["decode_tokens_per_sec"] > 0
+    assert data["serve_decode_tokens_per_sec"] > 0
+    assert data["serve_ttft_p99_ms"] > 0
+    assert data["compile_cache_entries"] > 0
+
+
+def test_committed_continuous_artifact_acceptance():
+    """The committed r14 artifact holds the ISSUE-14 acceptance: >= 2x
+    decode tokens/s over the static batcher at concurrency 32, zero
+    retraces, and a measurable warm-replica compile skip."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmark", "results",
+        "serve_continuous_r14.json")
+    data = json.load(open(path))
+    assert data["backend_ok"] is True
+    assert data["meta"]["concurrency"] == 32
+    assert data["serve_continuous_speedup_vs_static"] >= 2.0
+    assert data["continuous"]["retraces_after_warmup"] == 0
+    # continuous TTFT tail beats static's by construction
+    assert data["continuous"]["ttft_p99_ms"] \
+        < data["static"]["ttft_p99_ms"]
+    assert data["serve_compile_cache_warm_speedup"] > 1.2
+    rows = data["autoreg_open_loop"]
+    assert len(rows) >= 4
+    offered = [r["offered_rps"] for r in rows]
+    assert offered == sorted(offered)
+    # the sweep crosses saturation: decode tokens/s stops tracking the
+    # offered load at the top rates
+    assert rows[-1]["achieved_rps"] < 0.9 * rows[-1]["offered_rps"]

@@ -282,6 +282,41 @@ def test_close_without_drain_fails_pending():
 
 
 # ---------------------------------------------------------------------------
+# regression (ISSUE 14 satellite): pad-row mask. Batches are zero-padded
+# up to their bucket, so output rows [n:] are pad garbage — the execute
+# path must slice them off explicitly, and an output that does not carry
+# the batch dim (no row<->request correspondence: indexing it would hand
+# requesters data mixing in pad rows) must fail TYPED, never reply.
+# ---------------------------------------------------------------------------
+def test_pad_rows_never_leak_into_replies():
+    # fn(x) maps zero pad rows to the sentinel 5.0 — if any pad row
+    # leaked into a reply, the requester would see 5s instead of its
+    # own transform
+    model = serve.CallableModel(lambda x: x * 2.0 + 5.0, (4,),
+                                [((3,), "float32")])
+    with serve.Server(model, batch_timeout_ms=1.0) as srv:
+        xs = _rows(7, dim=3, seed=21)
+        outs = [srv.predict(x, timeout=30) for x in xs]
+        for x, o in zip(xs, outs):
+            assert o.shape == (3,)
+            np.testing.assert_allclose(o, x * 2.0 + 5.0, rtol=1e-6)
+
+
+def test_batch_reducing_output_fails_typed_not_garbage():
+    # a model that reduces over the batch axis: its output has NO pad
+    # mask (every element mixes the zero pad rows in) — the server must
+    # fail the batch with a typed error instead of slicing nonsense
+    model = serve.CallableModel(lambda x: x.sum(axis=0), (2,),
+                                [((3,), "float32")])
+    with serve.Server(model, batch_timeout_ms=1.0) as srv:
+        f = srv.submit(np.ones(3, np.float32))
+        with pytest.raises(serve.ServeError, match="pad"):
+            f.result(timeout=30)
+        # the server survives the failed batch
+        assert srv.stats()["errors"] == 1
+
+
+# ---------------------------------------------------------------------------
 # metrics + observability
 # ---------------------------------------------------------------------------
 def test_metrics_surface(exported):

@@ -83,6 +83,12 @@ TREND_KEYS = {
     # SLO-aware admission will be judged against
     "serve_knee_rps": "higher",
     "serve_p99_ms_at_0p8_knee": "lower",
+    # continuous-batching phase (PR 14, serve.continuous): decode
+    # throughput through the iteration-level engine must not fall, and
+    # time-to-first-token p99 — the admission/SLO half of the story —
+    # must not grow
+    "serve_decode_tokens_per_sec": "higher",
+    "serve_ttft_p99_ms": "lower",
 }
 
 DEFAULT_THRESHOLD = 0.10
@@ -327,6 +333,23 @@ def self_test():
     rep = compare(ol_base, dict(ol_base, serve_knee_rps=130.0,
                                 serve_p99_ms_at_0p8_knee=40.0))
     check("improving open-loop keys pass with improvements reported",
+          rep["status"] == "ok" and len(rep["improvements"]) == 2)
+    # continuous-batching keys (PR 14): falling decode tokens/s or a
+    # rising TTFT p99 gates the trend
+    cont_base = {"backend_ok": True,
+                 "serve_decode_tokens_per_sec": 9000.0,
+                 "serve_ttft_p99_ms": 20.0}
+    rep = compare(cont_base,
+                  dict(cont_base, serve_decode_tokens_per_sec=7000.0,
+                       serve_ttft_p99_ms=35.0))
+    check("decode tokens/s drop / ttft p99 rise is a regression",
+          rep["status"] == "regression"
+          and {r["key"] for r in rep["regressions"]}
+          == {"serve_decode_tokens_per_sec", "serve_ttft_p99_ms"})
+    rep = compare(cont_base,
+                  dict(cont_base, serve_decode_tokens_per_sec=12000.0,
+                       serve_ttft_p99_ms=14.0))
+    check("improving continuous keys pass with improvements reported",
           rep["status"] == "ok" and len(rep["improvements"]) == 2)
     missing_only_new = {"backend_ok": True,
                         "io_pipeline_images_per_sec": 700.0}
