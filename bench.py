@@ -879,6 +879,101 @@ def _phase_fused_sweep(tiny=False):
     return out
 
 
+def _phase_memory(quick=False):
+    """Device-memory trend row (mx.inspect.memory): predicted vs measured
+    peak for the fused train step, the carved KV slab of a serving pool,
+    and a leakcheck over the real train loop. The four scalars benchdiff
+    gates:
+
+      train_peak_hbm_mb          measured live-buffer high-water across
+                                 the timed train steps (census-based —
+                                 honest on CPU where memory_stats is
+                                 absent; stamped measured_source)
+      serve_kv_slab_mb           the KV slab pair a serving pool carves
+                                 (the single biggest planned allocation
+                                 in serving)
+      mem_plan_vs_measured_ratio compiled-program plan peak / measured
+                                 peak — plan-quality drift gate (a plan
+                                 ballooning relative to what actually
+                                 lives is a prediction regression)
+      leakcheck_growth_mb        untagged live-byte growth across
+                                 leakcheck rounds of the REAL train loop
+                                 (must stay ~0)
+    """
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, serve, telemetry
+    from incubator_mxnet_tpu import inspect as mxinspect
+    from incubator_mxnet_tpu import optimizer as opt_mod
+    from incubator_mxnet_tpu.gluon.contrib import FusedTrainStep
+
+    # -- train side: plan + measured high-water + leakcheck -------------
+    if quick:
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(8, 3, padding=1, layout="NHWC"),
+                gluon.nn.Flatten(), gluon.nn.Dense(10))
+        shape, n_classes, bs, iters = (8, 8, 3), 10, 8, 4
+    else:
+        net = _make_net("NHWC", model="resnet18")
+        shape, n_classes, bs, iters = (224, 224, 3), 1000, 32, 6
+    net.initialize()
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.np.array(np.random.uniform(
+        -1, 1, (bs,) + shape).astype(np.float32))
+    y = mx.np.array(np.random.randint(0, n_classes, (bs,)))
+    net(x)
+    opt = opt_mod.create("sgd", learning_rate=0.05, momentum=0.9)
+    step = FusedTrainStep(net, lambda n, a, b: loss_fn(n(a), b).mean(),
+                          opt, donate=True)
+    plan = mxinspect.memory_plan(step, x, y, name="fused_train")
+    step(x, y)                                 # compile outside the clock
+    tl = telemetry.StepTimeline(name="bench.memory")
+    measured_peak = mxinspect.live_bytes()
+    for _ in range(iters):
+        with tl.step():
+            step(x, y)
+        measured_peak = max(measured_peak, mxinspect.live_bytes())
+    leak = mxinspect.leakcheck(lambda: step(x, y), rounds=3,
+                               raise_on_leak=False)
+    timeline = tl.report()
+
+    # -- serve side: the carved KV slab ---------------------------------
+    cfg = serve.DecoderConfig(vocab=64, embed=32, layers=2, heads=2,
+                              head_dim=16, max_len=64)
+    decoder = serve.CachedDecoder(cfg)
+    engine = serve.ContinuousEngine(decoder, max_slots=8, decode_steps=2,
+                                    prefill_window=32).start()
+    try:
+        engine.generate([1, 2, 3], max_new_tokens=4)
+        serve_plans = engine.memory_plans()
+        slab_bytes = engine.pool.stats()["slab_bytes"]
+        census = mxinspect.census()
+    finally:
+        engine.close()
+
+    ratio = (round(plan["peak_bytes"] / measured_peak, 4)
+             if measured_peak and plan.get("peak_bytes") else 0.0)
+    return {
+        "train_peak_hbm_mb": round(measured_peak / 2**20, 3),
+        "serve_kv_slab_mb": round(slab_bytes / 2**20, 3),
+        "mem_plan_vs_measured_ratio": ratio,
+        "leakcheck_growth_mb": leak["growth_mb"],
+        "mem_train_plan_peak_mb": round(plan["peak_bytes"] / 2**20, 3),
+        "mem_train_plan_source": plan["source"],
+        "mem_train_alias_mb": round(plan.get("alias_size", 0) / 2**20, 3),
+        "mem_measured_source": "live_arrays",
+        "mem_timeline_peak_hbm_mb": round(
+            timeline["peak_hbm_bytes"] / 2**20, 3),
+        "mem_timeline_source": timeline["mem_source"],
+        "mem_serve_prefill_peak_mb": round(
+            serve_plans["prefill"]["peak_bytes"] / 2**20, 3),
+        "mem_serve_decode_peak_mb": round(
+            serve_plans["decode"]["peak_bytes"] / 2**20, 3),
+        "mem_census_tagged_fraction": census["tagged_fraction"],
+        "mem_leakcheck_leak": leak["leak"],
+    }
+
+
 def _phase_offenders(model="resnet18", batch_size=32):
     """Fusion-level roofline attribution of the compiled train step
     (mx.inspect): the ranked offender work-list for the kernel tier, and
@@ -934,6 +1029,7 @@ PHASES = [
     ("serve", _phase_serve),
     ("serve_continuous", _phase_serve_continuous),
     ("elastic", _phase_elastic),
+    ("memory", _phase_memory),
     ("offenders", _phase_offenders),
     ("fused_sweep", _phase_fused_sweep),
     ("calib", _phase_calib),
@@ -983,6 +1079,12 @@ def _phase_serve_continuous_quick():
     return _phase_serve_continuous(quick=True)
 
 
+def _phase_memory_quick():
+    # same keys, tiny net + tiny decoder: the tier-1 smoke exercises the
+    # plan/census/leakcheck path end to end without a ResNet compile
+    return _phase_memory(quick=True)
+
+
 QUICK_PHASES = {
     "dispatch": _phase_dispatch_quick,
     "train32": _phase_train32_quick,
@@ -991,6 +1093,7 @@ QUICK_PHASES = {
     "fused_sweep": _phase_fused_sweep_quick,
     "elastic": _phase_elastic_quick,
     "serve_continuous": _phase_serve_continuous_quick,
+    "memory": _phase_memory_quick,
 }
 
 # Per-phase subprocess timeouts, seconds. MXNET_BENCH_PHASE_TIMEOUT (one
@@ -998,7 +1101,8 @@ QUICK_PHASES = {
 PHASE_TIMEOUTS = {
     "dispatch": 300, "eager": 900, "train32": 1500, "train128": 1500,
     "infer": 900, "io": 700, "input_pipeline": 700, "serve": 700,
-    "serve_continuous": 900, "elastic": 700, "offenders": 700,
+    "serve_continuous": 900, "elastic": 700, "memory": 700,
+    "offenders": 700,
     "fused_sweep": 2000, "calib": 900, "xla_flops": 600,
 }
 PHASE_TIMEOUT_DEFAULT_S = 900

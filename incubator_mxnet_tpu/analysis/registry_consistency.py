@@ -30,6 +30,22 @@ Three symbol families, six rules:
     stats-key-untested  a stats key never appears in any tests/*.py —
                         nothing would notice the counter going dead
 
+  memory census owners (mx.inspect.memory) — owner strings are the
+  attribution surface a live-buffer census groups by, and like stats
+  keys they rot: a renamed subsystem with a stale doc row (or an
+  undocumented owner) makes an OOM dump unreadable. Code surface:
+  literal `owner="..."` keywords of `register(...)` calls and the first
+  arg of `mem.tag("...")` / `memory.tag("...")` context entries (flat
+  `[a-z0-9_]+` tokens by contract — dots would collide with the metric
+  namespace). Doc surface: the "Census owners" table in
+  docs/OBSERVABILITY.md (section-scoped so owner tokens never collide
+  with the metric catalog's dotted names).
+
+    mem-owner-undocumented  an owner string used in code is missing
+                            from the Census owners table
+    mem-owner-doc-stale     a Census owners row names an owner no code
+                            registers — stale docs fail the build
+
   telemetry metric names — the registered surface is (a) every
   `stats_group("family", {keys...})` adoption, contributing
   `family.key` names, and (b) every literal-named object metric:
@@ -65,7 +81,8 @@ RULES = ("env-undocumented", "env-doc-stale", "fault-point-unwired",
          "fault-point-unregistered", "fault-point-undocumented",
          "fault-doc-stale", "stats-key-untested",
          "telemetry-metric-undocumented", "telemetry-doc-stale",
-         "telemetry-metric-untested")
+         "telemetry-metric-untested",
+         "mem-owner-undocumented", "mem-owner-doc-stale")
 
 _ENV_RE = re.compile(r"MXNET_[A-Z0-9_]+")
 _STATS_NAME_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*_STATS$")
@@ -172,6 +189,59 @@ def _doc_points(doc_path):
 
 _METRIC_CTORS = {"counter", "gauge", "histogram"}
 _METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+_OWNER_NAME_RE = re.compile(r"^[a-z0-9_]+$")
+
+
+def _mem_owner_sites(modules):
+    """{owner: (relpath, line)} for literal census-owner strings: the
+    `owner=` keyword of any `register(...)`/`mem.register(...)` call,
+    and the first arg of `mem.tag(...)`/`memory.tag(...)` (the receiver
+    must mention "mem" — a bare `tag(...)` elsewhere is not an owner)."""
+    owners = {}
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if not cname:
+                continue
+            parts = cname.split(".")
+            lit = None
+            if parts[-1] == "register":
+                for kw in node.keywords:
+                    if kw.arg == "owner":
+                        lit = str_const(kw.value)
+            elif parts[-1] == "tag" and len(parts) >= 2 \
+                    and "mem" in parts[-2] and node.args:
+                lit = str_const(node.args[0])
+            if lit and _OWNER_NAME_RE.match(lit) and lit not in owners:
+                owners[lit] = (mod.relpath, node.lineno)
+    return owners
+
+
+def _doc_mem_owners(doc_path):
+    """{owner: line} from the "Census owners" table in OBSERVABILITY.md —
+    SECTION-scoped (rows between the heading containing "Census owners"
+    and the next heading), so flat owner tokens can never be confused
+    with the dotted metric catalog."""
+    doc = {}
+    if not os.path.exists(doc_path):
+        return doc
+    in_section = False
+    with open(doc_path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            stripped = line.strip()
+            if stripped.startswith("#"):
+                in_section = "census owners" in stripped.lower()
+                continue
+            if not in_section or not stripped.startswith("|"):
+                continue
+            first_cell = stripped.split("|")[1] if "|" in stripped[1:] \
+                else ""
+            for m in re.finditer(r"`([a-z0-9_]+)`", first_cell):
+                if _OWNER_NAME_RE.match(m.group(1)):
+                    doc.setdefault(m.group(1), i)
+    return doc
 
 
 def _stats_value_dict(value):
@@ -375,4 +445,25 @@ def run(modules, root,
                 f"telemetry metric `{name}` never appears (as a dotted "
                 f"literal) in any test — nothing notices it going dead",
                 scope="telemetry", symbol=name))
+
+    # ---- memory census owners (mx.inspect.memory) ---------------------
+    owner_sites = _mem_owner_sites(modules)
+    doc_owners = _doc_mem_owners(obs_doc_path)
+    if owner_sites or doc_owners:
+        for owner, (relpath, line) in sorted(owner_sites.items()):
+            if owner not in doc_owners:
+                findings.append(Finding(
+                    "mem-owner-undocumented", relpath, line,
+                    f"census owner `{owner}` is registered here but "
+                    f"missing from the {obs_doc} Census owners table — "
+                    f"an OOM dump naming it would be unreadable",
+                    scope="mem-owner", symbol=owner))
+        for owner, line in sorted(doc_owners.items()):
+            if owner not in owner_sites:
+                findings.append(Finding(
+                    "mem-owner-doc-stale", obs_doc, line,
+                    f"{obs_doc} Census owners table lists `{owner}` "
+                    f"which no code registers — delete the row or "
+                    f"restore the registration",
+                    scope="doc", symbol=owner))
     return findings

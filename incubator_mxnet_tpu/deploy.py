@@ -732,9 +732,15 @@ def _capi_device_count(kind):
 
 
 def _capi_memory_info(_dev_id):
+    # (used, limit) per the C API contract. device_memory_info returns a
+    # MemoryInfo namedtuple — the old code treated it as a dict (a latent
+    # AttributeError) and a backend without bytes_limit now reads as an
+    # explicit (0, 0) don't-know instead of fake zero headroom.
     from .device import device_memory_info
     info = device_memory_info()
-    return int(info.get("bytes_in_use", 0)), int(info.get("bytes_limit", 0))
+    if not info.known:
+        return 0, 0
+    return int(info.total - info.free), int(info.total)
 
 
 def _capi_is_numpy_shape():

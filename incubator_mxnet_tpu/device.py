@@ -10,12 +10,14 @@ run unmodified (BASELINE.json north star: "mx.tpu() contexts").
 from __future__ import annotations
 
 import threading
+from typing import NamedTuple
 
 from .base import MXNetError, get_env
 
 __all__ = [
     "Device", "Context", "cpu", "tpu", "gpu", "current_device", "current_context",
-    "num_gpus", "num_tpus", "device_memory_info", "gpu_memory_info",
+    "num_gpus", "num_tpus", "MemoryInfo", "device_memory_info",
+    "gpu_memory_info",
 ]
 
 _state = threading.local()
@@ -142,13 +144,40 @@ def num_gpus():
     return num_tpus()
 
 
+class MemoryInfo(NamedTuple):
+    """`device_memory_info` result: the reference's `(free, total)` plus
+    the `known` flag. CPU backends and some PJRT builds expose no
+    `bytes_limit`, and before this flag `(0, 0)` was indistinguishable
+    from "zero headroom" — a capacity-planning caller (deploy sizing,
+    the OOM dump) MUST branch on `known` before trusting the numbers.
+
+    DELIBERATE API break (ISSUE 15 satellite): `info[0]`/`info[1]` and
+    attribute access keep working, but the tuple now iterates THREE
+    elements, so `free, total = device_memory_info()` raises — exactly
+    the call sites that were silently trusting no-data zeros and must be
+    rewritten to consult `known` (the in-repo one, deploy's C-API shim,
+    was)."""
+
+    free: int
+    total: int
+    known: bool
+
+
 def device_memory_info(device_id=0):
-    """(free, total) bytes on the accelerator (≙ mx.context.gpu_memory_info)."""
+    """Free/total accelerator memory with a typed don't-know sentinel
+    (≙ mx.context.gpu_memory_info): `MemoryInfo(free, total, known)`.
+    `known=False` (free=total=0) means the backend reports no
+    `bytes_limit` — NO DATA, not an exhausted device."""
     dev = tpu(device_id).jax_device
-    stats = dev.memory_stats() or {}
-    total = stats.get("bytes_limit", 0)
-    used = stats.get("bytes_in_use", 0)
-    return (total - used, total)
+    try:
+        stats = dev.memory_stats() or {}
+    except Exception:
+        stats = {}
+    total = stats.get("bytes_limit")
+    if not total:
+        return MemoryInfo(0, 0, False)
+    used = int(stats.get("bytes_in_use", 0))
+    return MemoryInfo(int(total) - used, int(total), True)
 
 
 gpu_memory_info = device_memory_info

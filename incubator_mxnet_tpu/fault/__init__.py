@@ -640,11 +640,14 @@ def run_resilient(step_fn, state, ckpt_dir, num_steps, *, ckpt_every=10,
     uninterrupted run would have made. Returns a ResilientRun.
     """
     from .. import checkpoint as ckpt
-    from ..telemetry import install_crash_hooks, span as _span
+    from ..telemetry import (install_crash_hooks, mem_install_oom_hook,
+                             mem_on_oom, span as _span)
 
     # a resilient run should always leave a black box (hooks are no-ops
-    # unless MXNET_FLIGHTREC_DIR is set)
+    # unless MXNET_FLIGHTREC_DIR is set) — including the memory one: an
+    # uncaught RESOURCE_EXHAUSTED dumps census + plans on the way down
     install_crash_hooks()
+    mem_install_oom_hook()
     run = ResilientRun()
     entry = ckpt.latest_entry(ckpt_dir)
     if entry is not None:
@@ -699,7 +702,14 @@ def run_resilient(step_fn, state, ckpt_dir, num_steps, *, ckpt_every=10,
         with _span("resilient.step", step=step):
             with watchdog(watchdog_seconds):
                 inject("resilient.step")
-                return step_fn(state, step)
+                try:
+                    return step_fn(state, step)
+                except BaseException as e:
+                    # an OOM-shaped failure leaves the memory black box
+                    # (census + plans) before the retry/raise machinery
+                    # sees it; no-op (and exception-proof) otherwise
+                    mem_on_oom(e, where="resilient.step")
+                    raise
 
     run_step = retrying(max_attempts=max_step_retries + 1,
                         backoff=retry_backoff, retry_on=tuple(retry_on),

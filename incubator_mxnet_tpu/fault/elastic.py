@@ -348,7 +348,24 @@ class ElasticTrainer:
             lambda: _kv.allgather_buckets(shards, metas, self.jax_mesh,
                                           axis=self.axis,
                                           bucket_bytes=self.bucket_bytes))
-        return dict(zip(names, outs))
+        params = dict(zip(names, outs))
+        # census attribution (mx.inspect.memory): the replicated working
+        # params are the third leg of the elastic resident set next to
+        # the optimizer_shards the ShardedOptimizer registers
+        try:
+            from ..inspect import memory as _mem
+            _mem.register(params, owner="elastic_params")
+        except Exception:
+            pass
+        return params
+
+    def memory_plans(self):
+        """Memory plans of the cached bucketed reduce-scatter/all-gather
+        programs this trainer's steps dispatch
+        (`mx.inspect.memory.collective_memory_plans`): run at least one
+        step first so the programs exist."""
+        from ..inspect.memory import collective_memory_plans
+        return collective_memory_plans()
 
     # ------------------------------------------------------------------
     def _stage_batch(self, batch):
@@ -707,11 +724,14 @@ def run_elastic(loss_fn, params, batch_fn, ckpt_dir, num_steps, *,
         protocol. Returns an ElasticRun.
     """
     from .. import checkpoint as ckpt
-    from ..telemetry import install_crash_hooks, span as _span
+    from ..telemetry import (install_crash_hooks, mem_install_oom_hook,
+                             mem_on_oom, span as _span)
 
     # an elastic run should always leave a black box (hooks are no-ops
-    # unless MXNET_FLIGHTREC_DIR is set)
+    # unless MXNET_FLIGHTREC_DIR is set) — the memory one included: an
+    # uncaught RESOURCE_EXHAUSTED dumps census + plans on the way down
     install_crash_hooks()
+    mem_install_oom_hook()
     run = ElasticRun()
     shrink_to = shrink_to or (lambda d: d // 2)
     kw = dict(collective_timeout=collective_timeout,
@@ -793,6 +813,13 @@ def run_elastic(loss_fn, params, batch_fn, ckpt_dir, num_steps, *,
             run.shrinks += 1
             run.dp_history.append(target)
             continue    # retry the SAME step on the smaller mesh
+        except BaseException as e:
+            # not a worker loss: before the error unwinds, an OOM-shaped
+            # failure (RESOURCE_EXHAUSTED mid-step) leaves the memory
+            # black box naming the top owners (no-op, and
+            # exception-proof, for every other error)
+            mem_on_oom(e, where="elastic.step")
+            raise
         step += 1
         if step % ckpt_every == 0 or step == num_steps:
             save_retrying(step)

@@ -497,5 +497,15 @@ class FusedTrainStep:
             _state_restore(self._states[k], new_s[k])
         for i, buf in zip(self._meta["aux_idx"], aux_bufs):
             self._params[i].data()._set_arr(buf)
+        # census attribution (mx.inspect.memory): the donated update
+        # produced FRESH weight/state buffers — re-attribute them so a
+        # live-buffer census names the training state (a weakref-dict
+        # write per buffer; must never break the step)
+        try:
+            from ...inspect import memory as _mem
+            _mem.register((new_w, new_s, list(aux_bufs)),
+                          owner="train_step")
+        except Exception:
+            pass
         out = (_wrap(loss),) + tuple(_wrap(e) for e in extras)
         return out if len(out) > 1 else out[0]

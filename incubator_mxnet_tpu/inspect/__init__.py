@@ -26,6 +26,12 @@ from .roofline import (analyze_compiled, analyze_module, callable_cost,
                        kernel_units, load_calibration, unit_cost)
 from .report import (inspect_step, inspect_compiled, inspect_hlo_text,
                      render_markdown, lower_any, class_name, dump_json)
+from .memory import (memory_plan, plan_from_compiled, assert_donation,
+                     collective_memory_plans, active_plans, note_plan,
+                     tag, register, current_tag, census, census_diff,
+                     leakcheck, live_bytes, MemoryLeakError,
+                     is_oom_error, on_oom, oom_report, dump_oom,
+                     install_oom_hook)
 
 __all__ = [
     "HloInstruction", "HloComputation", "HloModule", "parse_module",
@@ -35,4 +41,10 @@ __all__ = [
     "load_calibration", "unit_cost",
     "inspect_step", "inspect_compiled", "inspect_hlo_text",
     "render_markdown", "lower_any", "class_name", "dump_json",
+    "memory_plan", "plan_from_compiled", "assert_donation",
+    "collective_memory_plans", "active_plans", "note_plan",
+    "tag", "register", "current_tag", "census", "census_diff",
+    "leakcheck", "live_bytes", "MemoryLeakError",
+    "is_oom_error", "on_oom", "oom_report", "dump_oom",
+    "install_oom_hook",
 ]

@@ -135,6 +135,11 @@ def inspect_compiled(compiled, name="step", top_k=None, calib=None,
         records, totals, _module = _roofline.analyze_compiled(
             compiled, calib=calib)
         ca = _roofline.cost_analysis_summary(compiled)
+        # the memory side of the same program, right next to the roofline
+        # ranking: predicted peak HBM + argument/output/temp/alias split
+        # (inspect/memory.py; degrades per its own contract, never raises)
+        from . import memory as _memory
+        memplan = _memory.plan_from_compiled(compiled, name=name)
     # degradation contract: no byte estimates anywhere (shape parse failed
     # AND cost analysis silent) -> flops-only ranking, flagged, no crash
     have_bytes = totals["bytes"] > 0 or ca["bytes_estimated"]
@@ -157,6 +162,7 @@ def inspect_compiled(compiled, name="step", top_k=None, calib=None,
         },
         "totals": totals,
         "cost_analysis": ca,
+        "memory": memplan,
         "offenders": records[:top_k],
         "n_groups": len(groups),
         "offender_groups": groups[:top_k],

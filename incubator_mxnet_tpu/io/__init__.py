@@ -851,6 +851,13 @@ class ImageRecordIter(DataIter):
             dev = maybe_device_put(_np.array(images_view))
             _bump_io("alias_copies")
         from ..ndarray import _wrap
+        # census attribution (mx.inspect.memory): the staged image batch
+        # is the decode pipeline's device-resident set
+        try:
+            from ..inspect import memory as _mem
+            _mem.register(dev, owner="imagerec_slots")
+        except Exception:
+            pass
         if self._device_augment:
             data = self._augment_on_device(_wrap(dev), cursor)
         else:

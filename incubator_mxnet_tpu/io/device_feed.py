@@ -417,6 +417,14 @@ class DeviceFeed:
         if not isinstance(raw, (jax.Array, _np.ndarray, _np.generic)):
             return x                       # scalars/strings pass through
         out = maybe_device_put(raw, self._leaf_sharding(raw.ndim))
+        # census attribution (mx.inspect.memory): in-flight staged
+        # batches are the feed's resident set (depth x batch bytes) —
+        # a weakref-registry write per leaf, never able to break staging
+        try:
+            from ..inspect import memory as _mem
+            _mem.register(out, owner="device_feed")
+        except Exception:
+            pass
         return _wrap(out)
 
     def _leaf_sharding(self, ndim):

@@ -134,6 +134,25 @@ def _coll_fn(kind, jmesh, axis, sig, scale, build):
     return fn
 
 
+def collective_compiled_surfaces():
+    """Inspection snapshot of the cached bucketed-collective programs:
+    ``[{"kind", "axis", "fn", "avals"}]`` — the jitted shard_map program
+    plus abstract ``jax.ShapeDtypeStruct`` args reconstructed from the
+    cache key's signature, so `mx.inspect.memory.collective_memory_plans`
+    can lower each program for a memory plan without touching live
+    gradient/shard buffers (lowering at the same avals hits the same jit
+    cache entry — no extra compile, no retrace)."""
+    import jax
+    out = []
+    with _COLL_FN_LOCK:
+        entries = list(_COLL_FN_CACHE.items())
+    for (kind, _mid, axis, sig, _scale), (_jmesh, fn) in entries:
+        avals = tuple(jax.ShapeDtypeStruct(tuple(item[0]), item[1])
+                      for item in sig)
+        out.append({"kind": kind, "axis": axis, "fn": fn, "avals": avals})
+    return out
+
+
 def _bucketize(raws, bytes_of_idx, bucket_bytes):
     """Greedy ~bucket_bytes buckets of indices into `raws`,
     dtype-segregated, order-preserving within dtype (≙ the kvstore_dist

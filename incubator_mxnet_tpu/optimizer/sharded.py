@@ -37,6 +37,17 @@ __all__ = ["ShardedOptimizer", "shard_len", "to_shards", "from_shards",
            "repartition", "state_layout", "layout_spec_tree"]
 
 
+def _mem_register(tree):
+    """Census attribution (mx.inspect.memory): master/moment shards are
+    the ZeRO trainer's resident set. Must never break the optimizer."""
+    try:
+        from ..inspect import memory as _mem
+        _mem.register(tree, owner="optimizer_shards")
+    except Exception:
+        pass
+    return tree
+
+
 def shard_len(numel, dp):
     """Per-rank shard length: ceil(numel / dp) (the tail rank is padded)."""
     if dp < 1:
@@ -138,7 +149,7 @@ class ShardedOptimizer:
         if a.ndim != 2 or a.shape[0] != self.dp:
             raise MXNetError(f"expected a ({self.dp}, L) shard view, got "
                              f"{a.shape}")
-        return jax.device_put(a, self._sharding())
+        return _mem_register(jax.device_put(a, self._sharding()))
 
     def shard_params(self, params):
         """params: dict name -> array. Returns (wshard, meta): the sharded
@@ -171,7 +182,7 @@ class ShardedOptimizer:
         # create_state returns NDArrays; keep raw sharded jax buffers
         import jax
         raw = st._arr if hasattr(st, "_arr") else _np.asarray(st)
-        return jax.device_put(raw, self._sharding())
+        return _mem_register(jax.device_put(raw, self._sharding()))
 
     # ------------------------------------------------------------------
     def mem_per_replica_bytes(self, wshard, states):
@@ -246,6 +257,10 @@ class ShardedOptimizer:
         new_trees = jtu.tree_unflatten(sdef, list(new_leaves))
         new_states = {n: self._tuplify(t)
                       for n, t in zip(names, new_trees)}
+        # the donated update produced FRESH buffers — re-attribute them
+        # (the old entries die with the donated arrays)
+        _mem_register(new_wshard)
+        _mem_register(new_states)
         return new_wshard, new_states
 
     @staticmethod

@@ -360,6 +360,37 @@ def dump_storage_profile(filename="memory.prof", backend=None):
     return filename
 
 
+def read_memory_sample(device=None):
+    """ONE memory reading with an honest provenance stamp:
+    `(bytes_in_use, source)`.
+
+    `source == "device"`: PJRT `memory_stats()["bytes_in_use"]` — real
+    accelerator HBM. `source == "host_rss"`: the CPU backend (and some
+    PJRT builds) expose no memory stats, so the fallback is process RSS
+    from `/proc/self/statm` — a HOST number that still moves with
+    allocations, making the timeline lane meaningful on CI instead of a
+    flat 0. `source == "unavailable"`: neither worked (bytes is 0).
+
+    Shared by `MemoryMonitor`, `telemetry.StepTimeline`'s
+    `peak_hbm_bytes` lane, and the OOM dump — one reader, one stamp."""
+    try:
+        import jax
+        dev = device or jax.devices()[0]
+        stats = getattr(dev, "memory_stats", lambda: None)()
+        if stats and stats.get("bytes_in_use") is not None:
+            return int(stats["bytes_in_use"]), "device"
+    except Exception:
+        pass
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        import resource
+        page = resource.getpagesize()
+        return rss_pages * page, "host_rss"
+    except Exception:
+        return 0, "unavailable"
+
+
 class MemoryMonitor:
     """Sampled device-memory timeline (≙ the storage profiler's
     MemoryManagerProfiler lane). Each sample lands in the Chrome trace as a
@@ -368,22 +399,38 @@ class MemoryMonitor:
 
         with profiler.MemoryMonitor(interval=0.01):
             train()
+
+    Samples are `(ts_us, bytes, source)`; `source` is "device" on real
+    accelerators and "host_rss" where `memory_stats()` is unavailable
+    (CPU backends) — process RSS instead of a silently meaningless flat 0
+    (the counter events carry the same stamp). Default interval:
+    `MXNET_MEM_SAMPLE_INTERVAL`.
     """
 
-    def __init__(self, interval=0.05, device=None):
+    def __init__(self, interval=None, device=None):
+        if interval is None:
+            interval = get_env("MXNET_MEM_SAMPLE_INTERVAL", 0.05,
+                               typ=float)
         self.interval = float(interval)
         self.device = device
-        self.samples = []          # (ts_us, bytes_in_use)
+        self.samples = []          # (ts_us, bytes_in_use, source)
+        self.source = None         # stamp of the most recent sample
         self._stop = None
         self._thread = None
 
     def _read(self):
-        import jax
-        dev = self.device or jax.devices()[0]
-        stats = getattr(dev, "memory_stats", lambda: None)()
-        if stats:
-            return int(stats.get("bytes_in_use", 0))
-        return 0
+        b, source = read_memory_sample(self.device)
+        # handoff ordered by Thread start/join like samples (see __enter__)
+        self.source = source  # mxlint: disable=lock-shared-mutation
+        # feed the process-wide mem.peak_hbm_bytes high-water — the
+        # cataloged gauge covers MemoryMonitor AND StepTimeline samples,
+        # so a monitor-only loop must move it too
+        try:
+            from .telemetry.steptrace import _note_memory_sample
+            _note_memory_sample(b)
+        except Exception:
+            pass
+        return b, source
 
     def __enter__(self):
         import threading
@@ -393,7 +440,8 @@ class MemoryMonitor:
 
         def loop():
             while not self._stop.is_set():
-                self.samples.append((_now_us(), self._read()))  # mxlint: disable=lock-shared-mutation
+                b, source = self._read()
+                self.samples.append((_now_us(), b, source))  # mxlint: disable=lock-shared-mutation
                 self._stop.wait(self.interval)
 
         self._thread = threading.Thread(target=loop, daemon=True)
@@ -408,14 +456,14 @@ class MemoryMonitor:
         # the user explicitly asked for this lane by entering the context,
         # whether or not the op profiler is also running
         with _lock:
-            for ts, b in self.samples:
+            for ts, b, source in self.samples:
                 _events.append({
                     "name": "device_memory", "cat": "storage", "ph": "C",
                     "ts": ts, "pid": 0,
                     "tid": _threading.get_ident() % 100000,
-                    "args": {"bytes_in_use": b},
+                    "args": {"bytes_in_use": b, "source": source},
                 })
 
     @property
     def peak_bytes(self):
-        return max((b for _, b in self.samples), default=0)
+        return max((b for _, b, _src in self.samples), default=0)

@@ -36,7 +36,8 @@ import numpy as _np
 from ..base import MXNetError, get_env
 from ..deploy import _np_dtype
 from .. import fault as _fault
-from ..telemetry import record_span, trace as _trace
+from ..telemetry import (record_span, trace as _trace, mem_on_oom,
+                         mem_install_oom_hook)
 from .metrics import ServeMetrics, SERVE_STATS
 
 
@@ -315,8 +316,10 @@ class Server:
                     "metrics endpoint on port %s unavailable (%s); "
                     "serving continues without /metrics", port, e)
         # flight-recorder crash hooks (no-ops unless MXNET_FLIGHTREC_DIR):
-        # a served process should always leave a black box
+        # a served process should always leave a black box — and an
+        # uncaught RESOURCE_EXHAUSTED should leave the memory one too
         _trace.install_crash_hooks()
+        mem_install_oom_hook()
         self._started = True
         self._thread.start()
         return self
@@ -562,6 +565,11 @@ class Server:
                     f"off, refusing to reply with pad-contaminated data")
             outs = tuple(o[:n] for o in outs)
         except BaseException as e:
+            # RESOURCE_EXHAUSTED gets the OOM black box (census + plans +
+            # flightrec ring) before the batch is failed (crash-path
+            # helper: never raises — the import is guarded too, so a
+            # teardown-time failure can never displace the real error)
+            mem_on_oom(e, where="serve.batch")
             self.metrics.count("errors", n)
             err = e if isinstance(e, MXNetError) else ServeError(
                 f"batch execution failed: {type(e).__name__}: {e}")

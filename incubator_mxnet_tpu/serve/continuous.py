@@ -61,7 +61,8 @@ import numpy as _np
 
 from ..base import MXNetError, get_env
 from .. import fault as _fault
-from ..telemetry import record_span, trace as _trace
+from ..telemetry import (record_span, trace as _trace, mem_on_oom,
+                         mem_install_oom_hook)
 from .batcher import (ServeError, QueueFullError, RequestTimeout,
                       ServerClosed, _fail, _profiler_on)
 from .metrics import SERVE_STATS, _STATS_LOCK, percentile
@@ -288,6 +289,13 @@ class CachedDecoder:
         self.config = config
         self.params = params if params is not None \
             else init_decoder_params(config, seed)
+        # census attribution (mx.inspect.memory): the decoder weights are
+        # serving's second-biggest resident set after the KV slabs
+        try:
+            from ..inspect import memory as _mem
+            _mem.register(self.params, owner="decoder_params")
+        except Exception:
+            pass
         # programs keyed by their trace-time constants (prefill window /
         # decode scan length + eos), each its own jit: built once per
         # engine at construction — steady state replays, never re-builds
@@ -533,6 +541,7 @@ class ContinuousEngine:
             self._started = True
         self.warmup_s = round(time.perf_counter() - t0, 3)
         _trace.install_crash_hooks()
+        mem_install_oom_hook()
         self._thread.start()
         return self
 
@@ -669,6 +678,38 @@ class ContinuousEngine:
                 f"— a shape leaked into the compiled step")
         return r
 
+    def memory_plans(self):
+        """Predicted device-memory plans of the TWO compiled step
+        programs (`mx.inspect.memory.memory_plan` over the prefill and
+        decode jits, lowered at the exact warmup shapes via abstract
+        avals — no buffers touched, no extra compile in steady state:
+        the lowering hits the same jit cache entry the engine replays).
+        The KV slab dominates both plans' argument size and is donated,
+        so `alias_size` covering ~2x the slab is the zero-copy-update
+        evidence."""
+        import jax
+        import jax.tree_util as jtu
+        from ..inspect.memory import memory_plan
+
+        def aval(shape, dtype="int32"):
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+        params_avals = jtu.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            self.model.params)
+        pool_aval = jax.ShapeDtypeStruct(self.pool.shape, self.pool.dtype)
+        P, S, W = self.prefill_lanes, self.max_slots, self.prefill_window
+        prefill = self._prefill_prog.lower(
+            params_avals, pool_aval, pool_aval, aval((P, W)), aval((P,)),
+            aval((P,)))
+        decode = self._decode_prog.lower(
+            params_avals, pool_aval, pool_aval, aval((S,)), aval((S,)),
+            aval((S,)))
+        return {
+            "prefill": memory_plan(prefill, name=f"{self.name}.prefill"),
+            "decode": memory_plan(decode, name=f"{self.name}.decode"),
+        }
+
     def stats(self):
         """Plain-data snapshot: counters, slot occupancy, TTFT/TPOT
         percentiles, decode tokens/s, and the zero-retrace observables."""
@@ -743,7 +784,11 @@ class ContinuousEngine:
             except BaseException as e:
                 # a step failure fails the IN-FLIGHT requests, frees
                 # their slots, and the engine keeps serving (the PR-3
-                # batch-error contract)
+                # batch-error contract). A RESOURCE_EXHAUSTED step
+                # additionally leaves the OOM black box (census + plans
+                # + flightrec ring) BEFORE the slab reallocation below
+                # rewrites the memory picture.
+                mem_on_oom(e, where="serve.continuous")
                 err = e if isinstance(e, MXNetError) else ServeError(
                     f"engine step failed: {type(e).__name__}: {e}")
                 with self._cv:

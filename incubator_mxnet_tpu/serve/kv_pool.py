@@ -35,7 +35,7 @@ from __future__ import annotations
 import threading
 
 from ..base import get_env
-from ..telemetry.registry import stats_group as _stats_group
+from ..telemetry.registry import REGISTRY, stats_group as _stats_group
 from .batcher import ServeError
 
 __all__ = ["SlotsFullError", "KVCachePool", "KVPOOL_STATS", "kvpool_stats"]
@@ -65,6 +65,29 @@ def kvpool_stats(reset=False):
     """Process-wide KV-pool counter snapshot (atomic with the optional
     reset, the serve_stats() contract)."""
     return KVPOOL_STATS.snapshot(reset=reset)
+
+
+# the single biggest planned allocation in serving, previously invisible:
+# set at every carve (allocate/reallocate/poison) so dashboards and the
+# memory bench see the slab without holding a pool reference. Level, not
+# flow — survives snapshot(reset=True). With several pools alive it holds
+# the most recent carve; per-pool numbers live on pool.stats().
+_SLAB_GAUGE = REGISTRY.gauge(
+    "kvpool.slab_bytes",
+    help="bytes of the most recently carved KV slab pair (k+v, incl. "
+         "the garbage row)")
+
+
+def _note_slab(pool):
+    """Stamp the gauge and attribute the slab buffers to the census
+    owner `kv_pool` (mx.inspect.memory). Attribution must never be able
+    to break serving — failures are swallowed."""
+    try:
+        _SLAB_GAUGE.set(pool.nbytes())
+        from ..inspect import memory as _mem
+        _mem.register((pool.k, pool.v), owner="kv_pool")
+    except Exception:
+        pass
 
 
 class KVCachePool:
@@ -125,6 +148,7 @@ class KVCachePool:
         import jax.numpy as jnp
         self.k = jnp.zeros(self.shape, dtype=self.dtype)
         self.v = jnp.zeros(self.shape, dtype=self.dtype)
+        _note_slab(self)
 
     def reallocate(self):
         """Replace the slab with fresh zeroed buffers. The engine's
@@ -151,6 +175,7 @@ class KVCachePool:
         """Install the step program's output buffers (the donated-update
         swap idiom: the old arrays were consumed by donation)."""
         self.k, self.v = k, v
+        _note_slab(self)
 
     def poison(self, value=1e9):
         """Overwrite the WHOLE slab with a sentinel. Test hook for the
@@ -160,6 +185,7 @@ class KVCachePool:
         import jax.numpy as jnp
         self.k = jnp.full(self.shape, value, dtype=self.dtype)
         self.v = jnp.full(self.shape, value, dtype=self.dtype)
+        _note_slab(self)
 
     # -- slot bookkeeping --------------------------------------------------
     def claim(self):

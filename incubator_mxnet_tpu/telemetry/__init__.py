@@ -53,7 +53,7 @@ __all__ = [
     "span", "current_span", "record_span", "StepTimeline", "model_flops",
     "block_fwd_flops", "cost_flops", "device_peak_flops",
     "metrics_text", "scalar_snapshot", "start_metrics_server",
-    "ensure_metrics_server",
+    "ensure_metrics_server", "mem_on_oom", "mem_install_oom_hook",
     "trace", "TraceContext", "current_context", "attach", "detach",
     "attached", "new_context", "child_context", "flightrec_record",
     "flightrec_dump", "flightrec_maybe_dump", "flightrec_events",
@@ -75,6 +75,31 @@ _register_env("MXNET_BENCH_PHASE_TIMEOUT", float, None,
 _register_env("MXNET_BENCH_FAULT_PHASE", str, None,
               "Deterministic bench-phase crash injection: "
               "'<phase>[:dtype|hang|exit]'")
+
+
+def mem_on_oom(error, where=""):
+    """Crash-path-safe proxy to `inspect.memory.on_oom`: the ONE shared
+    wrapper every driver (run_resilient / run_elastic / serve batcher /
+    continuous engine) calls from its exception path. Guards the IMPORT
+    too — a failure to load the inspect package (interpreter teardown,
+    broken install) must never replace the original error on a crash
+    path. Returns the dump path or None; never raises."""
+    try:
+        from ..inspect.memory import on_oom
+        return on_oom(error, where=where)
+    except Exception:
+        return None
+
+
+def mem_install_oom_hook():
+    """Crash-path-safe proxy to `inspect.memory.install_oom_hook` (the
+    sys.excepthook chain for uncaught OOMs), armed next to
+    `install_crash_hooks` by the same drivers. Never raises."""
+    try:
+        from ..inspect.memory import install_oom_hook
+        install_oom_hook()
+    except Exception:
+        pass
 
 
 def metrics_text():

@@ -55,6 +55,22 @@ SPAN_COUNT = REGISTRY.counter(
     "span.count", help="telemetry.span completions by span name",
     labels=("name",))
 
+# process-wide memory high-water over every StepTimeline/MemoryMonitor
+# sample (mx.inspect.memory catalog); the python cell avoids a locked
+# gauge read per step
+MEM_PEAK = REGISTRY.gauge(
+    "mem.peak_hbm_bytes", help="high-water bytes_in_use across every "
+    "step-timeline / memory-monitor sample this process took "
+    "(source per profiler.read_memory_sample: device HBM, or host RSS "
+    "on backends without memory_stats)")
+_mem_peak_seen = [0]
+
+
+def _note_memory_sample(b):
+    if b > _mem_peak_seen[0]:
+        _mem_peak_seen[0] = b
+        MEM_PEAK.set(b)
+
 _enabled = _trace.enabled
 
 
@@ -287,6 +303,11 @@ class StepTimeline:
                            else device_peak_flops())
         self.steps = 0
         self.step_time_us = 0.0
+        # memory lane: per-step-exit bytes_in_use samples, high-water
+        # over the loop window (profiler.read_memory_sample provenance:
+        # "device" on accelerators, "host_rss" on CPU backends)
+        self.peak_hbm_bytes = 0
+        self.mem_source = None
         self.deltas = {"data_stall_us": 0.0, "h2d_stage_us": 0.0,
                        "allreduce_us": 0.0, "host_transfers": 0,
                        "allreduce_buckets": 0,
@@ -327,6 +348,17 @@ class StepTimeline:
             after = _stall_counters()
             for k in tl.deltas:
                 tl.deltas[k] = after[k] - tl._base[k]
+            # memory lane: one cheap sample per step exit (PJRT
+            # memory_stats / one /proc read) — the loop high-water folds
+            # into the report AND the process-wide mem.peak_hbm_bytes
+            try:
+                b, source = profiler.read_memory_sample()
+                if b > tl.peak_hbm_bytes:
+                    tl.peak_hbm_bytes = b
+                tl.mem_source = source
+                _note_memory_sample(b)
+            except Exception:
+                pass
             return False
 
     def step(self):
@@ -369,6 +401,8 @@ class StepTimeline:
             "stall_pct": round(100.0 * stall / total, 2) if total else 0.0,
             "compute_pct": round(100.0 * compute / total, 2) if total
             else 0.0,
+            "peak_hbm_bytes": int(self.peak_hbm_bytes),
+            "mem_source": self.mem_source,
         }
         if self.flops_per_step and total > 0:
             achieved = self.flops_per_step * self.steps / (total * 1e-6)

@@ -42,3 +42,22 @@ TELE_STATS = stats_group("tele", {"good": 0, "lonely": 0})
 def g():
     counter("tele.obj_documented")
     counter("tele.obj_untested")     # documented, never in tests
+
+
+class _mem:
+    """Stands in for mx.inspect.memory (never imported — parsed only)."""
+
+    @staticmethod
+    def register(tree, owner=None):
+        return tree
+
+    @staticmethod
+    def tag(owner):
+        return owner
+
+
+def h():
+    _mem.register([], owner="fixture_owner_good")
+    _mem.register([], owner="fixture_owner_secret")   # mem-owner-undocumented
+    with _mem.tag("fixture_tag_owner"):
+        _mem.register([])

@@ -237,6 +237,16 @@ def test_registry_consistency_fixture_findings():
     assert {f.symbol for f in by["telemetry-doc-stale"]} == {"tele.ghost"}
     assert {f.symbol for f in by["telemetry-metric-untested"]} == \
         {"tele.obj_untested"}
+    # memory census owners (mx.inspect.memory): literal owner= keywords
+    # and mem.tag(...) first args vs the section-scoped "Census owners"
+    # table, both directions — flat tokens never collide with the dotted
+    # metric catalog above
+    assert {f.symbol for f in by["mem-owner-undocumented"]} == \
+        {"fixture_owner_secret"}
+    assert {f.symbol for f in by["mem-owner-doc-stale"]} == \
+        {"fixture_owner_ghost"}
+    assert "fixture_tag_owner" not in {
+        f.symbol for f in by["mem-owner-undocumented"]}
 
 
 def test_stats_group_adoption_still_yields_stats_keys():

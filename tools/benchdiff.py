@@ -89,6 +89,26 @@ TREND_KEYS = {
     # must not grow
     "serve_decode_tokens_per_sec": "higher",
     "serve_ttft_p99_ms": "lower",
+    # memory phase (PR 15, mx.inspect.memory): the train step's measured
+    # live-buffer high-water and the carved KV slab must not creep up;
+    # the plan/measured ratio gates plan-quality drift (a plan ballooning
+    # relative to what actually lives is a prediction regression); the
+    # leakcheck growth of the real train loop must stay ~0 (a FLOOR
+    # metric — gated on absolute delta via ABS_THRESHOLDS below, so the
+    # healthy 0.0 baseline cannot dead-arm the gate)
+    "train_peak_hbm_mb": "lower",
+    "serve_kv_slab_mb": "lower",
+    "mem_plan_vs_measured_ratio": "lower",
+    "leakcheck_growth_mb": "lower",
+}
+
+# floor metrics whose healthy committed baseline IS 0 (a ratio threshold
+# against a zero old value is meaningless and the `a <= 0` skip would
+# make the gate dead on arrival): compared on ABSOLUTE delta instead —
+# regression when `new` worsens by more than this many units past `old`,
+# whatever `old` was.
+ABS_THRESHOLDS = {
+    "leakcheck_growth_mb": 1.0,     # a real leak is tens of MB/round
 }
 
 DEFAULT_THRESHOLD = 0.10
@@ -153,6 +173,21 @@ def compare(old, new, threshold=DEFAULT_THRESHOLD):
         a, b = old.get(key), new.get(key)
         if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
             continue
+        abs_thr = ABS_THRESHOLDS.get(key)
+        if abs_thr is not None:
+            # floor metric: absolute delta, valid from a zero baseline
+            compared += 1
+            worse_abs = (b - a) if direction == "lower" else (a - b)
+            row = {"key": key, "old": a, "new": b,
+                   "change_abs": round(b - a, 4),
+                   "change_pct": round((b - a) / a * 100.0, 2) if a > 0
+                   else None,
+                   "direction": direction}
+            if worse_abs > abs_thr:
+                regressions.append(row)
+            elif worse_abs < -abs_thr:
+                improvements.append(row)
+            continue
         if a <= 0:     # a zero/negative old value makes ratios meaningless
             continue
         compared += 1
@@ -188,6 +223,14 @@ def run_diff(old_path, new_path, threshold, json_out=False):
     return 1 if report["status"] == "regression" else 0
 
 
+def _fmt_change(row):
+    """Human form of one diff row: percent for ratio-gated keys, the raw
+    delta for floor metrics whose old value may be 0 (pct is None)."""
+    if row.get("change_pct") is not None:
+        return f"{row['change_pct']:+.1f}%"
+    return f"{row.get('change_abs', 0):+g} abs"
+
+
 def _print_human(report, threshold):
     print(f"benchdiff {report['old_file']} -> {report['new_file']} "
           f"(threshold {threshold * 100:.0f}%)")
@@ -198,10 +241,10 @@ def _print_human(report, threshold):
         return
     for row in report["regressions"]:
         print(f"  REGRESSION {row['key']}: {row['old']} -> {row['new']} "
-              f"({row['change_pct']:+.1f}%, want {row['direction']})")
+              f"({_fmt_change(row)}, want {row['direction']})")
     for row in report["improvements"]:
         print(f"  improved   {row['key']}: {row['old']} -> {row['new']} "
-              f"({row['change_pct']:+.1f}%)")
+              f"({_fmt_change(row)})")
     print(f"  {report['compared']} trend keys compared, "
           f"{len(report['regressions'])} regression(s)")
 
@@ -351,6 +394,35 @@ def self_test():
                        serve_ttft_p99_ms=14.0))
     check("improving continuous keys pass with improvements reported",
           rep["status"] == "ok" and len(rep["improvements"]) == 2)
+    # memory keys (PR 15): rising peak HBM / slab / plan ratio / leak
+    # growth gates the trend
+    mem_base = {"backend_ok": True, "train_peak_hbm_mb": 100.0,
+                "serve_kv_slab_mb": 8.0,
+                "mem_plan_vs_measured_ratio": 1.2,
+                "leakcheck_growth_mb": 0.5}
+    rep = compare(mem_base, dict(mem_base, train_peak_hbm_mb=130.0,
+                                 serve_kv_slab_mb=10.0,
+                                 mem_plan_vs_measured_ratio=1.5,
+                                 leakcheck_growth_mb=12.0))
+    check("memory keys regress on peak/slab/ratio/leak growth",
+          rep["status"] == "regression"
+          and {r["key"] for r in rep["regressions"]}
+          == {"train_peak_hbm_mb", "serve_kv_slab_mb",
+              "mem_plan_vs_measured_ratio", "leakcheck_growth_mb"})
+    rep = compare(mem_base, dict(mem_base, train_peak_hbm_mb=80.0))
+    check("improving memory keys pass with improvements reported",
+          rep["status"] == "ok" and len(rep["improvements"]) == 1)
+    # leakcheck_growth_mb is a FLOOR metric gated on ABSOLUTE delta: the
+    # healthy committed baseline is 0.0 and the ratio path's `a <= 0`
+    # skip must NOT make the gate dead (the point of the leak trend key)
+    zero_leak = {"backend_ok": True, "leakcheck_growth_mb": 0.0}
+    rep = compare(zero_leak, dict(zero_leak, leakcheck_growth_mb=50.0))
+    check("a real leak fires from a 0.0 committed baseline",
+          rep["status"] == "regression"
+          and rep["regressions"][0]["key"] == "leakcheck_growth_mb")
+    rep = compare(zero_leak, dict(zero_leak, leakcheck_growth_mb=0.3))
+    check("sub-threshold leak jitter from a 0.0 baseline stays ok",
+          rep["status"] == "ok" and rep["compared"] == 1)
     missing_only_new = {"backend_ok": True,
                         "io_pipeline_images_per_sec": 700.0}
     check("keys missing from one side are skipped, not regressions",

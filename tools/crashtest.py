@@ -14,6 +14,16 @@ Usage:
                               [--dir DIR] [--seed 0]
     python tools/crashtest.py --elastic [--resume-dp 4] [...]
     python tools/crashtest.py --flightrec [--steps 12] [...]
+    python tools/crashtest.py --oom [--steps 8] [...]
+
+`--oom` tests the OOM-forensics path (ISSUE 15): a BOUNDED planted
+allocation bomb (32MB, census-registered as owner `oom_bomb`) rides an
+elastic run that raises a RESOURCE_EXHAUSTED-shaped error mid-training;
+the parent asserts run_elastic's `mem.on_oom` hook left an OOM dump
+whose top census entry names the planted owner (plus live memory plans
+and a parseable flightrec spool). Bounded on purpose: really exhausting
+memory on a shared CI host invites the OS OOM killer into neighboring
+processes.
 
 `--flightrec` tests the flight recorder's SIGKILL parity (ISSUE 13): the
 elastic child runs with `MXNET_FLIGHTREC_DIR` set, so every span open /
@@ -86,7 +96,17 @@ def _elastic_child(args):
     """Elastic-mode training subprocess: ZeRO trainer on an 8-way virtual
     CPU mesh, exact-lattice linear model (see module docstring), dp from
     --dp. Dumps final params + optimizer-state + accounting to
-    final.json."""
+    final.json.
+
+    OOM-bomb mode (`MXTPU_OOM_AT=<step>`, set by `--oom`): a 32MB device
+    buffer is carved up-front and census-registered as the planted owner
+    `oom_bomb`, and at the given step the batch supply raises a
+    RESOURCE_EXHAUSTED-shaped error. Deterministic and BOUNDED on
+    purpose: really exhausting host memory on a shared CI box invites
+    the OS OOM killer into every neighboring process — the point of the
+    test is the forensics path (run_elastic's on_oom hook dumps census +
+    plans + the flightrec ring before re-raising), and a synthetic
+    RESOURCE_EXHAUSTED drives exactly that path."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
@@ -107,6 +127,30 @@ def _elastic_child(args):
     def batch_fn(step):
         r = np.random.RandomState(seed * 100003 + step)
         return {"c": r.randint(-8, 9, (64, 24)).astype(np.float32)}
+
+    oom_at = os.environ.get("MXTPU_OOM_AT")
+    if oom_at is not None:
+        oom_at = int(oom_at)
+        from incubator_mxnet_tpu.inspect import memory as mem
+        # the planted owner: dominates every other live buffer, so the
+        # dump's top census entry MUST name it
+        bomb = jnp.zeros((1024, 1024, 8), jnp.float32)      # 32 MB
+        mem.register(bomb, owner="oom_bomb")
+        real_batch_fn = batch_fn
+
+        def batch_fn(step, _bomb=bomb):
+            if step >= oom_at:
+                # the collective programs exist by now — note their plans
+                # so the dump's "what was supposed to fit" table is live
+                try:
+                    mem.collective_memory_plans()
+                except Exception:
+                    pass
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                    "allocate 34359738368 bytes (simulated allocation "
+                    "bomb; tools/crashtest.py --oom)")
+            return real_batch_fn(step)
 
     params = {"w": (np.arange(24, dtype=np.float32) - 12) / 4.0,
               "v": np.linspace(-1, 1, 16).astype(np.float32)}
@@ -196,6 +240,79 @@ def _flightrec_mode(workdir, kill_at, run_child, point):
     return 0
 
 
+def _oom_mode(workdir, kill_at, run_child):
+    """Drive the OOM-forensics path: a planted allocation bomb under
+    run_elastic must leave (a) a parseable flightrec spool recording the
+    `oom` event, and (b) an OOM dump whose TOP census entry names the
+    planted owner and whose plans table is non-empty."""
+    import glob
+
+    rec_dir = os.path.join(workdir, "flightrec")
+    _d, proc = run_child("crash", {
+        "MXNET_FLIGHTREC_DIR": rec_dir,
+        "MXTPU_OOM_AT": str(kill_at)})
+    if proc.returncode == 0:
+        print("crashtest: child survived its own OOM?", file=sys.stderr)
+        return 1
+    print(f"crashtest: child OOMed at step {kill_at} "
+          f"(rc={proc.returncode})")
+
+    dumps = glob.glob(os.path.join(rec_dir, "oomdump-*.json"))
+    if not dumps:
+        print(f"crashtest: NO oom dump in {rec_dir}", file=sys.stderr)
+        print(proc.stdout + proc.stderr, file=sys.stderr)
+        return 1
+    with open(dumps[0]) as f:
+        dump = json.load(f)
+    owners = (dump.get("census") or {}).get("owners") or {}
+    if not owners:
+        print("crashtest: oom dump carries no census", file=sys.stderr)
+        return 1
+    top = next(iter(owners))
+    if top != "oom_bomb":
+        print(f"crashtest: top census owner is {top!r}, wanted the "
+              f"planted 'oom_bomb' "
+              f"({ {k: v['bytes'] for k, v in owners.items()} })",
+              file=sys.stderr)
+        return 1
+    if not dump.get("plans"):
+        print("crashtest: oom dump carries no memory plans",
+              file=sys.stderr)
+        return 1
+    if "RESOURCE_EXHAUSTED" not in (dump.get("error") or ""):
+        print(f"crashtest: dump error field is not the OOM: "
+              f"{dump.get('error')!r}", file=sys.stderr)
+        return 1
+
+    spools = glob.glob(os.path.join(rec_dir, "flightrec-*.jsonl"))
+    if not spools:
+        print("crashtest: no flightrec spool next to the oom dump",
+              file=sys.stderr)
+        return 1
+    events = []
+    for path in spools:
+        with open(path) as f:
+            for ln, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    print(f"crashtest: {path}:{ln} is not valid JSON",
+                          file=sys.stderr)
+                    return 1
+    oom_events = [e for e in events if e.get("kind") == "oom"]
+    if not oom_events:
+        print("crashtest: spool has no 'oom' event", file=sys.stderr)
+        return 1
+    print(f"crashtest: OOM forensics OK — dump names 'oom_bomb' as top "
+          f"owner ({owners['oom_bomb']['bytes']} bytes), "
+          f"{len(dump['plans'])} plan(s), {len(events)} spooled events "
+          f"incl. the oom marker")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--steps", type=int, default=30)
@@ -218,9 +335,13 @@ def main(argv=None):
                     help="flight-recorder SIGKILL-parity mode: kill an "
                          "elastic run mid-step, assert the JSONL spool "
                          "names the in-flight step/mesh")
+    ap.add_argument("--oom", action="store_true",
+                    help="OOM-forensics mode: a planted allocation bomb "
+                         "under run_elastic must leave an OOM dump "
+                         "naming the planted owner as top census entry")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
-    if args.flightrec:
+    if args.flightrec or args.oom:
         args.elastic = True
 
     if args.child:
@@ -248,6 +369,8 @@ def main(argv=None):
 
     if args.flightrec:
         return _flightrec_mode(workdir, kill_at, run_child, point)
+    if args.oom:
+        return _oom_mode(workdir, kill_at, run_child)
 
     # 1. uninterrupted reference
     ref_dir, proc = run_child("ref", {})

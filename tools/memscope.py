@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""memscope — the operator entrypoint for device-memory observability
+(mx.inspect.memory).
+
+One command answers "where would the bytes go, and where are they now":
+
+    python tools/memscope.py --model tiny            # train-step plans
+    python tools/memscope.py --model resnet18 --json out.json
+    python tools/memscope.py --serve                 # serving-side plans
+    python tools/memscope.py --serve --markdown      # human tables
+
+`--model` builds an initialized FusedTrainStep (donate=True), prints its
+compiled memory plan (argument / output / temp / alias split, predicted
+peak), proves donation with `assert_donation`, runs a few steps, and
+reports the attributed live-buffer census. `--serve` builds a
+CachedDecoder + ContinuousEngine, prints the prefill/decode plans and
+the carved KV slab, and the census. Both end with `device_memory_info`
+— honestly stamped `known: false` where the backend reports no limits
+(CPU). Exit 0; a failed donation proof exits 1 (that IS the regression
+the tool exists to catch).
+
+Workflow docs: docs/OBSERVABILITY.md "Device memory".
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _mb(b):
+    return round(b / 2**20, 3)
+
+
+def _plan_row(name, plan):
+    return {
+        "program": name,
+        "source": plan.get("source"),
+        "argument_mb": _mb(plan.get("argument_size", 0)),
+        "output_mb": _mb(plan.get("output_size", 0)),
+        "temp_mb": _mb(plan.get("temp_size", 0)),
+        "alias_mb": _mb(plan.get("alias_size", 0)),
+        "peak_mb": _mb(plan.get("peak_bytes", 0)),
+    }
+
+
+def build_train(model="tiny", batch_size=None):
+    """(step, x, y, donated_bytes): an initialized FusedTrainStep probe."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu import optimizer as opt_mod
+    from incubator_mxnet_tpu.gluon.contrib import FusedTrainStep
+
+    if model == "tiny":
+        bs = batch_size or 8
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(8, 3, padding=1, layout="NHWC"),
+                gluon.nn.Flatten(), gluon.nn.Dense(10))
+        shape, n_classes = (bs, 8, 8, 3), 10
+    else:
+        bs = batch_size or 32
+        from incubator_mxnet_tpu.gluon.model_zoo import vision
+        net = getattr(vision, f"{model}_v1")(layout="NHWC")
+        shape, n_classes = (bs, 224, 224, 3), 1000
+    net.initialize()
+    net.hybridize()
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.np.array(np.random.uniform(-1, 1, shape).astype(np.float32))
+    y = mx.np.array(np.random.randint(0, n_classes, (bs,)))
+    net(x)
+    opt = opt_mod.create("sgd", learning_rate=0.05, momentum=0.9)
+    step = FusedTrainStep(net, lambda n, a, b: loss(n(a), b).mean(), opt,
+                          donate=True)
+    donated = sum(p.data()._arr.nbytes
+                  for p in net.collect_params().values()
+                  if p.grad_req != "null")
+    return step, x, y, donated
+
+
+def scope_model(model):
+    from incubator_mxnet_tpu import inspect as mxinspect
+
+    step, x, y, donated = build_train(model)
+    plan = mxinspect.memory_plan(step, x, y, name=f"{model}_train")
+    donation_ok, donation_err = True, None
+    try:
+        mxinspect.assert_donation(plan, donated)
+    except Exception as e:
+        donation_ok, donation_err = False, str(e)
+    step(x, y)
+    step(x, y)
+    census = mxinspect.census()
+    return {
+        "mode": "model", "model": model,
+        "plans": [_plan_row(f"{model}_train (fused fwd+bwd+update)",
+                            plan)],
+        "donated_mb": _mb(donated),
+        "donation_ok": donation_ok,
+        "donation_error": donation_err,
+        "census": census,
+    }
+
+
+def scope_serve():
+    from incubator_mxnet_tpu import serve
+    from incubator_mxnet_tpu import inspect as mxinspect
+
+    cfg = serve.DecoderConfig(vocab=64, embed=32, layers=2, heads=2,
+                              head_dim=16, max_len=64)
+    engine = serve.ContinuousEngine(serve.CachedDecoder(cfg), max_slots=8,
+                                    decode_steps=2,
+                                    prefill_window=32).start()
+    try:
+        engine.generate([1, 2, 3], max_new_tokens=4)
+        plans = engine.memory_plans()
+        pool = engine.pool.stats()
+        census = mxinspect.census()
+    finally:
+        engine.close()
+    return {
+        "mode": "serve",
+        "plans": [_plan_row("continuous.prefill", plans["prefill"]),
+                  _plan_row("continuous.decode", plans["decode"])],
+        "kv_slab_mb": _mb(pool["slab_bytes"]),
+        "kv_slots": pool["max_slots"],
+        "donation_ok": True,
+        "census": census,
+    }
+
+
+def _device_memory():
+    from incubator_mxnet_tpu.device import device_memory_info
+    try:
+        info = device_memory_info()
+        return {"free_mb": _mb(info.free), "total_mb": _mb(info.total),
+                "known": info.known}
+    except Exception as e:
+        return {"known": False, "error": str(e)}
+
+
+def render_markdown(report):
+    lines = [f"# memscope — {report['mode']}", ""]
+    lines.append("| program | source | args MB | out MB | temp MB | "
+                 "alias MB | peak MB |")
+    lines.append("|---|---|---|---|---|---|---|")
+    for p in report["plans"]:
+        lines.append(
+            f"| `{p['program']}` | {p['source']} | {p['argument_mb']} | "
+            f"{p['output_mb']} | {p['temp_mb']} | {p['alias_mb']} | "
+            f"{p['peak_mb']} |")
+    lines.append("")
+    if report["mode"] == "model":
+        ok = "proven" if report["donation_ok"] else \
+            f"FAILED: {report['donation_error']}"
+        lines.append(f"Donation ({report['donated_mb']} MB of "
+                     f"weight+state buffers): {ok}")
+    else:
+        lines.append(f"KV slab: {report['kv_slab_mb']} MB across "
+                     f"{report['kv_slots']} slots")
+    c = report["census"]
+    lines.append("")
+    lines.append(f"## Live-buffer census "
+                 f"({_mb(c['total_bytes'])} MB, "
+                 f"{c['tagged_fraction'] * 100:.1f}% attributed)")
+    lines.append("")
+    lines.append("| owner | arrays | MB | top shapes |")
+    lines.append("|---|---|---|---|")
+    for name, g in c["owners"].items():
+        shapes = ", ".join(f"{s}×{n}" for s, n in
+                           list(g["shapes"].items())[:3])
+        lines.append(f"| `{name}` | {g['count']} | {_mb(g['bytes'])} | "
+                     f"{shapes} |")
+    dm = report.get("device_memory", {})
+    lines.append("")
+    if dm.get("known"):
+        lines.append(f"Device memory: {dm['free_mb']} MB free of "
+                     f"{dm['total_mb']} MB")
+    else:
+        lines.append("Device memory: backend reports no limits "
+                     "(known: false — CPU or a PJRT build without "
+                     "bytes_limit)")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="memscope", description=__doc__)
+    ap.add_argument("--model", default=None,
+                    help="train-step probe: tiny | resnet18 | resnet50")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving probe: decoder + continuous engine")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="write the full report as JSON (- for stdout)")
+    ap.add_argument("--markdown", action="store_true",
+                    help="print human tables (default when no --json)")
+    args = ap.parse_args(argv)
+
+    if args.serve:
+        report = scope_serve()
+    else:
+        report = scope_model(args.model or "tiny")
+    report["device_memory"] = _device_memory()
+
+    if args.json:
+        payload = json.dumps(report, indent=1, default=str)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload)
+            print(f"memscope: wrote {args.json}")
+    if args.markdown or not args.json:
+        print(render_markdown(report))
+    return 0 if report.get("donation_ok", True) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
