@@ -531,6 +531,34 @@ def bench_serve_trace_ab():
              "serve_trace_sampled_overhead_pct") if k in best}
 
 
+def bench_fleet(quick=False):
+    """Multi-replica serving trend row (subprocess: fleet_bench forces
+    CPU and spawns its own replica processes — see
+    benchmark/fleet_bench.py). Returns the bench JSON dict or None."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            out = os.path.join(d, "fleet.json")
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            cmd = [sys.executable,
+                   os.path.join(here, "benchmark", "fleet_bench.py"),
+                   "--out", out]
+            if quick:
+                cmd.append("--quick")
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=600, cwd=here, env=env)
+            if r.returncode != 0:
+                return None
+            with open(out) as f:
+                return json.load(f)
+    except Exception:
+        return None
+
+
 def _log(msg):
     import time as _t
     print(f"[bench {_t.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
@@ -778,6 +806,30 @@ def bench_fused_train(model="resnet18", batch_size=32, iters=12, warmup=4,
         if use_amp:
             amp.uninit()
     return batch_size * iters / dt, flops, retraces
+
+
+def _phase_fleet(quick=False):
+    """Fleet serving trend row: 2-replica capacity over single-replica,
+    kill-window tail latency, and drain-and-swap drop accounting (all
+    three scalars benchdiff-gated; fleet_kill_failures and
+    fleet_swap_dropped_requests must stay 0)."""
+    r = bench_fleet(quick=quick)
+    if r is None:
+        return {}
+    out = {}
+    for k in ("fleet_vs_single_speedup", "fleet_p99_ms_during_kill",
+              "fleet_p99_ms_steady", "fleet_kill_failures",
+              "fleet_swap_dropped_requests"):
+        if r.get(k) is not None:
+            out[k] = r[k]
+    for seg, keys in (("fleet", ("requests_per_sec",)),
+                      ("single", ("requests_per_sec",)),
+                      ("kill", ("failovers", "retries", "respawns")),
+                      ("swap", ("swap_ms", "served_during"))):
+        for k in keys:
+            if (r.get(seg) or {}).get(k) is not None:
+                out[f"fleet_{seg}_{k}"] = r[seg][k]
+    return out
 
 
 def _phase_elastic(quick=False):
@@ -1028,6 +1080,7 @@ PHASES = [
     ("input_pipeline", _phase_input_pipeline),
     ("serve", _phase_serve),
     ("serve_continuous", _phase_serve_continuous),
+    ("fleet", _phase_fleet),
     ("elastic", _phase_elastic),
     ("memory", _phase_memory),
     ("offenders", _phase_offenders),
@@ -1079,6 +1132,13 @@ def _phase_serve_continuous_quick():
     return _phase_serve_continuous(quick=True)
 
 
+def _phase_fleet_quick():
+    # same keys, stub replicas + short windows (stamped meta.stub inside
+    # fleet_bench): the tier-1 smoke exercises supervisor + router +
+    # SIGKILL failover + rolling swap end to end without a jax compile
+    return _phase_fleet(quick=True)
+
+
 def _phase_memory_quick():
     # same keys, tiny net + tiny decoder: the tier-1 smoke exercises the
     # plan/census/leakcheck path end to end without a ResNet compile
@@ -1093,6 +1153,7 @@ QUICK_PHASES = {
     "fused_sweep": _phase_fused_sweep_quick,
     "elastic": _phase_elastic_quick,
     "serve_continuous": _phase_serve_continuous_quick,
+    "fleet": _phase_fleet_quick,
     "memory": _phase_memory_quick,
 }
 
@@ -1101,7 +1162,7 @@ QUICK_PHASES = {
 PHASE_TIMEOUTS = {
     "dispatch": 300, "eager": 900, "train32": 1500, "train128": 1500,
     "infer": 900, "io": 700, "input_pipeline": 700, "serve": 700,
-    "serve_continuous": 900, "elastic": 700, "memory": 700,
+    "serve_continuous": 900, "fleet": 700, "elastic": 700, "memory": 700,
     "offenders": 700,
     "fused_sweep": 2000, "calib": 900, "xla_flops": 600,
 }

@@ -107,6 +107,15 @@ POINTS = {
     "elastic.step": "run_elastic, before each trainer step",
     "elastic.loss": "run_elastic, applied to the step loss (nan kind "
                     "poisons it)",
+    "fleet.dispatch": "serve.Fleet router, before a request is sent to "
+                      "the chosen replica (failure consumes one retry "
+                      "from the failover budget)",
+    "fleet.heartbeat": "serve.Fleet monitor, per replica health check "
+                       "(failure counts as a missed heartbeat)",
+    "fleet.respawn": "serve.Fleet supervisor, before a dead replica is "
+                     "respawned (failure bills a consecutive restart)",
+    "fleet.swap": "serve.Fleet.swap, before each replica's "
+                  "drain-and-swap (failure aborts the rolling upgrade)",
 }
 
 _KINDS = ("ioerror", "oserror", "error", "timeout", "nan", "stall", "kill")

@@ -52,13 +52,15 @@ from __future__ import annotations
 from ..base import _register_env
 from ..telemetry import metrics_text, start_metrics_server
 from .batcher import (ServeError, QueueFullError, RequestTimeout,
-                      ServerClosed, BucketedModel, CallableModel, Server,
-                      pick_bucket)
+                      ServerClosed, ReplicaDraining, BucketedModel,
+                      CallableModel, Server, pick_bucket)
 from .metrics import SERVE_STATS, ServeMetrics, serve_stats as stats
 from .kv_pool import (KVCachePool, SlotsFullError, KVPOOL_STATS,
                       kvpool_stats)
 from .continuous import (ContinuousEngine, CachedDecoder, DecoderConfig,
                          init_decoder_params)
+from .fleet import (Fleet, FleetError, ReplicaDied, FLEET_STATS,
+                    fleet_stats)
 
 __all__ = [
     "Server", "BucketedModel", "CallableModel", "pick_bucket",
@@ -69,6 +71,9 @@ __all__ = [
     "ContinuousEngine", "CachedDecoder", "DecoderConfig",
     "init_decoder_params", "KVCachePool", "SlotsFullError",
     "KVPOOL_STATS", "kvpool_stats",
+    # multi-replica serving fleet
+    "Fleet", "FleetError", "ReplicaDied", "ReplicaDraining",
+    "FLEET_STATS", "fleet_stats",
 ]
 
 _register_env("MXNET_SERVE_MAX_QUEUE", int, 256,
@@ -85,3 +90,15 @@ _register_env("MXNET_SERVE_MAX_SLOTS", int, 8,
 _register_env("MXNET_SERVE_PREFILL_BUDGET", int, 256,
               "Max prompt tokens prefilled per engine iteration "
               "(bounds prefill's added latency on in-flight decode)")
+_register_env("MXNET_FLEET_REPLICAS", int, 2,
+              "Replica worker processes a serve.Fleet spawns")
+_register_env("MXNET_FLEET_HEARTBEAT_MS", float, 500.0,
+              "Fleet heartbeat interval; a replica missing "
+              "`heartbeat_misses` consecutive beats is declared hung")
+_register_env("MXNET_FLEET_RETRY_BUDGET", int, 2,
+              "Failover retries per request before the original replica "
+              "error surfaces to the client")
+_register_env("MXNET_FLEET_DRAIN_TIMEOUT_MS", float, 30000.0,
+              "Max wait for a draining replica to finish its resident "
+              "requests before the swap hard-stops it (survivors absorb "
+              "its in-flight via failover)")

@@ -46,6 +46,7 @@ def _profiler_on():
 
 __all__ = [
     "ServeError", "QueueFullError", "RequestTimeout", "ServerClosed",
+    "ReplicaDraining",
     "BucketedModel", "CallableModel", "Server", "pick_bucket",
 ]
 
@@ -71,6 +72,15 @@ class RequestTimeout(ServeError):
 class ServerClosed(ServeError):
     """submit() after close(), or the request was pending at a non-draining
     shutdown."""
+
+
+class ReplicaDraining(ServerClosed):
+    """submit() while the engine is DRAINING: it has stopped admitting but
+    is still finishing its resident requests before a restart (the
+    drain-and-swap protocol). The fleet router catches this and re-routes
+    to another replica — clients never see it. Subclasses ServerClosed so
+    single-process callers that already handle close() races keep
+    working."""
 
 
 def pick_bucket(n, buckets):

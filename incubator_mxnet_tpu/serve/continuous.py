@@ -64,7 +64,7 @@ from .. import fault as _fault
 from ..telemetry import (record_span, trace as _trace, mem_on_oom,
                          mem_install_oom_hook)
 from .batcher import (ServeError, QueueFullError, RequestTimeout,
-                      ServerClosed, _fail, _profiler_on)
+                      ServerClosed, ReplicaDraining, _fail, _profiler_on)
 from .metrics import SERVE_STATS, _STATS_LOCK, percentile
 from .kv_pool import KVCachePool, SlotsFullError
 
@@ -593,6 +593,29 @@ class ContinuousEngine:
     def __exit__(self, *exc):
         self.close()
 
+    def begin_drain(self):
+        """Stop admitting (submit() raises `ReplicaDraining`) while the
+        scheduler finishes every waiting AND admitted request. Non-blocking
+        by design — the drain-and-swap replica keeps answering heartbeats
+        while its KV-resident requests finish; `close()` joins after."""
+        with self._cv:
+            if not self._closing:
+                self._closing = True
+                self._drain = True
+            self._cv.notify_all()
+
+    @property
+    def draining(self):
+        """True while a drain is in progress (resident requests still
+        finishing); False once the scheduler has exited."""
+        return self._closing and self._drain and self._thread.is_alive()
+
+    def queue_depth(self):
+        """(waiting, running) request counts — the fleet router's
+        least-loaded placement signal."""
+        with self._cv:
+            return len(self._waiting), len(self._running)
+
     # -- submission --------------------------------------------------------
     def submit(self, prompt_tokens, max_new_tokens=16, deadline_ms=None):
         """Enqueue one generation request; returns a Future resolving to
@@ -624,6 +647,13 @@ class ContinuousEngine:
                           else time.perf_counter() + dl, ctx)
         with self._cv:
             if self._closing:
+                # typed split, not one generic ServerClosed: DRAINING means
+                # "resident requests still finishing before a restart" and
+                # the fleet router re-routes it silently; CLOSED is final
+                if self._drain and self._thread.is_alive():
+                    raise ReplicaDraining(
+                        "engine is draining (finishing resident requests "
+                        "before restart); route to another replica")
                 raise ServerClosed("engine is closed")
             if len(self._waiting) >= self.max_queue:
                 depth = len(self._waiting)

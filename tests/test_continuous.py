@@ -395,6 +395,50 @@ def test_closed_engine_rejects_and_drains(decoder):
         eng.submit([1, 2], 4)
 
 
+def test_submit_during_drain_raises_typed_replica_draining(decoder):
+    """DRAINING is not CLOSED: while the engine is still finishing its
+    resident requests before a restart, submit() must raise the typed
+    ReplicaDraining (a ServerClosed subclass the fleet router re-routes
+    silently), and revert to plain ServerClosed once the drain is done."""
+    model, _ = decoder
+    eng = serve.ContinuousEngine(model, max_slots=2, decode_steps=2).start()
+    resident = eng.submit([1, 2, 3], 10)
+    eng.begin_drain()
+    assert eng.draining
+    with pytest.raises(serve.ReplicaDraining, match="draining"):
+        eng.submit([4], 2)
+    assert issubclass(serve.ReplicaDraining, serve.ServerClosed)
+    # the resident lane still finishes: drain never cancels admitted work
+    assert resident.result(timeout=120).size == 10
+    eng.close()
+    assert not eng.draining
+    try:
+        eng.submit([4], 2)
+        pytest.fail("closed engine accepted a request")
+    except serve.ReplicaDraining:
+        pytest.fail("closed engine must raise plain ServerClosed")
+    except serve.ServerClosed:
+        pass
+
+
+def test_drain_completes_when_waiting_lane_expires_mid_drain(decoder):
+    """A waiting request whose deadline fires DURING the drain must not
+    wedge close(drain=True): the loop drops the expired waiter and exits."""
+    model, _ = decoder
+    eng = serve.ContinuousEngine(model, max_slots=1, prefill_lanes=1,
+                                 decode_steps=1).start()
+    held = eng.pool.claim()            # the waiter can never be admitted
+    doomed = eng.submit([3], 4, deadline_ms=300)
+    t0 = time.time()
+    eng.close(drain=True, timeout=30)
+    dt = time.time() - t0
+    # gated by the 300ms deadline, not wedged and not instant
+    assert 0.2 <= dt < 10, dt
+    with pytest.raises(serve.RequestTimeout, match="KV slot"):
+        doomed.result(timeout=1)
+    eng.pool.free(held)
+
+
 # ---------------------------------------------------------------------------
 # tracing: one request = one trace across N iterations
 # ---------------------------------------------------------------------------

@@ -100,6 +100,14 @@ TREND_KEYS = {
     "serve_kv_slab_mb": "lower",
     "mem_plan_vs_measured_ratio": "lower",
     "leakcheck_growth_mb": "lower",
+    # fleet phase (PR 16, serve.fleet): 2 replicas must keep buying real
+    # capacity over 1; the kill-window tail must not grow (failover cost
+    # is the whole point of the subsystem); swap drops are a FLOOR metric
+    # like leakcheck — the healthy baseline is 0 dropped requests, so it
+    # is gated on absolute delta via ABS_THRESHOLDS
+    "fleet_vs_single_speedup": "higher",
+    "fleet_p99_ms_during_kill": "lower",
+    "fleet_swap_dropped_requests": "lower",
 }
 
 # floor metrics whose healthy committed baseline IS 0 (a ratio threshold
@@ -109,6 +117,7 @@ TREND_KEYS = {
 # whatever `old` was.
 ABS_THRESHOLDS = {
     "leakcheck_growth_mb": 1.0,     # a real leak is tens of MB/round
+    "fleet_swap_dropped_requests": 0.5,   # ANY dropped request regresses
 }
 
 DEFAULT_THRESHOLD = 0.10
@@ -423,6 +432,32 @@ def self_test():
     rep = compare(zero_leak, dict(zero_leak, leakcheck_growth_mb=0.3))
     check("sub-threshold leak jitter from a 0.0 baseline stays ok",
           rep["status"] == "ok" and rep["compared"] == 1)
+    # fleet keys (PR 16): a falling replica speedup or a fatter
+    # kill-window tail gates the trend
+    fleet_base = {"backend_ok": True, "fleet_vs_single_speedup": 1.8,
+                  "fleet_p99_ms_during_kill": 40.0,
+                  "fleet_swap_dropped_requests": 0.0}
+    rep = compare(fleet_base,
+                  dict(fleet_base, fleet_vs_single_speedup=1.3,
+                       fleet_p99_ms_during_kill=70.0))
+    check("fleet speedup drop / kill-window p99 rise is a regression",
+          rep["status"] == "regression"
+          and {r["key"] for r in rep["regressions"]}
+          == {"fleet_vs_single_speedup", "fleet_p99_ms_during_kill"})
+    # fleet_swap_dropped_requests is a FLOOR metric like leakcheck: the
+    # healthy committed baseline is 0 dropped requests and ANY drop from
+    # that baseline must fire the gate
+    rep = compare(fleet_base,
+                  dict(fleet_base, fleet_swap_dropped_requests=3.0))
+    check("any swap-dropped request fires from a 0 committed baseline",
+          rep["status"] == "regression"
+          and rep["regressions"][0]["key"]
+          == "fleet_swap_dropped_requests")
+    rep = compare(fleet_base,
+                  dict(fleet_base, fleet_vs_single_speedup=2.2,
+                       fleet_p99_ms_during_kill=28.0))
+    check("improving fleet keys pass with improvements reported",
+          rep["status"] == "ok" and len(rep["improvements"]) == 2)
     missing_only_new = {"backend_ok": True,
                         "io_pipeline_images_per_sec": 700.0}
     check("keys missing from one side are skipped, not regressions",

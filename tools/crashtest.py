@@ -15,6 +15,19 @@ Usage:
     python tools/crashtest.py --elastic [--resume-dp 4] [...]
     python tools/crashtest.py --flightrec [--steps 12] [...]
     python tools/crashtest.py --oom [--steps 8] [...]
+    python tools/crashtest.py --fleet [--rate 20] [--window 6] [...]
+
+`--fleet` is the serving-side SIGKILL-parity harness (ISSUE 16): a real
+2-replica `mx.serve.Fleet` (replica subprocesses sharing one persistent
+compilation cache) serves an OPEN-LOOP Poisson request stream (the PR-13
+tail-latency discipline: arrivals never wait for completions, so a
+stalled fleet cannot slow its own load down). Mid-stream the harness
+SIGKILLs replica 0 and asserts (a) ZERO client-visible failures — every
+in-flight request re-enqueues onto the survivor under the retry budget,
+(b) the kill-window p99 stays within 3x the steady-state p99, and
+(c) the supervisor's respawned replica rejoins WARM: its hello reports
+the same compile_cache_size it died with and the fleet-wide zero-retrace
+contract still holds.
 
 `--oom` tests the OOM-forensics path (ISSUE 15): a BOUNDED planted
 allocation bomb (32MB, census-registered as owner `oom_bomb`) rides an
@@ -313,6 +326,156 @@ def _oom_mode(workdir, kill_at, run_child):
     return 0
 
 
+def _fleet_mode(workdir, args):
+    """Serving SIGKILL parity: open-loop Poisson traffic over a real
+    2-replica fleet, replica 0 SIGKILLed mid-stream. Zero client-visible
+    failures, bounded kill-window p99, warm respawn."""
+    import signal
+    import threading
+    import time
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    cache = os.path.join(workdir, "compile_cache")
+    os.makedirs(cache, exist_ok=True)
+    os.environ["MXNET_COMPILE_CACHE_DIR"] = cache
+    sys.path.insert(0, REPO)
+    from incubator_mxnet_tpu import serve
+
+    spec = {"version": "v1", "seed": args.seed,
+            "config": dict(vocab=64, embed=32, layers=2, heads=4,
+                           head_dim=8, max_len=48),
+            "engine": {"max_slots": 4, "decode_steps": 2,
+                       "prefill_window": 16}}
+    fleet = serve.Fleet(spec, replicas=2, heartbeat_ms=200,
+                        workdir=os.path.join(workdir, "fleet")).start()
+    try:
+        pre = {r["replica"]: r for r in fleet.stats()["replicas"]}
+        print(f"crashtest: fleet up — warmups "
+              f"{[round(r['warmup_s'], 2) for r in pre.values()]}s, "
+              f"compile_cache_size "
+              f"{[r['compile_cache_size'] for r in pre.values()]}")
+
+        rng = np.random.RandomState(args.seed)
+        lock = threading.Lock()
+        lat = {"steady": [], "kill": []}
+        failures = []
+
+        def fire(window, prompt):
+            t0 = time.perf_counter()
+
+            def done(f):
+                try:
+                    f.result()
+                    with lock:
+                        lat[window].append(time.perf_counter() - t0)
+                except Exception as e:          # noqa: BLE001 - harness
+                    with lock:
+                        failures.append((window, repr(e)))
+
+            fleet.submit(prompt, max_new_tokens=4).add_done_callback(done)
+
+        def poisson_window(window, seconds):
+            # OPEN loop: exponential inter-arrival, arrivals never wait
+            # for completions
+            end = time.perf_counter() + seconds
+            n = 0
+            while time.perf_counter() < end:
+                fire(window, [int(rng.randint(1, 64))
+                              for _ in range(int(rng.randint(2, 8)))])
+                n += 1
+                time.sleep(rng.exponential(1.0 / args.rate))
+            return n
+
+        burst = 24
+        rng2 = np.random.RandomState(args.seed + 1)
+
+        def fire_burst(window):
+            for _ in range(burst):
+                fire(window, [int(rng2.randint(1, 64))
+                              for _ in range(int(rng2.randint(2, 8)))])
+
+        # the steady window carries the SAME mid-window burst as the kill
+        # window, so the 3x p99 comparison is apples-to-apples: the kill
+        # window differs ONLY by the SIGKILL
+        buster = threading.Timer(args.window * 0.25, fire_burst,
+                                 ("steady",))
+        buster.start()
+        n_steady = poisson_window("steady", args.window) + burst
+        buster.join()
+        pid0 = fleet.stats()["replicas"][0]["pid"]
+
+        def kill_with_inflight():
+            # the burst right before the SIGKILL guarantees requests are
+            # IN FLIGHT on the doomed replica — the failover path under
+            # test, not just the lucky between-requests case
+            fire_burst("kill")
+            os.kill(pid0, signal.SIGKILL)
+
+        killer = threading.Timer(args.window * 0.25, kill_with_inflight)
+        killer.start()
+        n_kill = poisson_window("kill", args.window) + burst
+        killer.join()
+
+        # let the tail drain, then wait for the respawn to finish
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            st = fleet.stats()
+            tail_done = len(lat["steady"]) + len(lat["kill"]) \
+                + len(failures) >= n_steady + n_kill
+            if tail_done and sum(1 for r in st["replicas"]
+                                 if r["state"] == "serving") == 2:
+                break
+            time.sleep(0.1)
+
+        p99s = float(np.percentile(lat["steady"], 99)) * 1e3
+        p99k = float(np.percentile(lat["kill"], 99)) * 1e3
+        st = fleet.stats()
+        post0 = st["replicas"][0]
+        print(f"crashtest: {n_steady} steady + {n_kill} kill-window "
+              f"requests at ~{args.rate}/s, SIGKILL pid {pid0}")
+        print(f"crashtest: p99 steady {p99s:.1f}ms, during kill "
+              f"{p99k:.1f}ms; failovers={st['failovers']} "
+              f"retries={st['retries']} respawns={st['respawns']}")
+        if failures:
+            print(f"crashtest: {len(failures)} CLIENT-VISIBLE FAILURES "
+                  f"(first: {failures[0]})", file=sys.stderr)
+            return 1
+        if st["respawns"] < 1 or post0["state"] != "serving" \
+                or post0["pid"] == pid0:
+            print(f"crashtest: replica 0 did not respawn ({post0})",
+                  file=sys.stderr)
+            return 1
+        if st["failovers"] < 1:
+            print("crashtest: SIGKILL caught zero in-flight requests — "
+                  "the failover path was not exercised", file=sys.stderr)
+            return 1
+        # warm rejoin: the respawned hello must report the compile cache
+        # it died with — deserialization, not recompilation
+        if (post0["compile_cache_size"] or 0) < \
+                (pre[0]["compile_cache_size"] or 0):
+            print(f"crashtest: respawned replica came back COLD "
+                  f"(cache {post0['compile_cache_size']} < "
+                  f"{pre[0]['compile_cache_size']})", file=sys.stderr)
+            return 1
+        time.sleep(0.5)                     # one more pong round-trip
+        fleet.assert_no_retraces()
+        # 3x steady-state p99 bound, with a small absolute floor so a
+        # sub-ms steady p99 on an idle host cannot fail a healthy run
+        bound = 3.0 * max(p99s, 25.0)
+        if p99k > bound:
+            print(f"crashtest: kill-window p99 {p99k:.1f}ms exceeds "
+                  f"3x steady bound {bound:.1f}ms", file=sys.stderr)
+            return 1
+        print(f"crashtest: fleet SIGKILL parity OK — 0 client-visible "
+              f"failures over {n_steady + n_kill} requests, kill-window "
+              f"p99 {p99k:.1f}ms <= {bound:.1f}ms, warm respawn "
+              f"(cache size {post0['compile_cache_size']}, warmup "
+              f"{post0['warmup_s']:.2f}s), zero retraces fleet-wide")
+        return 0
+    finally:
+        fleet.close()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--steps", type=int, default=30)
@@ -339,6 +502,17 @@ def main(argv=None):
                     help="OOM-forensics mode: a planted allocation bomb "
                          "under run_elastic must leave an OOM dump "
                          "naming the planted owner as top census entry")
+    ap.add_argument("--fleet", action="store_true",
+                    help="serving SIGKILL-parity mode: open-loop Poisson "
+                         "traffic over a real 2-replica fleet, replica 0 "
+                         "SIGKILLed mid-stream — zero client-visible "
+                         "failures, p99 <= 3x steady, warm respawn")
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="fleet mode: open-loop Poisson arrival rate "
+                         "(requests/s)")
+    ap.add_argument("--window", type=float, default=6.0,
+                    help="fleet mode: seconds per traffic window "
+                         "(steady and kill)")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
     if args.flightrec or args.oom:
@@ -348,6 +522,8 @@ def main(argv=None):
         return _elastic_child(args) if args.elastic else _child(args)
 
     workdir = args.dir or tempfile.mkdtemp(prefix="mx_crashtest_")
+    if args.fleet:
+        return _fleet_mode(workdir, args)
     kill_at = args.kill_at or random.randint(2, max(2, args.steps - 1))
     base_env = {**os.environ, "JAX_PLATFORMS": "cpu",
                 "PYTHONPATH": REPO + os.pathsep
