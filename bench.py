@@ -743,6 +743,43 @@ def _phase_serve_continuous(quick=False):
     return out
 
 
+def _phase_serve_decode(quick=False):
+    """Decode-speed trend row (serve_bench --decode): the speculative
+    path's wall-clock tokens/s in its single-stream deployment regime,
+    the acceptance-weighted per-wave ceiling, the int8 KV-pool density
+    (slots/GB — benchdiff-gated), the token-exactness verdict, and the
+    paged-attention honesty stamp."""
+    args = ["--decode", "--duration", "2.0" if quick else "6.0"]
+    if quick:
+        args.append("--quick")
+    r = _run_serve_bench(args, timeout=900)
+    if r is None:
+        return {}
+    out = {}
+    for k in ("serve_decode_tokens_per_sec_spec",
+              "serve_decode_speedup_spec",
+              "serve_decode_saturation_speedup_spec",
+              "serve_decode_tokens_per_verify_wave"):
+        if r.get(k) is not None:
+            out[k] = r[k]
+    kv = r.get("kv_slots_per_gb") or {}
+    if kv.get("int8") is not None:
+        # the benchdiff scalar is the int8 pool's density — the number
+        # the quantized-KV tier is accountable for
+        out["kv_slots_per_gb"] = kv["int8"]
+        out["kv_slots_per_gb_float32"] = kv.get("float32")
+        out["kv_slots_per_gb_ratio"] = kv.get("ratio")
+    for k in ("spec_token_exact", "paged_pallas_active"):
+        if r.get(k) is not None:
+            out[f"serve_decode_{k}"] = r[k]
+    spec = r.get("spec", {})
+    for k in ("draft_acceptance", "retraces_after_warmup",
+              "draft_tokens"):
+        if spec.get(k) is not None:
+            out[f"serve_decode_spec_{k}"] = spec[k]
+    return out
+
+
 def bench_fused_train(model="resnet18", batch_size=32, iters=12, warmup=4,
                       layout="NHWC", use_amp=True, remat=None, donate=True,
                       use_fusion=True, tiny=False):
@@ -1080,6 +1117,7 @@ PHASES = [
     ("input_pipeline", _phase_input_pipeline),
     ("serve", _phase_serve),
     ("serve_continuous", _phase_serve_continuous),
+    ("serve_decode", _phase_serve_decode),
     ("fleet", _phase_fleet),
     ("elastic", _phase_elastic),
     ("memory", _phase_memory),
@@ -1132,6 +1170,12 @@ def _phase_serve_continuous_quick():
     return _phase_serve_continuous(quick=True)
 
 
+def _phase_serve_decode_quick():
+    # same keys, tiny decoder + short windows: the tier-1 smoke exercises
+    # plain/spec/int8 A/B + exactness check + density + honesty stamp
+    return _phase_serve_decode(quick=True)
+
+
 def _phase_fleet_quick():
     # same keys, stub replicas + short windows (stamped meta.stub inside
     # fleet_bench): the tier-1 smoke exercises supervisor + router +
@@ -1153,6 +1197,7 @@ QUICK_PHASES = {
     "fused_sweep": _phase_fused_sweep_quick,
     "elastic": _phase_elastic_quick,
     "serve_continuous": _phase_serve_continuous_quick,
+    "serve_decode": _phase_serve_decode_quick,
     "fleet": _phase_fleet_quick,
     "memory": _phase_memory_quick,
 }
@@ -1162,7 +1207,8 @@ QUICK_PHASES = {
 PHASE_TIMEOUTS = {
     "dispatch": 300, "eager": 900, "train32": 1500, "train128": 1500,
     "infer": 900, "io": 700, "input_pipeline": 700, "serve": 700,
-    "serve_continuous": 900, "fleet": 700, "elastic": 700, "memory": 700,
+    "serve_continuous": 900, "serve_decode": 900, "fleet": 700,
+    "elastic": 700, "memory": 700,
     "offenders": 700,
     "fused_sweep": 2000, "calib": 900, "xla_flops": 600,
 }

@@ -582,13 +582,20 @@ def _make_static_generate(model, max_prompt, t_max):
         k = jnp.zeros(shape, dtype=cfg.dtype)
         v = jnp.zeros(shape, dtype=cfg.dtype)
         plens = jnp.maximum(plens, 1)       # pad rows: keep math benign
-        k, v, first = prefill(params, k, v, prompts, plens,
-                              jnp.arange(B))
+        # greedy lanes: temp 0 / full vocab / p=1, keys unused
+        temps = jnp.zeros((B,), dtype=jnp.float32)
+        top_ks = jnp.zeros((B,), dtype=jnp.int32)
+        top_ps = jnp.ones((B,), dtype=jnp.float32)
+        keys = jnp.zeros((B, 2), dtype=jnp.uint32)
+        k, v, logits = prefill(params, k, v, prompts, plens,
+                               jnp.arange(B))
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         def step(carry, _):
             k, v, last, lens = carry
             k, v, toks, _ = decode(params, k, v, last, lens,
-                                   jnp.ones((B,), dtype=jnp.int32))
+                                   jnp.ones((B,), dtype=jnp.int32),
+                                   temps, top_ks, top_ps, keys)
             nxt = toks[0]
             return (k, v, nxt, lens + 1), nxt
 
@@ -687,13 +694,17 @@ def bench_autoreg_static(model, workload, max_prompt, t_max, concurrency,
 
 
 def bench_autoreg_continuous(model, workload, concurrency, duration_s,
-                             max_slots=None, max_prompt=None):
+                             max_slots=None, max_prompt=None,
+                             engine_kwargs=None):
     """The continuous engine on the same workload: per-iteration
-    admit/retire, deadline-aware slot grants, zero retraces asserted."""
+    admit/retire, deadline-aware slot grants, zero retraces asserted.
+    `engine_kwargs` reaches the ContinuousEngine constructor verbatim —
+    the decode A/B passes `draft_tokens` / `kv_dtype` through it."""
     from incubator_mxnet_tpu import serve
     eng = serve.ContinuousEngine(
         model, max_slots=max_slots, prefill_window=max_prompt,
-        max_queue=max(256, 8 * concurrency)).start()
+        max_queue=max(256, 8 * concurrency),
+        **(engine_kwargs or {})).start()
     try:
         def submit(i):
             prompt, max_new = workload[i % len(workload)]
@@ -723,7 +734,132 @@ def bench_autoreg_continuous(model, workload, concurrency, duration_s,
            "tpot_p50_ms": st["tpot_p50_ms"],
            "tpot_p99_ms": st["tpot_p99_ms"],
            "e2e_p50_ms": _percentile_of(lat_sorted, 50),
-           "e2e_p99_ms": _percentile_of(lat_sorted, 99)}
+           "e2e_p99_ms": _percentile_of(lat_sorted, 99),
+           "decode_steps": st["decode_steps"],
+           "draft_tokens": st["draft_tokens"]}
+    if st.get("draft_acceptance") is not None:
+        out["draft_acceptance"] = st["draft_acceptance"]
+    if engine_kwargs and engine_kwargs.get("kv_dtype"):
+        out["kv_dtype"] = engine_kwargs["kv_dtype"]
+    return out
+
+
+def bench_decode_ab(model, workload, concurrency, duration_s,
+                    max_slots=None, max_prompt=None, draft=4):
+    """Speculative-decoding A/B (ISSUE 17): the SAME engine/workload run
+    plain vs with draft+verify waves, plus an int8-KV arm, a token-
+    exactness spot check (speculation must be a pure SPEED change), the
+    KV-pool density numbers, and an honest record of whether the Pallas
+    paged-attention kernel served the traffic compiled (TPU) or the
+    reference einsum did (CPU).
+
+    TWO operating points, because speculative decoding's economics flip
+    with batch occupancy: at SATURATION (concurrency-32 closed loop, the
+    r14 operating point) a compute-bound host pays ~C× for the C-wide
+    verify forward, so the wall-clock win only exists where that forward
+    is memory-/overhead-bound; in the LATENCY-BOUND single-stream arm
+    (concurrency 1 — the regime speculation is actually deployed in) the
+    per-wave fixed cost dominates and the acceptance-weighted win is
+    realized as wall-clock tokens/s on this host too."""
+    from incubator_mxnet_tpu import serve
+    from incubator_mxnet_tpu.ops import fused as F
+
+    F.fused_stats(reset=True)
+    plain = bench_autoreg_continuous(
+        model, workload, concurrency, duration_s, max_slots=max_slots,
+        max_prompt=max_prompt)
+    print(f"plain     {plain['decode_tokens_per_sec']:>9.1f} tok/s  "
+          f"{plain['requests_per_sec']:>7.1f} req/s  "
+          f"retraces {plain['retraces_after_warmup']}")
+    spec = bench_autoreg_continuous(
+        model, workload, concurrency, duration_s, max_slots=max_slots,
+        max_prompt=max_prompt, engine_kwargs={"draft_tokens": draft})
+    spec["mode"] = "continuous_spec"
+    print(f"spec k={draft} {spec['decode_tokens_per_sec']:>9.1f} tok/s  "
+          f"{spec['requests_per_sec']:>7.1f} req/s  "
+          f"acceptance {spec.get('draft_acceptance')}  "
+          f"retraces {spec['retraces_after_warmup']}")
+    spec8 = bench_autoreg_continuous(
+        model, workload, concurrency, duration_s, max_slots=max_slots,
+        max_prompt=max_prompt,
+        engine_kwargs={"draft_tokens": draft, "kv_dtype": "int8"})
+    spec8["mode"] = "continuous_spec_int8"
+    print(f"spec int8 {spec8['decode_tokens_per_sec']:>9.1f} tok/s  "
+          f"{spec8['requests_per_sec']:>7.1f} req/s  "
+          f"acceptance {spec8.get('draft_acceptance')}  "
+          f"retraces {spec8['retraces_after_warmup']}")
+    out = {"plain": plain, "spec": spec, "spec_int8": spec8}
+    if plain["decode_tokens_per_sec"]:
+        out["serve_decode_saturation_speedup_spec"] = round(
+            spec["decode_tokens_per_sec"]
+            / plain["decode_tokens_per_sec"], 2)
+        out["serve_decode_saturation_speedup_spec_int8"] = round(
+            spec8["decode_tokens_per_sec"]
+            / plain["decode_tokens_per_sec"], 2)
+    # acceptance-weighted speedup: tokens emitted per verify forward —
+    # the C-independent-cost (memory-bound accelerator) ceiling
+    if spec.get("draft_acceptance") is not None:
+        out["serve_decode_tokens_per_verify_wave"] = round(
+            1.0 + draft * spec["draft_acceptance"], 2)
+
+    # latency-bound arm: single-stream generation, where the per-wave
+    # fixed cost dominates and speculation pays off in wall-clock
+    lat_plain = bench_autoreg_continuous(
+        model, workload, 1, duration_s, max_slots=1,
+        max_prompt=max_prompt)
+    lat_spec = bench_autoreg_continuous(
+        model, workload, 1, duration_s, max_slots=1,
+        max_prompt=max_prompt, engine_kwargs={"draft_tokens": draft})
+    lat_spec["mode"] = "continuous_spec"
+    out["latency_plain"] = lat_plain
+    out["latency_spec"] = lat_spec
+    print(f"single-stream plain {lat_plain['decode_tokens_per_sec']:>8.1f}"
+          f" tok/s   spec {lat_spec['decode_tokens_per_sec']:>8.1f} tok/s"
+          f"  acceptance {lat_spec.get('draft_acceptance')}")
+    if lat_plain["decode_tokens_per_sec"]:
+        out["serve_decode_speedup_spec"] = round(
+            lat_spec["decode_tokens_per_sec"]
+            / lat_plain["decode_tokens_per_sec"], 2)
+
+    # token-exactness spot check: the speculative engine must emit the
+    # byte-identical tokens the scheduling-free plain reference does
+    eng = serve.ContinuousEngine(
+        model, max_slots=max_slots, prefill_window=max_prompt,
+        draft_tokens=draft).start()
+    exact, checked = True, 0
+    try:
+        for prompt, max_new in workload[:8]:
+            got = eng.generate(prompt, max_new, timeout=120)
+            ref = model.reference_generate(prompt, max_new,
+                                           window=max_prompt)
+            checked += 1
+            if not np.array_equal(got, ref):
+                exact = False
+                break
+    finally:
+        eng.close()
+    out["spec_token_exact"] = exact
+    out["spec_token_exact_checked"] = checked
+    print(f"token-exact spot check: {checked} prompts "
+          f"{'OK' if exact else 'DIVERGED'}")
+
+    # KV density: int8 codes + per-position f32 scales vs the f32 slab
+    p32 = model.new_pool(max_slots=max_slots or 4)
+    p8 = model.new_pool(max_slots=max_slots or 4, dtype="int8")
+    out["kv_slots_per_gb"] = {
+        "float32": p32.slots_per_gb(), "int8": p8.slots_per_gb(),
+        "ratio": round(p8.slots_per_gb() / p32.slots_per_gb(), 2)}
+    print(f"kv slots/GB: f32 {out['kv_slots_per_gb']['float32']}  "
+          f"int8 {out['kv_slots_per_gb']['int8']}  "
+          f"({out['kv_slots_per_gb']['ratio']}x)")
+
+    # honesty stamp: did the Pallas kernel actually trace into the
+    # programs that served this traffic, or did the reference einsum?
+    fs = F.fused_stats()
+    out["paged_pallas_active"] = fs.get("pallas_calls", 0) > 0
+    out["fused_stats"] = {
+        k: fs.get(k, 0) for k in ("paged_attention_calls",
+                                  "pallas_calls", "fallback_calls")}
     return out
 
 
@@ -904,6 +1040,15 @@ def main():
                          "(iteration-level) batching vs the static "
                          "batcher on the same decoder; with --open-loop, "
                          "a Poisson TTFT/TPOT sweep of the engine")
+    ap.add_argument("--decode", action="store_true",
+                    help="decode-speed A/B on the continuous engine: "
+                         "plain vs speculative (draft+verify) vs "
+                         "speculative+int8-KV, with a token-exactness "
+                         "spot check, KV slots/GB density, and the "
+                         "paged-attention honesty stamp")
+    ap.add_argument("--draft", type=int, default=None,
+                    help="speculative draft tokens per wave (default "
+                         "MXNET_SERVE_DRAFT_TOKENS or 4)")
     ap.add_argument("--max-slots", type=int, default=None,
                     help="continuous engine KV slots "
                          "(default MXNET_SERVE_MAX_SLOTS)")
@@ -939,6 +1084,64 @@ def main():
             json.dump(out, f, indent=1)
         print(json.dumps(out))
         return 1
+
+    if args.decode:
+        draft = args.draft if args.draft is not None else int(
+            os.environ.get("MXNET_SERVE_DRAFT_TOKENS") or 4)
+        out = {"meta": {"bench": "serve_bench", "mode": "decode",
+                        "quick": bool(args.quick),
+                        "concurrency": args.concurrency,
+                        "duration_s": duration,
+                        "draft_tokens": draft,
+                        "host_cores": os.cpu_count(),
+                        "platform": "cpu"}}
+        model, workload, max_prompt, t_max = _build_autoreg(args.quick)
+        slots = args.max_slots or min(32, args.concurrency)
+        out["meta"]["max_slots"] = slots
+        out["meta"]["model"] = model.config.as_dict()
+        out["meta"]["workload"] = {
+            "n": len(workload), "max_prompt": max_prompt,
+            "t_max": t_max,
+            "mean_new_tokens": round(float(np.mean(
+                [m for _, m in workload])), 2)}
+        out.update(bench_decode_ab(model, workload, args.concurrency,
+                                   duration, max_slots=slots,
+                                   max_prompt=max_prompt, draft=draft))
+        # benchdiff trend key: the speculative path's wall-clock tokens/s
+        # in its deployment regime (single-stream latency-bound decode —
+        # the saturation arm's plain key stays with serve_continuous)
+        out["serve_decode_tokens_per_sec_spec"] = \
+            out["latency_spec"]["decode_tokens_per_sec"]
+        if out.get("serve_decode_speedup_spec"):
+            print(f"speculative decoding speedup (single-stream): "
+                  f"{out['serve_decode_speedup_spec']}x decode tokens/s")
+        out["note"] = (
+            "serve_bench --decode: plain vs speculative (draft+verify) "
+            "vs speculative+int8-KV on the r14 autoregressive workload, "
+            "same decoder, same host. CPU round: the Pallas "
+            "paged-attention kernel falls back to the masked-einsum "
+            "reference (paged_pallas_active=false) and the C-wide verify "
+            "forward is compute-bound (costs ~C x a single-token step), "
+            "so at concurrency-32 saturation speculation cannot beat "
+            "plain batching in wall-clock here - the committed speedup "
+            "is the single-stream latency-bound arm (speculation's "
+            "deployment regime), where the win is realized on this host "
+            "too; serve_decode_tokens_per_verify_wave is the "
+            "acceptance-weighted ceiling a memory-bound accelerator "
+            "converts to wall-clock at saturation. The TPU win is "
+            "measured by re-running this mode on-chip.")
+        out["backend_ok"] = True
+        try:
+            from incubator_mxnet_tpu import telemetry
+            out["telemetry"] = telemetry.scalar_snapshot()
+        except Exception:
+            pass
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {args.out}")
+        return 0
 
     if args.autoregressive:
         out = {"meta": {"bench": "serve_bench", "mode": "autoregressive",

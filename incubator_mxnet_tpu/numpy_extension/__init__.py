@@ -313,6 +313,39 @@ def _register_flash_attention():
 
 _flash_key = _register_flash_attention()
 
+
+def paged_attention(query, k_slab, v_slab, lengths, layer,
+                    k_scale=None, v_scale=None, interpret=None):
+    """Paged decode attention over a serve.kv_pool KV slab — the
+    ops.fused block-sparse decode kernel registered as a first-class op
+    (dispatch record + AMP class). `query` is (S, C, H, D) chunk queries;
+    lane s reads slab row s of `layer`, positions `[0, lengths[s] + j]`;
+    `k_scale`/`v_scale` dequantize int8 slabs per position."""
+    from ..ops import fused as _fused
+    kw = dict(layer=int(layer), interpret=interpret)
+    info = get_op("npx.paged_attention")
+    arrs = [_as_nd(query), _as_nd(k_slab), _as_nd(v_slab),
+            _as_nd(lengths)]
+    fn = _fused.paged_attention
+    if k_scale is not None:
+        arrs.extend([_as_nd(k_scale), _as_nd(v_scale)])
+        call = lambda q, k, v, ln, ks, vs: fn(q, k, v, ln, k_scale=ks,
+                                              v_scale=vs, **kw)
+    else:
+        call = lambda q, k, v, ln: fn(q, k, v, ln, **kw)
+    return invoke(call, tuple(arrs), name="paged_attention", op=info,
+                  key=record_key(_paged_key, kw))
+
+
+def _register_paged_attention():
+    from ..ops import fused as _fused
+    register_op("npx.paged_attention", _fused.paged_attention,
+                amp=_fused.paged_attention._amp_class)
+    return _segment.derive_key_cached(_fused.paged_attention)
+
+
+_paged_key = _register_paged_attention()
+
 # layout-sensitive kernels get dispatch records too (PR 8): the npx
 # wrappers below stamp each call's layout onto the record (note_layout),
 # making the NHWC/NCHW choice introspectable next to the AMP class.
@@ -326,7 +359,7 @@ del _kn, _k
 
 __all__ += ["fused_bias_act", "fused_norm_act_residual",
             "fused_bn_inference", "fused_avg_pool2d", "fused_batch_norm",
-            "fused_image_augment", "flash_attention"]
+            "fused_image_augment", "flash_attention", "paged_attention"]
 
 
 def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, **kwargs):

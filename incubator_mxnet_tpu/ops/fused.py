@@ -58,8 +58,10 @@ from ..telemetry.registry import stats_group as _stats_group
 from . import pallas_kernels as _pk
 
 __all__ = ["bias_act", "norm_act_residual", "bn_inference", "batch_norm",
-           "avg_pool2d", "image_augment", "bias_act_ref",
+           "avg_pool2d", "image_augment", "paged_attention",
+           "bias_act_ref",
            "norm_act_residual_ref", "bn_inference_ref", "avg_pool2d_ref",
+           "paged_attention_ref",
            "fusion_scope",
            "fusion_enabled", "set_fusion_default", "set_use_fusion",
            "set_interpret", "fused_stats", "FUSED_STATS", "FUSABLE_ACTS"]
@@ -70,6 +72,8 @@ FUSED_STATS = _stats_group("fused", {
     "pallas_calls": 0,       # dispatches that took a Pallas kernel path
     "fallback_calls": 0,     # dispatches served by the jnp composition
     "device_augment_calls": 0,  # image_augment programs built (per trace)
+    "paged_attention_calls": 0,  # paged_attention dispatches (per trace
+                                 # inside the jitted decode programs)
 })
 _STATS = FUSED_STATS
 
@@ -216,6 +220,62 @@ def bn_inference_ref(x, gamma, beta, mean, var, eps=1e-5, axis=-1,
     """Unfused composition of bn_inference."""
     scale, shift = _fold_bn(gamma, beta, mean, var, eps)
     return _ref_apply(x, scale, shift, residual, act_type, axis)
+
+
+def paged_attention_ref(q, k_slab, v_slab, lengths, layer,
+                        k_scale=None, v_scale=None):
+    """Unfused composition of paged decode attention over the serve
+    KV-pool slab — the fallback and parity oracle. Reads the WHOLE
+    (S, T) page per lane and masks to `[0, lengths + j]` per chunk
+    query j (the O(max_len) path the Pallas kernel's block-sparse
+    clamped reads replace).
+
+    `q`: (S, C, H, D) — C chunk queries per lane at positions
+    `lengths[s] + j`. `k_slab`/`v_slab`: (rows, layers, T, H, D) with
+    rows > S (lane s reads row s). `k_scale`/`v_scale`: optional
+    per-position f32 dequant scales (rows, layers, T) for int8 slabs."""
+    import jax
+    jnp = _jnp()
+    s_lanes, c, _h, d = q.shape
+    t = k_slab.shape[2]
+    kk = k_slab[:s_lanes, layer]
+    vv = v_slab[:s_lanes, layer]
+    if k_scale is not None:
+        kk = kk.astype(jnp.float32) * k_scale[:s_lanes, layer][..., None,
+                                                               None]
+    if v_scale is not None:
+        vv = vv.astype(jnp.float32) * v_scale[:s_lanes, layer][..., None,
+                                                               None]
+    scores = jnp.einsum("schd,sthd->shct", q, kk) * (1.0 / float(d) ** 0.5)
+    pos = jnp.arange(t)
+    mask = pos[None, None, :] <= (lengths[:, None, None]
+                                  + jnp.arange(c)[None, :, None])
+    scores = jnp.where(mask[:, None], scores, -1e30)
+    att = jnp.einsum("shct,sthd->schd",
+                     jax.nn.softmax(scores, axis=-1), vv)
+    return att.astype(q.dtype)
+
+
+def paged_attention(q, k_slab, v_slab, lengths, layer,
+                    k_scale=None, v_scale=None, interpret=None):
+    """Paged decode attention over the slotted KV slab — the serve
+    engine's per-layer attention read, in place (no per-layer copy of
+    the cache). Routes to the Pallas block-sparse kernel on TPU (or in
+    interpret mode for CPU CI) and to the identical masked-einsum
+    composition otherwise; the choice is static per trace. Honors the
+    MXNET_USE_FUSION kill switch (falls back, never fails)."""
+    interpret = _interpret() if interpret is None else interpret
+    _STATS["paged_attention_calls"] += 1
+    if (_on_tpu() or interpret) and _env_use_fusion():
+        out = _pk.paged_attention_fwd(q, k_slab, v_slab, lengths, layer,
+                                      k_scale=k_scale, v_scale=v_scale,
+                                      interpret=interpret)
+        if out is not None:
+            _STATS["pallas_calls"] += 1
+            return out
+    _STATS["fallback_calls"] += 1
+    return paged_attention_ref(q, k_slab, v_slab, lengths, layer,
+                               k_scale=k_scale, v_scale=v_scale)
 
 
 def avg_pool2d_ref(x, pool_size, layout="NHWC"):
@@ -552,6 +612,7 @@ def avg_pool2d(x, pool_size, layout="NHWC", interpret=None):
 # family, pinned f32 like ops.nn.batch_norm. Pooling matches nn.pooling.
 for _f, _cls in ((bias_act, "safe"), (norm_act_residual, "unsafe"),
                  (bn_inference, "unsafe"), (batch_norm, "unsafe"),
-                 (avg_pool2d, "safe"), (image_augment, "neutral")):
+                 (avg_pool2d, "safe"), (image_augment, "neutral"),
+                 (paged_attention, "safe")):
     _f._amp_class = _cls
 del _f, _cls

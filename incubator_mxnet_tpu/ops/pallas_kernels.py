@@ -16,6 +16,16 @@ kernels instead:
     VMEM-tiled backward: the gradient is an in-register broadcast of the
     upstream tile instead of XLA's generic reduce-window gradient scatter
     (the 0.18-intensity `reduce-window` offender class).
+  * `paged_attention_fwd` — decode attention over the serve.kv_pool
+    slotted KV slab, read IN PLACE (no per-layer gather/copy of the
+    `(slots, max_len, ...)` cache). Block-sparse: per-lane `lengths`
+    are scalar-prefetched so the token-block index map CLAMPS to each
+    lane's `[0, cur_len + C)` — blocks past a lane's live prefix are
+    never fetched from HBM (the clamped index revisits the last live
+    block, whose copy is elided) and their compute is `pl.when`-skipped.
+    Online-softmax VMEM accumulators carry across the sequential token
+    grid. Optional per-position f32 scales dequantize int8 slabs on the
+    fly (serve.kv_pool `dtype="int8"`).
 
 Everything here takes and returns raw jax arrays and is shape-strict: the
 caller (ops/fused.py) owns fallback policy, custom_vjp wiring and layout
@@ -31,7 +41,7 @@ from __future__ import annotations
 import functools
 
 __all__ = ["apply_scale_shift_act", "avg_pool2d_fwd", "avg_pool2d_bwd",
-           "supported_act", "ACTS"]
+           "paged_attention_fwd", "supported_act", "ACTS"]
 
 # activation set the kernels (and their hand-derived VJPs) support; None
 # means identity. Kept in sync with ops/fused.py's dispatch tables.
@@ -191,6 +201,164 @@ def avg_pool2d_fwd(x, ph, pw, interpret=False):
         out_shape=jax.ShapeDtypeStruct((n, h // ph, w // pw, c), x.dtype),
         interpret=interpret,
     )(x)
+
+
+# ---------------------------------------------------------------------------
+# paged decode attention over the slotted KV slab
+# ---------------------------------------------------------------------------
+def _paged_attn_kernel(lens_ref, *refs, bt, n_blocks, chunk, scale,
+                       quantized):
+    """One (lane, token-block) grid step of paged decode attention.
+
+    Grid is (S, nT) with the token dimension minor, so the VMEM scratch
+    accumulators (running max `m`, normalizer `l`, weighted sum `acc`)
+    persist across a lane's sequential token blocks — classic online
+    softmax. `lens_ref` is scalar-prefetched: block `t` only computes
+    when `t*bt <= len + chunk - 1` (the index map already clamped its
+    HBM fetch to the live prefix)."""
+    import jax
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+
+    it = iter(refs)
+    q_ref = next(it)
+    k_ref = next(it)
+    v_ref = next(it)
+    ks_ref = next(it) if quantized else None
+    vs_ref = next(it) if quantized else None
+    o_ref = next(it)
+    m_ref = next(it)
+    l_ref = next(it)
+    acc_ref = next(it)
+
+    s = pl.program_id(0)
+    t = pl.program_id(1)
+    lane_len = lens_ref[s]
+
+    @pl.when(t == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(t * bt <= lane_len + chunk - 1)
+    def _accumulate():
+        qf = q_ref[0].astype(jnp.float32)          # (C, H, D)
+        kf = k_ref[0, 0].astype(jnp.float32)       # (bt, H, D)
+        vf = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            kf = kf * ks_ref[0, 0].astype(jnp.float32)[:, None, None]
+            vf = vf * vs_ref[0, 0].astype(jnp.float32)[:, None, None]
+        sco = jnp.einsum("chd,thd->hct", qf, kf) * scale
+        # query j (the j-th chunk position) may read KV positions
+        # [0, lane_len + j]: the in-chunk causal extension of the
+        # engine's `t <= lengths` decode mask
+        pos = t * bt + jax.lax.broadcasted_iota(jnp.int32, (chunk, bt), 1)
+        qoff = jax.lax.broadcasted_iota(jnp.int32, (chunk, bt), 0)
+        valid = pos <= lane_len + qoff
+        sco = jnp.where(valid[None], sco, -1e30)
+        m_prev = m_ref[...]                        # (H, C)
+        m_new = jnp.maximum(m_prev, jnp.max(sco, axis=-1))
+        p = jnp.exp(sco - m_new[..., None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1)
+        acc_ref[...] = (acc_ref[...] * alpha[..., None]
+                        + jnp.einsum("hct,thd->hcd", p, vf))
+        m_ref[...] = m_new
+
+    @pl.when(t == n_blocks - 1)
+    def _finalize():
+        acc = acc_ref[...]
+        l = l_ref[...]
+        o_ref[0] = (acc / l[..., None]).transpose(1, 0, 2) \
+            .astype(o_ref.dtype)
+
+
+def _paged_blocks(t, c, h, d):
+    """Token-block size for paged attention: the largest power-of-two
+    divisor of `t` whose k+v(+scale) working set stays inside the VMEM
+    budget alongside the per-lane q/out/accumulator buffers, or 0."""
+    fixed = (3 * c * h * d + 2 * c * h) * 4     # q, out, acc, m, l
+    if fixed + 2 * h * d * 4 > _VMEM_BUDGET:
+        return 0
+    bt = t & -t                                  # largest 2^k dividing t
+    while bt > 1 and fixed + 2 * bt * h * (d + 1) * 4 > _VMEM_BUDGET:
+        bt //= 2
+    if fixed + 2 * bt * h * (d + 1) * 4 > _VMEM_BUDGET:
+        return 0
+    return bt
+
+
+def paged_attention_fwd(q, k_slab, v_slab, lengths, layer,
+                        k_scale=None, v_scale=None, interpret=False):
+    """Pallas paged decode attention. `q`: (S, C, H, D) — C queries per
+    lane at positions `lengths[s] + j` (C == 1 plain decode, C == k+1
+    speculative verify). `k_slab`/`v_slab`: the whole KV pool slab
+    (rows, layers, T, H, D); lane s reads row s of layer `layer`,
+    positions clamped to `[0, lengths[s] + j]`. `k_scale`/`v_scale`:
+    per-position f32 dequant scales (rows, layers, T) for int8 slabs.
+    Returns (S, C, H, D) in q.dtype, or None when the shape does not
+    tile (caller falls back)."""
+    import jax
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    import jax.numpy as jnp
+
+    s_lanes, c, h, d = q.shape
+    t = k_slab.shape[2]
+    if k_slab.shape[0] <= s_lanes or k_slab.shape[1] <= layer:
+        return None
+    quantized = k_scale is not None
+    bt = _paged_blocks(t, c, h, d)
+    if bt == 0 or t % bt:
+        return None
+    n_blocks = t // bt
+    scale = 1.0 / float(d) ** 0.5
+
+    def qidx(s, tt, lens_ref):
+        return (s, 0, 0, 0)
+
+    def kidx(s, tt, lens_ref):
+        # clamp the fetched block to the lane's live prefix: out-of-range
+        # grid steps re-name the last live block (copy elided) and their
+        # compute is skipped in the kernel body
+        need = (lens_ref[s] + c - 1) // bt
+        return (s, layer, jnp.minimum(tt, need), 0, 0)
+
+    def sidx(s, tt, lens_ref):
+        need = (lens_ref[s] + c - 1) // bt
+        return (s, layer, jnp.minimum(tt, need))
+
+    in_specs = [
+        pl.BlockSpec((1, c, h, d), qidx),
+        pl.BlockSpec((1, 1, bt, h, d), kidx),
+        pl.BlockSpec((1, 1, bt, h, d), kidx),
+    ]
+    args = [lengths, q, k_slab, v_slab]
+    if quantized:
+        in_specs.append(pl.BlockSpec((1, 1, bt), sidx))
+        in_specs.append(pl.BlockSpec((1, 1, bt), sidx))
+        args.extend([k_scale, v_scale])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_lanes, n_blocks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, c, h, d), qidx),
+        scratch_shapes=[
+            pltpu.VMEM((h, c), jnp.float32),
+            pltpu.VMEM((h, c), jnp.float32),
+            pltpu.VMEM((h, c, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_attn_kernel, bt=bt, n_blocks=n_blocks,
+        chunk=c, scale=scale, quantized=quantized)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_lanes, c, h, d), q.dtype),
+        interpret=interpret,
+    )(*args)
 
 
 def avg_pool2d_bwd(dy, h, w, ph, pw, interpret=False):

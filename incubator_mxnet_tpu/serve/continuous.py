@@ -46,9 +46,44 @@ closes the root at retirement — while the profiler collects, the whole
 request renders as a single tree in the Chrome trace.
 
 The bundled `CachedDecoder` is a small pre-norm transformer decoder over
-the slot pool (greedy argmax decoding, deterministic) — the LLM-shaped
-model side for tests and the bench; any object with the same
-`prefill`/`decode`/`compile_cache_size` contract serves.
+the slot pool — the LLM-shaped model side for tests and the bench; any
+object with the same `prefill`/`decode`/`compile_cache_size` contract
+serves.
+
+Decode raw speed (the ROADMAP item-2 axes), all inside the SAME two
+fixed-shape programs so the zero-retrace contract survives untouched:
+
+  * **Sampling as data**: temperature/top-k/top-p and a per-lane PRNG
+    key ride into the compiled programs as (S,)-shaped ARRAYS (the PR-9
+    key-as-data idiom) — a mixed greedy/sampled batch is just different
+    array values through one program. The per-token key is
+    `fold_in(request_key, position)`, a pure function of the token's
+    page position, so the key schedule is WAVE-INVARIANT: the engine
+    (any decode_steps, any join/leave pattern) and the 1-slot
+    `reference_generate` twin draw identical tokens.
+  * **Speculative decoding** (`draft_tokens > 0`): each scan micro-step
+    drafts k tokens by prompt-lookup (latest n-gram match over the
+    lane's token page history, passed in as a fixed (S, max_len) array)
+    and verifies them with ONE chunked forward over the k+1 positions —
+    KV for the whole chunk scatters into the slot page, queries mask to
+    `[0, cur_len + j]`. The longest draft prefix that EXACTLY matches
+    the base model's own choice is emitted plus one bonus token, so
+    output token streams are identical to non-speculative decoding for
+    greedy AND sampled lanes (exact-match verification); acceptance
+    counts are in-scan data, so acceptance variance never changes
+    program shapes. Rejected-position KV is dead by construction: the
+    next chunk overwrites positions `[cur_len, cur_len+k]` before any
+    mask can reach them.
+  * **Paged attention**: the decode-side attention read is
+    `ops.fused.paged_attention` — a Pallas kernel over the slotted slab
+    with block-sparse reads clamped to each lane's live prefix (TPU, or
+    `MXNET_FUSION_INTERPRET=1` for CPU CI) and the identical
+    masked-einsum jnp fallback elsewhere.
+  * **int8 KV** (`kv_dtype="int8"`): the pool stores int8 codes + f32
+    per-position scales; writes quantize by per-position absmax over
+    (heads, head_dim), reads dequantize in the attention op. A
+    position's scale is written exactly once with its KV, so the stale-
+    scale story is the stale-KV story (same mask, same poison test).
 """
 from __future__ import annotations
 
@@ -136,6 +171,115 @@ def _rmsnorm(x, scale):
     return x * scale / jnp.sqrt(var + 1e-6)
 
 
+def _seed_key(seed):
+    """Host-side PRNG key bytes for a request seed — the same uint32
+    pair `jax.random.PRNGKey(seed)` holds, built without a device
+    round-trip so submit() stays cheap."""
+    seed = int(seed) & 0xFFFFFFFFFFFFFFFF
+    return _np.array([(seed >> 32) & 0xFFFFFFFF, seed & 0xFFFFFFFF],
+                     dtype=_np.uint32)
+
+
+def _sample_tokens(logits, temps, top_ks, top_ps, keys, positions):
+    """Per-lane next-token choice with sampling params AS DATA: every
+    lane runs the same temperature/top-k/top-p/categorical math and a
+    `temps > 0` select keeps greedy lanes exactly argmax — one compiled
+    program serves any greedy/sampled mix. The draw key is
+    `fold_in(lane_key, position)` (position = the query token's cache
+    position), a pure function of request state, so any wave schedule
+    draws the same tokens."""
+    import jax
+    import jax.numpy as jnp
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    srt = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        srt, jnp.clip(top_ks - 1, 0, V - 1)[:, None], axis=-1)
+    keep_k = (top_ks[:, None] <= 0) | (scaled >= kth)
+    probs = jax.nn.softmax(srt, axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    # smallest prefix whose mass reaches top_p (the kept-set INCLUDES
+    # the crossing token, hence the exclusive-cumsum comparison)
+    keepn = jnp.sum((csum - probs) < top_ps[:, None], axis=-1)
+    pth = jnp.take_along_axis(
+        srt, jnp.clip(keepn - 1, 0, V - 1)[:, None], axis=-1)
+    masked = jnp.where(keep_k & (scaled >= pth), scaled, -1e30)
+    kfold = jax.vmap(jax.random.fold_in)(keys, positions)
+    sampled = jax.vmap(
+        lambda kk, lg: jax.random.categorical(kk, lg))(kfold, masked)
+    return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+
+
+_SAMPLE_JIT = None
+
+
+def _sample_first(logits, temps, top_ks, top_ps, keys, positions):
+    """First-token draw from prefill logits through ONE process-wide
+    jitted sampler. The sampling math compiles once per (lanes, vocab)
+    shape for every model and engine in the process, instead of being
+    re-traced into each model's prefill program (the decode program
+    keeps its own in-scan copy, where it must live). Identical math
+    either way, so engine == reference still holds bit-for-bit."""
+    global _SAMPLE_JIT
+    if _SAMPLE_JIT is None:
+        import jax
+        _SAMPLE_JIT = jax.jit(_sample_tokens)
+    return _SAMPLE_JIT(logits, temps, top_ks, top_ps, keys, positions)
+
+
+def _kv_split(cache):
+    """A pool buffer is either a raw slab or a (codes, scales) pair
+    (int8 mode); normalize to (slab, scales_or_None)."""
+    if isinstance(cache, tuple):
+        return cache
+    return cache, None
+
+
+def _quantize_kv(val):
+    """int8 KV codes + f32 scale per written (lane, position): absmax
+    over (heads, head_dim). The scale is final at write time — a
+    position is quantized exactly once, with its KV."""
+    import jax.numpy as jnp
+    a = jnp.max(jnp.abs(val), axis=(-2, -1))
+    s = jnp.maximum(a.astype(jnp.float32), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(val / s[..., None, None]), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def _store_page(cache, rows, l, W, val):
+    """Scatter a (P, W, H, D) KV page into [rows, l, :W] (quantizing
+    into codes+scales when the pool is int8)."""
+    slab, scales = _kv_split(cache)
+    if scales is None:
+        return slab.at[rows, l, :W].set(val)
+    q, s = _quantize_kv(val)
+    return (slab.at[rows, l, :W].set(q), scales.at[rows, l, :W].set(s))
+
+
+def _store_pos(cache, rows, l, wpos, val):
+    """Scatter KV at explicit positions (rows/wpos broadcast to the
+    leading dims of `val`), quantizing when the pool is int8."""
+    slab, scales = _kv_split(cache)
+    if scales is None:
+        return slab.at[rows, l, wpos].set(val)
+    q, s = _quantize_kv(val)
+    return (slab.at[rows, l, wpos].set(q),
+            scales.at[rows, l, wpos].set(s))
+
+
+def _paged_attn(k_cache, v_cache, q, lengths, l):
+    """Decode-side attention read over the slot slab via
+    `ops.fused.paged_attention`: Pallas block-sparse kernel on TPU (or
+    interpret-mode CI), identical masked-einsum jnp fallback elsewhere.
+    q is (S, C, H, D); chunk offset j reads positions [0, lengths+j]."""
+    from ..ops import fused as _fused
+    k_slab, k_scale = _kv_split(k_cache)
+    v_slab, v_scale = _kv_split(v_cache)
+    return _fused.paged_attention(q, k_slab, v_slab, lengths, l,
+                                  k_scale=k_scale, v_scale=v_scale)
+
+
 def _make_prefill(config, window=None):
     """Build the prefill step: full causal forward over the padded prompt
     page, KV written into the claimed slot rows, first token emitted.
@@ -175,8 +319,8 @@ def _make_prefill(config, window=None):
             # positions past `lengths` hold pad-token KV, positions past
             # the window hold the previous tenant's bytes; both are
             # unreachable through the decode mask
-            k_cache = k_cache.at[slot_rows, l, :W].set(k)
-            v_cache = v_cache.at[slot_rows, l, :W].set(v)
+            k_cache = _store_page(k_cache, slot_rows, l, W, k)
+            v_cache = _store_page(v_cache, slot_rows, l, W, v)
             scores = jnp.einsum("pqhd,pkhd->phqk", q, k) * scale
             scores = jnp.where(mask, scores, -1e30)
             att = jnp.einsum("phqk,pkhd->pqhd",
@@ -187,8 +331,10 @@ def _make_prefill(config, window=None):
         xf = _rmsnorm(x, params["lnf"])
         last = xf[jnp.arange(P), jnp.maximum(lengths - 1, 0)]   # (P, E)
         logits = last @ params["emb"].T
-        first_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return k_cache, v_cache, first_tok
+        # the FIRST token is drawn from these logits by the caller
+        # (`_sample_first`, the process-shared sampler program) at fold
+        # position lengths-1, continuing into decode at `lengths`
+        return k_cache, v_cache, logits
 
     return prefill
 
@@ -204,17 +350,19 @@ def _make_decode(config, steps=1, eos_id=None):
     to wave granularity, TTFT stays prefill-bound.
 
     Signature: `decode(params, k_cache, v_cache, tokens, lengths,
-    steps_left) -> (k_cache, v_cache, out_tokens (steps, S), emitted)`.
-    `emitted[s]` is the EXACT number of tokens lane s produced this wave
-    (rows [0:emitted] of its column) — counted in-scan, because deriving
-    it from the steps_left delta would overcount when `eos_id` zeroes a
-    lane's remaining budget mid-wave."""
+    steps_left, temps, top_ks, top_ps, keys) -> (k_cache, v_cache,
+    out_tokens (steps, S), emitted)`. `emitted[s]` is the EXACT number
+    of tokens lane s produced this wave (rows [0:emitted] of its
+    column) — counted in-scan, because deriving it from the steps_left
+    delta would overcount when `eos_id` zeroes a lane's remaining
+    budget mid-wave. Sampling params ride as (S,) data (greedy lane =
+    temp 0), so a mixed batch replays the one program."""
     import jax
     import jax.numpy as jnp
     c = config
-    scale = 1.0 / _np.sqrt(c.head_dim)
 
-    def micro(params, k_cache, v_cache, tokens, lengths, active):
+    def micro(params, k_cache, v_cache, tokens, lengths, active,
+              temps, top_ks, top_ps, keys):
         # one token for every active lane. tokens (S,) int32 last emitted
         # token; lengths (S,) int32 current cache length (the new token's
         # KV lands at position `lengths`); active (S,) bool
@@ -226,33 +374,32 @@ def _make_decode(config, steps=1, eos_id=None):
         # attention reads positions 0..lengths INCLUSIVE (the new token's
         # KV is written before the read); anything past that — pad-token
         # KV from prefill or a previous tenant's garbage — is masked
-        tmask = jnp.arange(T)[None, :] <= lengths[:, None]   # (S, T)
+        # inside paged_attention's [0, lengths + chunk_offset] clamp
         for l in range(c.layers):
             h = _rmsnorm(x, params["ln1"][l])
             q = (h @ params["wq"][l]).reshape(S, c.heads, c.head_dim)
             k = (h @ params["wk"][l]).reshape(S, c.heads, c.head_dim)
             v = (h @ params["wv"][l]).reshape(S, c.heads, c.head_dim)
-            k_cache = k_cache.at[rows, l, wpos].set(k)
-            v_cache = v_cache.at[rows, l, wpos].set(v)
-            K = k_cache[:S, l]                           # (S, T, H, D)
-            V = v_cache[:S, l]
-            scores = jnp.einsum("shd,sthd->sht", q, K) * scale
-            scores = jnp.where(tmask[:, None, :], scores, -1e30)
-            att = jnp.einsum("sht,sthd->shd",
-                             jax.nn.softmax(scores, axis=-1), V)
+            k_cache = _store_pos(k_cache, rows, l, wpos, k)
+            v_cache = _store_pos(v_cache, rows, l, wpos, v)
+            att = _paged_attn(k_cache, v_cache, q[:, None], lengths,
+                              l)[:, 0]
             x = x + att.reshape(S, c.embed) @ params["wo"][l]
             h2 = _rmsnorm(x, params["ln2"][l])
             x = x + jax.nn.gelu(h2 @ params["w1"][l]) @ params["w2"][l]
         logits = _rmsnorm(x, params["lnf"]) @ params["emb"].T
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = _sample_tokens(logits, temps, top_ks, top_ps, keys,
+                             lengths)
         return k_cache, v_cache, jnp.where(active, nxt, 0)
 
-    def decode(params, k_cache, v_cache, tokens, lengths, steps_left):
+    def decode(params, k_cache, v_cache, tokens, lengths, steps_left,
+               temps, top_ks, top_ps, keys):
         def step(carry, _):
             k_cache, v_cache, last, lens, left, emitted = carry
             act = left > 0
             k_cache, v_cache, nxt = micro(params, k_cache, v_cache,
-                                          last, lens, act)
+                                          last, lens, act,
+                                          temps, top_ks, top_ps, keys)
             new_left = jnp.where(act, left - 1, left)
             if eos_id is not None:
                 new_left = jnp.where(act & (nxt == eos_id), 0, new_left)
@@ -268,6 +415,143 @@ def _make_decode(config, steps=1, eos_id=None):
         return k_cache, v_cache, toks, emitted
 
     return decode
+
+
+def _make_spec_decode(config, steps=1, eos_id=None, draft=2):
+    """Build the SPECULATIVE decode step: each of the `steps` scan
+    micro-steps advances every active lane by up to `draft + 1` tokens
+    — k drafted by prompt-lookup (latest n-gram match in the lane's
+    token history page) plus one bonus token, verified by ONE chunked
+    forward over the k+1 positions. Acceptance is EXACT match against
+    the base model's own next-token choice, so the emitted stream is
+    token-identical to non-speculative decode (greedy and sampled);
+    acceptance counts are in-scan DATA, so acceptance variance never
+    changes program shapes and the zero-retrace contract holds.
+
+    Safety of the chunk writes:
+      * a REJECTED position's KV is stale, but the lane's next chunk
+        starts at its new length and rewrites [len, len+k] before any
+        mask can expose them;
+      * near the page end, write positions clip to max_len-1 and may
+        collide — only queries whose outputs are DISCARDED (offset >=
+        emitted count) ever sit past max_len-2, and a query only reads
+        positions <= its own, so the clipped junk is unreachable from
+        any emitted token.
+
+    Signature: `spec(params, k_cache, v_cache, tokens, lengths,
+    steps_left, temps, top_ks, top_ps, keys, token_buf) -> (k_cache,
+    v_cache, tok_blocks (steps, S, draft+1), n_emits (steps, S),
+    emitted (S,), accepted (S,), rejected (S,))`. `token_buf` is the
+    (S, max_len) token history page (prompt + generated so far; entries
+    [0, lengths] valid) — the draft source, updated in-scan exactly as
+    a host rebuild would, so wave boundaries stay invisible. Lane s's
+    wave output is rows `tok_blocks[i, s, :n_emits[i, s]]` in scan
+    order; accepted/rejected count draft tokens for telemetry."""
+    import jax
+    import jax.numpy as jnp
+    c = config
+    draft = int(draft)
+    if draft < 1:
+        raise ServeError(f"draft must be >= 1, got {draft}")
+    C = draft + 1
+
+    def micro(params, k_cache, v_cache, last, lens, act, left,
+              temps, top_ks, top_ps, keys, token_buf):
+        S = last.shape[0]
+        T = c.max_len
+        rows = jnp.where(act, jnp.arange(S), S)          # garbage row
+        coffs = jnp.arange(C)
+        # -- prompt-lookup draft: the LATEST earlier occurrence of the
+        # current tail token predicts its historical successors
+        idx = jnp.arange(T)
+        hit = (idx[None, :] < lens[:, None]) & (token_buf == last[:, None])
+        p = jnp.max(jnp.where(hit, idx[None, :], -1), axis=1)    # (S,)
+        dsrc = p[:, None] + 1 + jnp.arange(draft)[None, :]       # (S, k)
+        ok = (p[:, None] >= 0) & (dsrc <= lens[:, None])
+        cand = jnp.take_along_axis(token_buf, jnp.clip(dsrc, 0, T - 1),
+                                   axis=1)
+        drafts = jnp.where(ok, cand, last[:, None])              # (S, k)
+        # -- ONE verify forward over the whole chunk [last, drafts...]
+        chunk = jnp.concatenate([last[:, None], drafts], axis=1)  # (S, C)
+        wposs = jnp.clip(lens[:, None] + coffs[None, :], 0, T - 1)
+        x = params["emb"][chunk] + params["pos"][wposs]   # (S, C, E)
+        for l in range(c.layers):
+            h = _rmsnorm(x, params["ln1"][l])
+            q = (h @ params["wq"][l]).reshape(S, C, c.heads, c.head_dim)
+            k = (h @ params["wk"][l]).reshape(S, C, c.heads, c.head_dim)
+            v = (h @ params["wv"][l]).reshape(S, C, c.heads, c.head_dim)
+            k_cache = _store_pos(k_cache, rows[:, None], l, wposs, k)
+            v_cache = _store_pos(v_cache, rows[:, None], l, wposs, v)
+            att = _paged_attn(k_cache, v_cache, q, lens, l)
+            x = x + att.reshape(S, C, c.embed) @ params["wo"][l]
+            h2 = _rmsnorm(x, params["ln2"][l])
+            x = x + jax.nn.gelu(h2 @ params["w1"][l]) @ params["w2"][l]
+        logits = _rmsnorm(x, params["lnf"]) @ params["emb"].T  # (S,C,V)
+        # -- the base model's own choice at EVERY chunk position, keyed
+        # by that position — identical draws to non-spec decode
+        positions = (lens[:, None] + coffs[None, :]).reshape(-1)
+        base_next = _sample_tokens(
+            logits.reshape(S * C, -1),
+            jnp.repeat(temps, C), jnp.repeat(top_ks, C),
+            jnp.repeat(top_ps, C), jnp.repeat(keys, C, axis=0),
+            positions).reshape(S, C)
+        # -- accept the longest draft prefix the base model agrees with,
+        # plus the bonus token sampled after it; cap to the lane budget
+        match = jnp.cumprod(
+            (drafts == base_next[:, :draft]).astype(jnp.int32), axis=1)
+        n = jnp.minimum(jnp.sum(match, axis=1) + 1, left)
+        if eos_id is not None:
+            is_eos = (base_next == eos_id) & (coffs[None, :] < n[:, None])
+            n = jnp.where(jnp.any(is_eos, axis=1),
+                          jnp.argmax(is_eos, axis=1) + 1, n)
+        n = jnp.where(act, n, 0)
+        new_last = jnp.where(
+            act,
+            jnp.take_along_axis(base_next,
+                                jnp.maximum(n - 1, 0)[:, None],
+                                axis=1)[:, 0],
+            last)
+        new_lens = lens + n
+        # -- history page update, exactly what a host rebuild would hold:
+        # chunk token at each written position, new tail at new_lens
+        buf2 = token_buf.at[jnp.arange(S)[:, None], wposs].set(
+            jnp.concatenate([last[:, None], base_next[:, :draft]],
+                            axis=1))
+        buf2 = buf2.at[jnp.arange(S),
+                       jnp.clip(new_lens, 0, T - 1)].set(new_last)
+        token_buf = jnp.where(act[:, None], buf2, token_buf)
+        return (k_cache, v_cache, token_buf, base_next, n, new_last,
+                new_lens)
+
+    def spec(params, k_cache, v_cache, tokens, lengths, steps_left,
+             temps, top_ks, top_ps, keys, token_buf):
+        def step(carry, _):
+            (k_cache, v_cache, last, lens, left, emitted, buf,
+             acc, rej) = carry
+            act = left > 0
+            (k_cache, v_cache, buf, base_next, n, new_last,
+             new_lens) = micro(params, k_cache, v_cache, last, lens,
+                               act, left, temps, top_ks, top_ps, keys,
+                               buf)
+            left = jnp.where(act, left - n, left)
+            if eos_id is not None:
+                left = jnp.where(act & (n > 0) & (new_last == eos_id),
+                                 0, left)
+            emitted = emitted + n
+            acc = acc + jnp.where(act, n - 1, 0)
+            rej = rej + jnp.where(act, draft - (n - 1), 0)
+            return ((k_cache, v_cache, new_last, new_lens, left,
+                     emitted, buf, acc, rej), (base_next, n))
+
+        zero = jnp.zeros_like(steps_left)
+        carry0 = (k_cache, v_cache, tokens, lengths, steps_left, zero,
+                  token_buf, zero, zero)
+        ((k_cache, v_cache, _, _, _, emitted, _, acc, rej),
+         (tok_blocks, n_emits)) = jax.lax.scan(step, carry0, None,
+                                               length=steps)
+        return k_cache, v_cache, tok_blocks, n_emits, emitted, acc, rej
+
+    return spec
 
 
 class CachedDecoder:
@@ -304,6 +588,21 @@ class CachedDecoder:
         self._prefill = self.prefill_program(config.max_len)
         self._decode = self.decode_program(1, None)
 
+    @staticmethod
+    def _greedy_defaults(jnp, n, temps, top_ks, top_ps, keys):
+        """Fill missing sampling arrays with the greedy encoding (temp
+        0 selects argmax in-program) so pre-sampling call sites keep
+        working unchanged."""
+        if temps is None:
+            temps = jnp.zeros((n,), dtype=jnp.float32)
+        if top_ks is None:
+            top_ks = jnp.zeros((n,), dtype=jnp.int32)
+        if top_ps is None:
+            top_ps = jnp.ones((n,), dtype=jnp.float32)
+        if keys is None:
+            keys = jnp.zeros((n, 2), dtype=jnp.uint32)
+        return temps, top_ks, top_ps, keys
+
     def new_pool(self, max_slots=None, dtype=None):
         c = self.config
         return KVCachePool(max_slots, layers=c.layers, max_len=c.max_len,
@@ -321,29 +620,56 @@ class CachedDecoder:
             self._prefills[key] = fn
         return fn
 
-    def decode_program(self, steps, eos_id=None):
-        """The jitted decode program for a (steps, eos) variant (built
-        and memoized on first request; the engine asks once at init)."""
+    def decode_program(self, steps, eos_id=None, draft=0):
+        """The jitted decode program for a (steps, eos, draft) variant
+        (built and memoized on first request; the engine asks once at
+        init). `draft > 0` selects the speculative program — a
+        DIFFERENT fixed shape (chunked verify), compiled once like any
+        other variant."""
         import jax
-        key = (int(steps), eos_id)
+        key = (int(steps), eos_id, int(draft))
         fn = self._decodes.get(key)
         if fn is None:
-            fn = jax.jit(_make_decode(self.config, steps=key[0],
-                                      eos_id=eos_id),
-                         donate_argnums=(1, 2))
+            if key[2] > 0:
+                built = _make_spec_decode(self.config, steps=key[0],
+                                          eos_id=eos_id, draft=key[2])
+            else:
+                built = _make_decode(self.config, steps=key[0],
+                                     eos_id=eos_id)
+            fn = jax.jit(built, donate_argnums=(1, 2))
             self._decodes[key] = fn
         return fn
 
-    def prefill(self, k_cache, v_cache, tokens, lengths, slot_rows):
+    def prefill(self, k_cache, v_cache, tokens, lengths, slot_rows,
+                temps=None, top_ks=None, top_ps=None, keys=None):
         # window inferred from the token page width (a compiled program
         # exists per width; the engine always sends its own window)
-        return self.prefill_program(tokens.shape[1])(
+        import jax.numpy as jnp
+        temps, top_ks, top_ps, keys = self._greedy_defaults(
+            jnp, tokens.shape[0], temps, top_ks, top_ps, keys)
+        k_cache, v_cache, logits = self.prefill_program(tokens.shape[1])(
             self.params, k_cache, v_cache, tokens, lengths, slot_rows)
+        first = _sample_first(logits, temps, top_ks, top_ps, keys,
+                              lengths - 1)
+        return k_cache, v_cache, first
 
     def decode(self, k_cache, v_cache, tokens, lengths, steps_left,
-               steps=1, eos_id=None):
-        return self.decode_program(steps, eos_id)(
-            self.params, k_cache, v_cache, tokens, lengths, steps_left)
+               steps=1, eos_id=None, temps=None, top_ks=None,
+               top_ps=None, keys=None, draft=0, token_buf=None):
+        import jax.numpy as jnp
+        temps, top_ks, top_ps, keys = self._greedy_defaults(
+            jnp, tokens.shape[0], temps, top_ks, top_ps, keys)
+        prog = self.decode_program(steps, eos_id, draft)
+        if draft > 0:
+            if token_buf is None:
+                raise ServeError(
+                    "speculative decode (draft > 0) needs token_buf — "
+                    "the (S, max_len) prompt+generated history page")
+            return prog(self.params, k_cache, v_cache, tokens, lengths,
+                        steps_left, temps, top_ks, top_ps, keys,
+                        token_buf)
+        return prog(self.params, k_cache, v_cache, tokens, lengths,
+                    steps_left, temps, top_ks, top_ps, keys)
 
     def compile_cache_size(self):
         """Total compiled programs across every jit (-1 unknown) — the
@@ -356,40 +682,76 @@ class CachedDecoder:
         return sum(sizes)
 
     def reference_generate(self, prompt, max_new_tokens, eos_id=None,
-                           window=None):
-        """Greedy generation through a PRIVATE 1-slot pool — the
+                           window=None, temperature=0.0, top_k=0,
+                           top_p=1.0, seed=0, draft_tokens=0,
+                           kv_dtype=None):
+        """Generation through a PRIVATE 1-slot pool — the
         scheduling-free reference the engine's mixed-batch outputs must
         match token-for-token (tests). Uses the same compiled math; pass
         the engine's `prefill_window` so the prefill page width (and so
-        the float-op layout) matches bit-for-bit."""
+        the float-op layout) matches bit-for-bit. Sampling
+        (`temperature > 0` with the request seed) matches the engine
+        because the draw key is a pure function of (seed, position);
+        `draft_tokens > 0` runs the speculative program one wave at a
+        time with a host-rebuilt history page — same tokens, by the
+        exact-verification contract. `kv_dtype="int8"` mirrors an int8
+        engine pool."""
         import jax.numpy as jnp
-        pool = self.new_pool(max_slots=1)
+        pool = self.new_pool(max_slots=1, dtype=kv_dtype)
         W = int(window if window is not None else self.config.max_len)
         plen = len(prompt)
         if plen < 1 or plen > W or plen >= self.config.max_len:
             raise ServeError(
                 f"prompt length {plen} outside [1, min(window={W}, "
                 f"max_len-1={self.config.max_len - 1})]")
+        temps = jnp.asarray([float(temperature)], dtype=jnp.float32)
+        tks = jnp.asarray([int(top_k)], dtype=jnp.int32)
+        tps = jnp.asarray([float(top_p)], dtype=jnp.float32)
+        keys = jnp.asarray(_seed_key(seed)[None, :])
         toks = _np.zeros((1, W), dtype=_np.int32)
         toks[0, :plen] = prompt
+        k, v = pool.buffers()
         k, v, first = self.prefill(
-            pool.k, pool.v, jnp.asarray(toks),
+            k, v, jnp.asarray(toks),
             jnp.asarray([plen], dtype=jnp.int32),
-            jnp.asarray([0], dtype=jnp.int32))
+            jnp.asarray([0], dtype=jnp.int32),
+            temps, tks, tps, keys)
         pool.swap_buffers(k, v)
         out = [int(first[0])]
         cache_len = plen
+        draft = int(draft_tokens)
         while (len(out) < max_new_tokens
                and (eos_id is None or out[-1] != eos_id)
                and cache_len + 1 < self.config.max_len):
-            k, v, toks, _ = self.decode(
-                pool.k, pool.v,
-                jnp.asarray([out[-1]], dtype=jnp.int32),
-                jnp.asarray([cache_len], dtype=jnp.int32),
-                jnp.asarray([1], dtype=jnp.int32))
-            pool.swap_buffers(k, v)
-            out.append(int(toks[0, 0]))
-            cache_len += 1
+            k, v = pool.buffers()
+            if draft > 0:
+                left = min(max_new_tokens - len(out),
+                           self.config.max_len - 1 - cache_len)
+                buf = _np.zeros((1, self.config.max_len),
+                                dtype=_np.int32)
+                hist = list(prompt) + out
+                buf[0, :len(hist)] = hist
+                k, v, blocks, n_emits, _, _, _ = self.decode(
+                    k, v, jnp.asarray([out[-1]], dtype=jnp.int32),
+                    jnp.asarray([cache_len], dtype=jnp.int32),
+                    jnp.asarray([left], dtype=jnp.int32),
+                    steps=1, eos_id=eos_id, temps=temps, top_ks=tks,
+                    top_ps=tps, keys=keys, draft=draft,
+                    token_buf=jnp.asarray(buf))
+                pool.swap_buffers(k, v)
+                n = int(_np.asarray(n_emits)[0, 0])
+                out.extend(int(t) for t in
+                           _np.asarray(blocks)[0, 0, :n])
+                cache_len += n
+            else:
+                k, v, toks1, _ = self.decode(
+                    k, v, jnp.asarray([out[-1]], dtype=jnp.int32),
+                    jnp.asarray([cache_len], dtype=jnp.int32),
+                    jnp.asarray([1], dtype=jnp.int32),
+                    temps=temps, top_ks=tks, top_ps=tps, keys=keys)
+                pool.swap_buffers(k, v)
+                out.append(int(toks1[0, 0]))
+                cache_len += 1
         return _np.asarray(out, dtype=_np.int32)
 
 
@@ -399,9 +761,10 @@ class CachedDecoder:
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "future", "deadline", "t_submit",
                  "ctx", "slot", "generated", "cache_len", "t_first",
-                 "t_last")
+                 "t_last", "temperature", "top_k", "top_p", "key")
 
-    def __init__(self, prompt, max_new, deadline, ctx):
+    def __init__(self, prompt, max_new, deadline, ctx,
+                 temperature=0.0, top_k=0, top_p=1.0, key=None):
         self.prompt = prompt                 # np.int32 (plen,)
         self.max_new = max_new
         self.future = Future()
@@ -413,6 +776,10 @@ class _GenRequest:
         self.cache_len = 0
         self.t_first = None                  # first token (TTFT anchor)
         self.t_last = None
+        self.temperature = temperature       # 0.0 = greedy lane
+        self.top_k = top_k
+        self.top_p = top_p
+        self.key = key if key is not None else _seed_key(0)  # uint32 (2,)
 
     def sort_key(self):
         """Earliest-deadline-first; deadline-less requests rank after
@@ -452,6 +819,16 @@ class ContinuousEngine:
       default_deadline_ms  queue deadline (MXNET_SERVE_DEADLINE_MS);
                        expiry while WAITING fails fast with
                        RequestTimeout — admitted requests always finish
+      draft_tokens     speculative decode depth k
+                       (MXNET_SERVE_DRAFT_TOKENS, default 0 = off):
+                       each scan micro-step drafts k tokens by
+                       prompt-lookup and verifies them in one chunked
+                       forward; output tokens are IDENTICAL to
+                       draft_tokens=0 (exact-match verification)
+      kv_dtype         KV pool storage dtype; "int8" stores quantized
+                       codes + per-position f32 scales (~4x KV bytes
+                       saved at float32 serving dtype — see
+                       pool.stats()["slots_per_gb"])
 
     Exactly one scheduler thread runs the compiled steps, so the donated
     KV buffers have a single writer; submit() is safe from any thread.
@@ -460,19 +837,27 @@ class ContinuousEngine:
     def __init__(self, model, *, max_slots=None, prefill_budget=None,
                  prefill_lanes=None, prefill_window=None, decode_steps=4,
                  max_queue=None, default_deadline_ms=None, eos_id=None,
+                 draft_tokens=None, kv_dtype=None,
                  name="serve.continuous"):
         self.model = model
         self.name = name
         self.eos_id = eos_id
-        self.pool = model.new_pool(max_slots)
+        self.kv_dtype = kv_dtype
+        self.pool = model.new_pool(max_slots, dtype=kv_dtype)
         self.max_slots = self.pool.max_slots
         # micro-iterations per compiled decode dispatch: >1 amortizes the
         # host round-trip over K tokens; admission/retirement happen at
         # wave granularity (a lane finishing mid-wave holds its slot
         # until the wave ends, never computes past its budget)
         self.decode_steps = max(1, int(decode_steps))
+        self.draft_tokens = int(
+            draft_tokens if draft_tokens is not None
+            else get_env("MXNET_SERVE_DRAFT_TOKENS", 0, typ=int))
+        if self.draft_tokens < 0:
+            raise ServeError("draft_tokens must be >= 0")
         self._decode_prog = model.decode_program(self.decode_steps,
-                                                 eos_id)
+                                                 eos_id,
+                                                 self.draft_tokens)
         # prompt page width: prompts are bounded by it, and the prefill
         # program pays O(window^2) attention instead of O(max_len^2) —
         # size it to the served prompt distribution, not the page
@@ -521,7 +906,9 @@ class ContinuousEngine:
             "requests", "replies", "rejected", "timeouts", "errors",
             "admitted", "retired", "decode_iterations", "decode_tokens",
             "prefill_tokens", "prefill_batches", "programs_compiled",
-            "active_sum")}
+            "active_sum", "sampled_tokens", "draft_accepted",
+            "draft_rejected")}
+        self._auto_seed = 0                  # per-engine seed fountain
         self._ttft_ms = deque(maxlen=4096)
         self._tpot_ms = deque(maxlen=4096)
         self._e2e_ms = deque(maxlen=4096)
@@ -549,23 +936,40 @@ class ContinuousEngine:
         """One garbage-lane prefill + one all-inactive decode: compiles
         (or loads from MXNET_COMPILE_CACHE_DIR) both programs without
         touching any real slot."""
+        import jax
         import jax.numpy as jnp
         g = self.pool.garbage_row
         P = self.prefill_lanes
-        k, v, _ = self._prefill_prog(
-            self.model.params, self.pool.k, self.pool.v,
+        S = self.max_slots
+        kb, vb = self.pool.buffers()
+        lens = jnp.ones((P,), dtype=jnp.int32)
+        k, v, logits = self._prefill_prog(
+            self.model.params, kb, vb,
             jnp.zeros((P, self.prefill_window), dtype=jnp.int32),
-            jnp.ones((P,), dtype=jnp.int32),
-            jnp.full((P,), g, dtype=jnp.int32))
+            lens, jnp.full((P,), g, dtype=jnp.int32))
+        # warm the shared first-token sampler at this (P, vocab) shape
+        # too — it is part of the steady-state prefill wave
+        _sample_first(logits, jnp.zeros((P,), dtype=jnp.float32),
+                      jnp.zeros((P,), dtype=jnp.int32),
+                      jnp.ones((P,), dtype=jnp.float32),
+                      jnp.zeros((P, 2), dtype=jnp.uint32), lens - 1)
         self.pool.swap_buffers(k, v)
-        k, v, _, _ = self._decode_prog(
-            self.model.params, self.pool.k, self.pool.v,
-            jnp.zeros((self.max_slots,), dtype=jnp.int32),
-            jnp.zeros((self.max_slots,), dtype=jnp.int32),
-            jnp.zeros((self.max_slots,), dtype=jnp.int32))
+        kb, vb = self.pool.buffers()
+        args = [self.model.params, kb, vb,
+                jnp.zeros((S,), dtype=jnp.int32),
+                jnp.zeros((S,), dtype=jnp.int32),
+                jnp.zeros((S,), dtype=jnp.int32),
+                jnp.zeros((S,), dtype=jnp.float32),
+                jnp.zeros((S,), dtype=jnp.int32),
+                jnp.ones((S,), dtype=jnp.float32),
+                jnp.zeros((S, 2), dtype=jnp.uint32)]
+        if self.draft_tokens:
+            args.append(jnp.zeros((S, self.max_len), dtype=jnp.int32))
+        out = self._decode_prog(*args)
+        k, v = out[0], out[1]
         self.pool.swap_buffers(k, v)
         # wait for the compiles to actually finish so warmup_s is honest
-        k.block_until_ready()
+        jax.block_until_ready(k)
         self._count("programs_compiled", 2)
 
     def __enter__(self):
@@ -617,10 +1021,26 @@ class ContinuousEngine:
             return len(self._waiting), len(self._running)
 
     # -- submission --------------------------------------------------------
-    def submit(self, prompt_tokens, max_new_tokens=16, deadline_ms=None):
+    def submit(self, prompt_tokens, max_new_tokens=16, deadline_ms=None,
+               temperature=0.0, top_k=0, top_p=1.0, seed=None):
         """Enqueue one generation request; returns a Future resolving to
-        the np.int32 array of generated token ids (greedy; cut at
-        `eos_id`, `max_new_tokens`, or a full KV page)."""
+        the np.int32 array of generated token ids (cut at `eos_id`,
+        `max_new_tokens`, or a full KV page).
+
+        `temperature=0` (default) is greedy; `temperature > 0` samples
+        with optional `top_k`/`top_p` truncation, deterministically in
+        `seed` (auto-assigned from a per-engine counter when omitted).
+        Both kinds share one compiled program — sampling params are
+        array data, never shapes."""
+        temperature = float(temperature)
+        if temperature < 0.0:
+            raise ServeError("temperature must be >= 0")
+        top_k = int(top_k)
+        if top_k < 0:
+            raise ServeError("top_k must be >= 0")
+        top_p = float(top_p)
+        if not 0.0 < top_p <= 1.0:
+            raise ServeError(f"top_p must be in (0, 1], got {top_p}")
         if not self._started:
             raise ServeError(
                 "ContinuousEngine.start() (or `with engine:`) first")
@@ -641,10 +1061,16 @@ class ContinuousEngine:
         _fault.inject("serve.enqueue")
         dl = (deadline_ms / 1e3 if deadline_ms is not None
               else self.default_deadline_s)
+        if seed is None:
+            with self._mlock:
+                seed = self._auto_seed
+                self._auto_seed += 1
         ctx = _trace.request_root("serve.request")
         req = _GenRequest(prompt, int(max_new_tokens),
                           None if dl is None
-                          else time.perf_counter() + dl, ctx)
+                          else time.perf_counter() + dl, ctx,
+                          temperature=temperature, top_k=top_k,
+                          top_p=top_p, key=_seed_key(seed))
         with self._cv:
             if self._closing:
                 # typed split, not one generic ServerClosed: DRAINING means
@@ -675,10 +1101,13 @@ class ContinuousEngine:
         return req.future
 
     def generate(self, prompt_tokens, max_new_tokens=16, timeout=None,
-                 deadline_ms=None):
+                 deadline_ms=None, temperature=0.0, top_k=0, top_p=1.0,
+                 seed=None):
         """submit() + wait."""
         return self.submit(prompt_tokens, max_new_tokens,
-                           deadline_ms=deadline_ms).result(timeout=timeout)
+                           deadline_ms=deadline_ms,
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p, seed=seed).result(timeout=timeout)
 
     # -- metrics -----------------------------------------------------------
     def _count(self, key, n=1):
@@ -727,14 +1156,23 @@ class ContinuousEngine:
         params_avals = jtu.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             self.model.params)
-        pool_aval = jax.ShapeDtypeStruct(self.pool.shape, self.pool.dtype)
+        slab = jax.ShapeDtypeStruct(self.pool.shape, self.pool.dtype)
+        if self.pool.quantized:
+            pool_aval = (slab, jax.ShapeDtypeStruct(
+                self.pool.scale_shape, "float32"))
+        else:
+            pool_aval = slab
         P, S, W = self.prefill_lanes, self.max_slots, self.prefill_window
         prefill = self._prefill_prog.lower(
             params_avals, pool_aval, pool_aval, aval((P, W)), aval((P,)),
             aval((P,)))
-        decode = self._decode_prog.lower(
-            params_avals, pool_aval, pool_aval, aval((S,)), aval((S,)),
-            aval((S,)))
+        dec_avals = [params_avals, pool_aval, pool_aval, aval((S,)),
+                     aval((S,)), aval((S,)), aval((S,), "float32"),
+                     aval((S,)), aval((S,), "float32"),
+                     aval((S, 2), "uint32")]
+        if self.draft_tokens:
+            dec_avals.append(aval((S, self.max_len)))
+        decode = self._decode_prog.lower(*dec_avals)
         return {
             "prefill": memory_plan(prefill, name=f"{self.name}.prefill"),
             "decode": memory_plan(decode, name=f"{self.name}.decode"),
@@ -763,6 +1201,11 @@ class ContinuousEngine:
                     else None
         out["pool"] = self.pool.stats()
         out["decode_steps"] = self.decode_steps
+        out["draft_tokens"] = self.draft_tokens
+        if c["draft_accepted"] + c["draft_rejected"] > 0:
+            out["draft_acceptance"] = round(
+                c["draft_accepted"]
+                / (c["draft_accepted"] + c["draft_rejected"]), 4)
         out["prefill_lanes"] = self.prefill_lanes
         out["prefill_window"] = self.prefill_window
         out["compile_cache_size"] = self.compile_cache_size()
@@ -882,14 +1325,27 @@ class ContinuousEngine:
         toks = _np.zeros((P, self.prefill_window), dtype=_np.int32)
         lens = _np.ones((P,), dtype=_np.int32)
         rows = _np.full((P,), g, dtype=_np.int32)
+        temps = _np.zeros((P,), dtype=_np.float32)
+        tks = _np.zeros((P,), dtype=_np.int32)
+        tps = _np.ones((P,), dtype=_np.float32)
+        keys = _np.zeros((P, 2), dtype=_np.uint32)
         for i, req in enumerate(admitted):
             toks[i, :req.prompt.size] = req.prompt
             lens[i] = req.prompt.size
             rows[i] = req.slot
+            temps[i] = req.temperature
+            tks[i] = req.top_k
+            tps[i] = req.top_p
+            keys[i] = req.key
         t0 = time.perf_counter()
-        k, v, first = self._prefill_prog(
-            self.model.params, self.pool.k, self.pool.v,
-            jnp.asarray(toks), jnp.asarray(lens), jnp.asarray(rows))
+        kb, vb = self.pool.buffers()
+        jlens = jnp.asarray(lens)
+        k, v, logits = self._prefill_prog(
+            self.model.params, kb, vb,
+            jnp.asarray(toks), jlens, jnp.asarray(rows))
+        first = _sample_first(logits, jnp.asarray(temps),
+                              jnp.asarray(tks), jnp.asarray(tps),
+                              jnp.asarray(keys), jlens - 1)
         self.pool.swap_buffers(k, v)
         first_host = _np.asarray(first)
         now = time.perf_counter()
@@ -897,6 +1353,9 @@ class ContinuousEngine:
         self._count("admitted", len(admitted))
         self._count("prefill_batches")
         self._count("prefill_tokens", n_tokens)
+        n_sampled = sum(1 for r in admitted if r.temperature > 0)
+        if n_sampled:
+            self._count("sampled_tokens", n_sampled)
         prof = _profiler_on()
         done = []
         for i, req in enumerate(admitted):
@@ -924,11 +1383,19 @@ class ContinuousEngine:
 
     def _run_decode(self, jnp):
         """ONE decode wave: every active slot advances up to
-        `decode_steps` tokens through the compiled multi-step program."""
+        `decode_steps` tokens (times up to `draft_tokens + 1` when
+        speculating) through the compiled multi-step program."""
         S = self.max_slots
+        draft = self.draft_tokens
         toks = _np.zeros((S,), dtype=_np.int32)
         lens = _np.zeros((S,), dtype=_np.int32)
         left = _np.zeros((S,), dtype=_np.int32)
+        temps = _np.zeros((S,), dtype=_np.float32)
+        tks = _np.zeros((S,), dtype=_np.int32)
+        tps = _np.ones((S,), dtype=_np.float32)
+        keys = _np.zeros((S, 2), dtype=_np.uint32)
+        buf = (_np.zeros((S, self.max_len), dtype=_np.int32)
+               if draft else None)
         with self._cv:
             running = dict(self._running)
         for slot, req in running.items():
@@ -943,30 +1410,63 @@ class ContinuousEngine:
             # token and break the K-invariance contract
             left[slot] = min(req.max_new - len(req.generated),
                              self.max_len - 1 - req.cache_len)
+            temps[slot] = req.temperature
+            tks[slot] = req.top_k
+            tps[slot] = req.top_p
+            keys[slot] = req.key
+            if draft:
+                # the draft source: token history = prompt + generated,
+                # exactly cache_len + 1 valid entries (tail not yet in KV)
+                plen = req.prompt.size
+                buf[slot, :plen] = req.prompt
+                buf[slot, plen:plen + len(req.generated)] = req.generated
         t0 = time.perf_counter()
-        k, v, out_toks, emitted = self._decode_prog(
-            self.model.params, self.pool.k, self.pool.v,
-            jnp.asarray(toks), jnp.asarray(lens), jnp.asarray(left))
+        kb, vb = self.pool.buffers()
+        args = [self.model.params, kb, vb, jnp.asarray(toks),
+                jnp.asarray(lens), jnp.asarray(left),
+                jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps),
+                jnp.asarray(keys)]
+        if draft:
+            k, v, blocks, n_emits, emitted, acc, rej = \
+                self._decode_prog(*args, jnp.asarray(buf))
+            blocks_host = _np.asarray(blocks)   # (steps, S, draft+1)
+            nem_host = _np.asarray(n_emits)     # (steps, S)
+        else:
+            k, v, out_toks, emitted = self._decode_prog(*args)
+            out_host = _np.asarray(out_toks)    # (decode_steps, S)
         self.pool.swap_buffers(k, v)
-        out_host = _np.asarray(out_toks)            # (decode_steps, S)
         emitted_host = _np.asarray(emitted)
         now = time.perf_counter()
         n_active = len(running)
         n_tokens = 0
+        n_sampled = 0
         done = []
         for slot, req in running.items():
             n_new = int(emitted_host[slot])
             if n_new > 0:
-                req.generated.extend(
-                    int(t) for t in out_host[:n_new, slot])
+                if draft:
+                    for i in range(nem_host.shape[0]):
+                        m = int(nem_host[i, slot])
+                        req.generated.extend(
+                            int(t) for t in blocks_host[i, slot, :m])
+                else:
+                    req.generated.extend(
+                        int(t) for t in out_host[:n_new, slot])
                 req.cache_len += n_new
                 req.t_last = now
                 n_tokens += n_new
+                if req.temperature > 0:
+                    n_sampled += n_new
             if self._finished(req):
                 done.append(req)
         self._count("decode_iterations")
         self._count("decode_tokens", n_tokens)
         self._count("active_sum", n_active)
+        if n_sampled:
+            self._count("sampled_tokens", n_sampled)
+        if draft:
+            self._count("draft_accepted", int(_np.asarray(acc).sum()))
+            self._count("draft_rejected", int(_np.asarray(rej).sum()))
         if _trace.enabled() and _trace.collector_active():
             record_span("serve.decode_batch", (now - t0) * 1e6,
                         ts_us=t0 * 1e6, cat="serve", active=n_active,
@@ -1035,4 +1535,7 @@ _ENGINE_TO_SERVE_KEY = {
     "prefill_tokens": "decode_prefill_tokens",
     "admitted": "decode_admitted",
     "retired": "decode_retired",
+    "sampled_tokens": "decode_sampled_tokens",
+    "draft_accepted": "decode_draft_accepted",
+    "draft_rejected": "decode_draft_rejected",
 }

@@ -132,9 +132,10 @@ _WIRE_ERRORS = {
 class _FleetRequest:
     __slots__ = ("rid", "prompt", "max_new", "deadline_at", "future",
                  "ctx", "attempts", "reroutes", "t_submit", "replica",
-                 "first_error")
+                 "first_error", "sampling")
 
-    def __init__(self, rid, prompt, max_new, deadline_at, ctx):
+    def __init__(self, rid, prompt, max_new, deadline_at, ctx,
+                 sampling=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new = max_new
@@ -146,6 +147,10 @@ class _FleetRequest:
         self.t_submit = time.perf_counter()
         self.replica = None
         self.first_error = None
+        # sampling params ride the wire verbatim (and survive failover
+        # re-dispatch — including the router-assigned seed, so a retried
+        # sampled request draws the SAME tokens on the new replica)
+        self.sampling = sampling or {}
 
     def sort_key(self):
         """EDF for failover re-dispatch: earliest deadline first,
@@ -230,6 +235,7 @@ class Fleet:
         self._port = None
         self._spec_path = None
         self._rid = [0]
+        self._seed_counter = [0]          # router-level sampling seeds
         self._swap_lock = threading.Lock()
         self._monitor_thread = None
         # cap on how long a dispatch may wait for SOME replica to accept
@@ -504,9 +510,13 @@ class Fleet:
         _fail_future(freq, err)
 
     # -- submission / dispatch --------------------------------------------
-    def submit(self, prompt_tokens, max_new_tokens=16, deadline_ms=None):
+    def submit(self, prompt_tokens, max_new_tokens=16, deadline_ms=None,
+               temperature=0.0, top_k=0, top_p=1.0, seed=None):
         """Enqueue one generation request onto the fleet; returns a
-        Future of the generated np.int32 token ids."""
+        Future of the generated np.int32 token ids. Sampling params pass
+        through to the replica engine; a sampled request without a seed
+        gets a ROUTER-assigned one, so a failover re-dispatch replays
+        the exact same draw sequence on the surviving replica."""
         if not self._started:
             raise FleetError("Fleet.start() (or `with fleet:`) first")
         if self._closing:
@@ -516,6 +526,23 @@ class Fleet:
             raise ServeError("prompt must have at least one token")
         if max_new_tokens < 1:
             raise ServeError("max_new_tokens must be >= 1")
+        temperature = float(temperature)
+        if temperature < 0.0:
+            raise ServeError("temperature must be >= 0")
+        if not 0.0 < float(top_p) <= 1.0:
+            raise ServeError(f"top_p must be in (0, 1], got {top_p}")
+        sampling = {}
+        if temperature > 0.0:
+            sampling["temperature"] = temperature
+            if int(top_k):
+                sampling["top_k"] = int(top_k)
+            if float(top_p) != 1.0:
+                sampling["top_p"] = float(top_p)
+            if seed is None:
+                with self._lock:
+                    seed = self._seed_counter[0]
+                    self._seed_counter[0] += 1
+            sampling["seed"] = int(seed)
         ctx = _trace.request_root("fleet.request")
         with self._lock:
             self._rid[0] += 1
@@ -523,15 +550,18 @@ class Fleet:
         deadline_at = (time.perf_counter() + deadline_ms / 1e3
                        if deadline_ms is not None else None)
         freq = _FleetRequest(rid, prompt, int(max_new_tokens),
-                             deadline_at, ctx)
+                             deadline_at, ctx, sampling=sampling)
         self._dispatch(freq)
         return freq.future
 
     def generate(self, prompt_tokens, max_new_tokens=16, timeout=None,
-                 deadline_ms=None):
+                 deadline_ms=None, temperature=0.0, top_k=0, top_p=1.0,
+                 seed=None):
         """submit() + wait."""
         return self.submit(prompt_tokens, max_new_tokens,
-                           deadline_ms=deadline_ms).result(timeout=timeout)
+                           deadline_ms=deadline_ms,
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p, seed=seed).result(timeout=timeout)
 
     def _pick(self, exclude=()):
         """Least-loaded SERVING replica: router-side in-flight count,
@@ -585,6 +615,7 @@ class Fleet:
                 msg = {"type": "request", "id": freq.rid,
                        "prompt": freq.prompt.tolist(),
                        "max_new": freq.max_new}
+                msg.update(freq.sampling)
                 if remaining_ms is not None:
                     msg["deadline_ms"] = remaining_ms
                 if freq.ctx is not None:

@@ -22,6 +22,15 @@ reads and writes, never as per-request Python objects the tracer sees):
     prove that: fill the slab with a sentinel, run a request through a
     reused slot, and check the output matches a fresh-pool reference
     bit-for-bit (tests/test_continuous.py).
+  * `dtype="int8"` opts a pool into QUANTIZED KV storage: int8 slabs plus
+    float32 per-(slot, layer, position) scale buffers (`k_scale` /
+    `v_scale`). Each written position is quantized by its absmax over
+    (heads, head_dim) — a position's scale is final the moment its KV is
+    written, so slot reuse never requantizes and a stale scale is exactly
+    as unreachable as a stale KV row (the same `[0, cur_len]` mask
+    governs both; `poison()` poisons the scales too so tests can prove
+    it). ~3-4x more slots per HBM byte (`slots_per_gb()`), the number
+    the memory bench commits.
 
 Exhaustion is typed: `claim()` past capacity raises `SlotsFullError`
 (a `ServeError`), the admission signal the engine's deadline-aware
@@ -85,7 +94,10 @@ def _note_slab(pool):
     try:
         _SLAB_GAUGE.set(pool.nbytes())
         from ..inspect import memory as _mem
-        _mem.register((pool.k, pool.v), owner="kv_pool")
+        bufs = (pool.k, pool.v)
+        if pool.quantized:
+            bufs = bufs + (pool.k_scale, pool.v_scale)
+        _mem.register(bufs, owner="kv_pool")
     except Exception:
         pass
 
@@ -124,11 +136,16 @@ class KVCachePool:
         self.heads = int(heads)
         self.head_dim = int(head_dim)
         self.dtype = str(dtype)
+        # int8 = quantized storage: slabs hold int8 codes, the paired
+        # k_scale/v_scale buffers hold one f32 dequant factor per
+        # written (slot, layer, position)
+        self.quantized = self.dtype == "int8"
         # LIFO free list: a just-freed slot is re-claimed first, which is
         # exactly what the poison-fill reuse test needs to exercise
         self._free = list(range(self.max_slots - 1, -1, -1))
         self._claimed = set()
         self.k = self.v = None
+        self.k_scale = self.v_scale = None
         if allocate:
             self._allocate()
 
@@ -140,6 +157,11 @@ class KVCachePool:
                 self.heads, self.head_dim)
 
     @property
+    def scale_shape(self):
+        """Per-position dequant-scale buffer shape (quantized pools)."""
+        return (self.max_slots + 1, self.layers, self.max_len)
+
+    @property
     def garbage_row(self):
         """Scatter target for a fixed-shape step's inactive lanes."""
         return self.max_slots
@@ -148,7 +170,19 @@ class KVCachePool:
         import jax.numpy as jnp
         self.k = jnp.zeros(self.shape, dtype=self.dtype)
         self.v = jnp.zeros(self.shape, dtype=self.dtype)
+        if self.quantized:
+            self.k_scale = jnp.zeros(self.scale_shape, dtype="float32")
+            self.v_scale = jnp.zeros(self.scale_shape, dtype="float32")
         _note_slab(self)
+
+    def buffers(self):
+        """The (k, v) arguments the step programs take: plain slabs, or
+        `(slab, scales)` pytree pairs for a quantized pool (the program
+        variant is chosen by `quantized` at build time, so the pytree
+        STRUCTURE is a trace-time constant)."""
+        if self.quantized:
+            return (self.k, self.k_scale), (self.v, self.v_scale)
+        return self.k, self.v
 
     def reallocate(self):
         """Replace the slab with fresh zeroed buffers. The engine's
@@ -159,7 +193,8 @@ class KVCachePool:
         self._allocate()
 
     def nbytes(self):
-        """Host-visible size of the slab pair (capacity-planning aid)."""
+        """Host-visible size of the slab pair incl. the quantized pools'
+        scale buffers (capacity-planning aid)."""
         import numpy as _np
         import ml_dtypes  # noqa: F401  (bf16 dtype string resolution)
         try:
@@ -169,22 +204,62 @@ class KVCachePool:
         n = 1
         for d in self.shape:
             n *= d
-        return 2 * n * itemsize
+        total = 2 * n * itemsize
+        if self.quantized:
+            s = 1
+            for d in self.scale_shape:
+                s *= d
+            total += 2 * s * 4
+        return total
+
+    def bytes_per_slot(self):
+        """Marginal device bytes one slot row costs (k + v pages, plus
+        scale rows on a quantized pool)."""
+        page = 2 * self.layers * self.max_len * self.heads * self.head_dim
+        import numpy as _np
+        try:
+            itemsize = _np.dtype(self.dtype).itemsize
+        except TypeError:
+            itemsize = 2
+        per = page * itemsize
+        if self.quantized:
+            per += 2 * self.layers * self.max_len * 4
+        return per
+
+    def slots_per_gb(self):
+        """KV slots one GiB of device memory buys at this pool's shape —
+        the capacity number the memory bench trends (int8 pools fit ~3-4x
+        the slots of float32 at the same (layers, max_len, heads, dim))."""
+        return round((1 << 30) / self.bytes_per_slot(), 2)
 
     def swap_buffers(self, k, v):
         """Install the step program's output buffers (the donated-update
-        swap idiom: the old arrays were consumed by donation)."""
-        self.k, self.v = k, v
+        swap idiom: the old arrays were consumed by donation). Quantized
+        pools take the `(slab, scales)` pairs `buffers()` hands out."""
+        if self.quantized:
+            (self.k, self.k_scale), (self.v, self.v_scale) = k, v
+        else:
+            self.k, self.v = k, v
         _note_slab(self)
 
     def poison(self, value=1e9):
         """Overwrite the WHOLE slab with a sentinel. Test hook for the
         slot-reuse isolation contract: after poisoning, any read that
         escapes the `[0, cur_len]` mask shows up as the sentinel in the
-        output. Never called on the serving path."""
+        output. On a quantized pool the codes are set to 1 and the SCALES
+        to `value`, so a stale-scale read is as loud as a stale-code one.
+        Never called on the serving path."""
         import jax.numpy as jnp
-        self.k = jnp.full(self.shape, value, dtype=self.dtype)
-        self.v = jnp.full(self.shape, value, dtype=self.dtype)
+        if self.quantized:
+            self.k = jnp.full(self.shape, 1, dtype=self.dtype)
+            self.v = jnp.full(self.shape, 1, dtype=self.dtype)
+            self.k_scale = jnp.full(self.scale_shape, value,
+                                    dtype="float32")
+            self.v_scale = jnp.full(self.scale_shape, value,
+                                    dtype="float32")
+        else:
+            self.k = jnp.full(self.shape, value, dtype=self.dtype)
+            self.v = jnp.full(self.shape, value, dtype=self.dtype)
         _note_slab(self)
 
     # -- slot bookkeeping --------------------------------------------------
@@ -228,4 +303,6 @@ class KVCachePool:
             used = len(self._claimed)
         return {"max_slots": self.max_slots, "in_use": used,
                 "free": self.max_slots - used,
+                "dtype": self.dtype,
+                "slots_per_gb": self.slots_per_gb(),
                 "slab_bytes": self.nbytes() if self.k is not None else 0}

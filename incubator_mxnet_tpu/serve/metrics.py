@@ -52,6 +52,11 @@ __all__ = ["SERVE_STATS", "ServeMetrics", "serve_stats", "percentile"]
 #   decode_prefill_tokens prompt tokens prefilled into KV slots
 #   decode_admitted       requests granted a KV slot (deadline-aware)
 #   decode_retired        requests finished and their slot freed
+#   decode_sampled_tokens tokens produced by sampled (temperature > 0)
+#                         lanes — greedy lanes never count here
+#   decode_draft_accepted speculative draft tokens accepted (emitted
+#                         without their own forward pass)
+#   decode_draft_rejected speculative draft tokens rejected at verify
 # Guards every SERVE_STATS mutation (all Server instances, all threads).
 _STATS_LOCK = threading.Lock()
 
@@ -65,7 +70,8 @@ SERVE_STATS = _stats_group("serve", {
     "programs_compiled": 0,
     "decode_iterations": 0, "decode_tokens": 0,
     "decode_prefill_tokens": 0, "decode_admitted": 0,
-    "decode_retired": 0,
+    "decode_retired": 0, "decode_sampled_tokens": 0,
+    "decode_draft_accepted": 0, "decode_draft_rejected": 0,
 }, lock=_STATS_LOCK,
     help="process-wide serving counters (profiler.serve_stats)")
 

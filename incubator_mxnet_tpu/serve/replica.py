@@ -132,7 +132,10 @@ class _StubEngine:
         with self._cv:
             return len(self._q), 0
 
-    def submit(self, prompt_tokens, max_new_tokens=16, deadline_ms=None):
+    def submit(self, prompt_tokens, max_new_tokens=16, deadline_ms=None,
+               **sampling):
+        # sampling params accepted for wire compatibility; the stub's
+        # deterministic token pattern ignores them
         prompt = _np.asarray(prompt_tokens, dtype=_np.int64).ravel()
         fut = Future()
         with self._cv:
@@ -281,10 +284,17 @@ def main(argv=None):
             # one trace survives a failover re-dispatch
             ctx = _trace.TraceContext.from_dict(msg.get("trace") or {})
             token = _trace.attach(ctx) if ctx is not None else None
+            # sampling params (temperature/top_k/top_p/seed) ride the
+            # request message; absent keys keep the engine's greedy
+            # defaults so old routers speak the same protocol
+            sampling = {k: msg[k]
+                        for k in ("temperature", "top_k", "top_p", "seed")
+                        if k in msg}
             try:
                 fut = eng.submit(msg.get("prompt"),
                                  msg.get("max_new", 16),
-                                 deadline_ms=msg.get("deadline_ms"))
+                                 deadline_ms=msg.get("deadline_ms"),
+                                 **sampling)
             except ReplicaDraining as e:
                 send({"type": "error", "id": rid,
                       "kind": "ReplicaDraining", "message": str(e)})

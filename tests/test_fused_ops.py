@@ -308,7 +308,8 @@ def test_fused_stats_counters_move():
     from incubator_mxnet_tpu import profiler
     assert set(profiler.fused_stats()) == {"pallas_calls",
                                            "fallback_calls",
-                                           "device_augment_calls"}
+                                           "device_augment_calls",
+                                           "paged_attention_calls"}
 
 
 def test_set_interpret_toggle_not_served_stale_programs():

@@ -451,6 +451,11 @@ EXEMPT = {
                             "parity in test_fused_ops.py",
     "npx.flash_attention": "covered in test_attention.py + "
                            "test_fused_ops.py (registered wrapper)",
+    "npx.paged_attention": "slotted-KV decode attention (cache slab + "
+                           "lengths inputs the generic sweep cannot "
+                           "shape); kernel-vs-ref interpret parity, "
+                           "int8 dequant, and engine poison isolation "
+                           "in tests/test_decode.py",
     "npx.fused_image_augment": "PRNGKey-data input (uint32) the numeric "
                                "FD sweep cannot differentiate; numpy-"
                                "reference fwd + grad-through-normalize "

@@ -108,6 +108,12 @@ TREND_KEYS = {
     "fleet_vs_single_speedup": "higher",
     "fleet_p99_ms_during_kill": "lower",
     "fleet_swap_dropped_requests": "lower",
+    # decode phase (PR 17, serve.decode): the speculative path's
+    # wall-clock tokens/s in its single-stream deployment regime must
+    # not fall, and the int8 KV pool's slots-per-GB density — the
+    # quantized-cache capacity win — must not shrink
+    "serve_decode_tokens_per_sec_spec": "higher",
+    "kv_slots_per_gb": "higher",
 }
 
 # floor metrics whose healthy committed baseline IS 0 (a ratio threshold
@@ -457,6 +463,23 @@ def self_test():
                   dict(fleet_base, fleet_vs_single_speedup=2.2,
                        fleet_p99_ms_during_kill=28.0))
     check("improving fleet keys pass with improvements reported",
+          rep["status"] == "ok" and len(rep["improvements"]) == 2)
+    # decode keys (PR 17): a falling speculative tokens/s or a shrinking
+    # int8 KV density gates the trend
+    dec_base = {"backend_ok": True,
+                "serve_decode_tokens_per_sec_spec": 4000.0,
+                "kv_slots_per_gb": 27000.0}
+    rep = compare(dec_base,
+                  dict(dec_base, serve_decode_tokens_per_sec_spec=3000.0,
+                       kv_slots_per_gb=14000.0))
+    check("spec tokens/s drop / kv density shrink is a regression",
+          rep["status"] == "regression"
+          and {r["key"] for r in rep["regressions"]}
+          == {"serve_decode_tokens_per_sec_spec", "kv_slots_per_gb"})
+    rep = compare(dec_base,
+                  dict(dec_base, serve_decode_tokens_per_sec_spec=5000.0,
+                       kv_slots_per_gb=34000.0))
+    check("improving decode keys pass with improvements reported",
           rep["status"] == "ok" and len(rep["improvements"]) == 2)
     missing_only_new = {"backend_ok": True,
                         "io_pipeline_images_per_sec": 700.0}
