@@ -1107,6 +1107,34 @@ def _phase_xla_flops():
     return {"xla_counted_fwd_gflop_per_img": xla_counted_fwd_gflops()}
 
 
+def _phase_tune(quick=False):
+    """Autotuner trend row: sweep the declared knob space from the
+    hand-tuned committed baselines (trial 0 of every phase measures the
+    hand assignment itself, so best >= hand is structural and the floor
+    metric is honest) and report the WORST per-phase speedup plus the
+    trial-containment counters. Trials are scrubbed-env subprocesses —
+    a crashing config shows up in tune_trials_failed, not as a dead
+    phase."""
+    from incubator_mxnet_tpu import tune as mxtune
+    phases = ["dispatch"] if quick else ["serve_decode", "train_fused",
+                                         "dispatch"]
+    budget = 4 if quick else 21
+    res = mxtune.sweep(phases=phases, budget=budget, seed=11,
+                       scale="quick" if quick else "full")
+    out = {"tune_trials": res["trials"],
+           "tune_trials_failed": res["trials_failed"]}
+    speedups = [d.get("speedup_vs_hand") for d in res["phases"].values()
+                if d.get("speedup_vs_hand") is not None]
+    if speedups:
+        out["tune_profile_vs_hand_speedup"] = round(min(speedups), 4)
+    for p, d in res["phases"].items():
+        if (d.get("baseline") or {}).get("score") is not None:
+            out[f"tune_{p}_hand_score"] = d["baseline"]["score"]
+        if (d.get("best") or {}).get("score") is not None:
+            out[f"tune_{p}_best_score"] = d["best"]["score"]
+    return out
+
+
 PHASES = [
     ("dispatch", _phase_dispatch),
     ("eager", _phase_eager),
@@ -1119,6 +1147,7 @@ PHASES = [
     ("serve_continuous", _phase_serve_continuous),
     ("serve_decode", _phase_serve_decode),
     ("fleet", _phase_fleet),
+    ("tune", _phase_tune),
     ("elastic", _phase_elastic),
     ("memory", _phase_memory),
     ("offenders", _phase_offenders),
@@ -1183,6 +1212,13 @@ def _phase_fleet_quick():
     return _phase_fleet(quick=True)
 
 
+def _phase_tune_quick():
+    # same keys, dispatch-only sweep with a 4-trial budget: the tier-1
+    # smoke exercises catalog -> schedule -> scrubbed subprocess trial ->
+    # speedup floor end to end in seconds, not minutes
+    return _phase_tune(quick=True)
+
+
 def _phase_memory_quick():
     # same keys, tiny net + tiny decoder: the tier-1 smoke exercises the
     # plan/census/leakcheck path end to end without a ResNet compile
@@ -1199,6 +1235,7 @@ QUICK_PHASES = {
     "serve_continuous": _phase_serve_continuous_quick,
     "serve_decode": _phase_serve_decode_quick,
     "fleet": _phase_fleet_quick,
+    "tune": _phase_tune_quick,
     "memory": _phase_memory_quick,
 }
 
@@ -1208,7 +1245,7 @@ PHASE_TIMEOUTS = {
     "dispatch": 300, "eager": 900, "train32": 1500, "train128": 1500,
     "infer": 900, "io": 700, "input_pipeline": 700, "serve": 700,
     "serve_continuous": 900, "serve_decode": 900, "fleet": 700,
-    "elastic": 700, "memory": 700,
+    "tune": 1200, "elastic": 700, "memory": 700,
     "offenders": 700,
     "fused_sweep": 2000, "calib": 900, "xla_flops": 600,
 }
@@ -1401,6 +1438,19 @@ def _host_diagnostics():
     return d
 
 
+def _phase_child_env():
+    """Scrubbed env for phase subprocesses (the tune.space helper): a
+    perf knob exported by the operator's shell — or by a previous trial —
+    must never leak into a phase's baseline measurement. Infra vars
+    (JAX_PLATFORMS, MXNET_COMPILE_CACHE_DIR, MXNET_BENCH_FAULT_PHASE,
+    fault specs, ...) pass through untouched."""
+    try:
+        from incubator_mxnet_tpu.tune.space import scrubbed_env
+        return scrubbed_env()
+    except Exception:
+        return None        # inherit: scrubbing is protective, not load-bearing
+
+
 def _run_sub(argv, timeout, env=None):
     """Run argv in its own process group; on timeout kill the whole group
     (a hung TPU client ignores SIGTERM's default courtesy window)."""
@@ -1519,7 +1569,7 @@ def run_phases_isolated(names=None, quick=False, partial_path=None):
         argv = [sys.executable, os.path.abspath(__file__), "--phase", name]
         if quick:
             argv.append("--quick")
-        rc, out, err = _run_sub(argv, timeout)
+        rc, out, err = _run_sub(argv, timeout, env=_phase_child_env())
         sys.stderr.write(err or "")
         parsed = None
         for line in reversed((out or "").strip().splitlines()):

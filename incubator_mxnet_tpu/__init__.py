@@ -36,7 +36,7 @@ from . import ops
 # Heavier subsystems import lazily to keep `import mx` fast and allow partial
 # builds during bring-up.
 _LAZY = ("gluon", "optimizer", "kvstore", "parallel", "amp", "profiler",
-         "fault", "serve", "telemetry", "inspect",
+         "fault", "serve", "telemetry", "inspect", "tune",
          "initializer", "lr_scheduler", "metric", "test_utils", "util",
          "runtime", "io", "image", "engine", "context", "recordio",
          "checkpoint", "visualization", "models", "native", "deploy",

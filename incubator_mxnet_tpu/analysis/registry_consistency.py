@@ -63,6 +63,21 @@ Three symbol families, six rules:
                                    already covered per-key by
                                    stats-key-untested)
 
+  tune knobs (mx.tune) — the registry is `KNOBS` (a module-level dict
+  literal, like POINTS); the exemption set is `NON_TUNABLE_ENV` (a set
+  literal beside it); the doc surface is the "Knob catalog" table in
+  docs/TUNING.md (section-scoped, like Census owners).
+
+    tune-knob-undocumented  a KNOBS entry missing from the TUNING.md
+                            knob-catalog table
+    tune-doc-stale          a TUNING.md catalog row naming a knob not
+                            in KNOBS
+    tune-env-undeclared     an MXNET_* var read in a knob-WIRED module
+                            (a module some knob's `wire` field names)
+                            that is neither a declared knob env nor in
+                            NON_TUNABLE_ENV — an undeclared tunable the
+                            sweep can't see and profiles can't pin
+
 All comparisons are literal-based on purpose: a knob that only exists
 behind computed strings is unauditable and should be rewritten, not
 special-cased.
@@ -82,7 +97,9 @@ RULES = ("env-undocumented", "env-doc-stale", "fault-point-unwired",
          "fault-doc-stale", "stats-key-untested",
          "telemetry-metric-undocumented", "telemetry-doc-stale",
          "telemetry-metric-untested",
-         "mem-owner-undocumented", "mem-owner-doc-stale")
+         "mem-owner-undocumented", "mem-owner-doc-stale",
+         "tune-knob-undocumented", "tune-doc-stale",
+         "tune-env-undeclared")
 
 _ENV_RE = re.compile(r"MXNET_[A-Z0-9_]+")
 _STATS_NAME_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*_STATS$")
@@ -91,29 +108,34 @@ _INJECT_CALLEES = {"inject", "_fault_inject"}
 _POINT_TABLE_RE = re.compile(r"^\|\s*`([a-z0-9_.]+)`(?:\s*/\s*`([a-z0-9_.]+)`)*")
 
 
+def _env_read_name(node):
+    """The literal MXNET_* var a single AST node reads, or None."""
+    name = None
+    if isinstance(node, ast.Call):
+        cname = call_name(node)
+        last = cname.split(".")[-1] if cname else None
+        if last in _ENV_READERS and node.args:
+            name = str_const(node.args[0])
+        elif cname and cname.endswith("environ.get") and node.args:
+            name = str_const(node.args[0])
+    elif isinstance(node, ast.Subscript):
+        # os.environ["X"] (read or write — both are knob surface)
+        base = node.value
+        if isinstance(base, ast.Attribute) and base.attr == "environ":
+            name = str_const(node.slice)
+    if name and name.startswith("MXNET_"):
+        return name
+    return None
+
+
 def _env_reads(modules):
     """{var: (relpath, line)} for every literal MXNET_* read site."""
     reads = {}
-
-    def note(name, mod, line):
-        if name and name.startswith("MXNET_") and name not in reads:
-            reads[name] = (mod.relpath, line)
-
     for mod in modules:
         for node in ast.walk(mod.tree):
-            if isinstance(node, ast.Call):
-                cname = call_name(node)
-                last = cname.split(".")[-1] if cname else None
-                if last in _ENV_READERS and node.args:
-                    note(str_const(node.args[0]), mod, node.lineno)
-                elif cname and cname.endswith("environ.get") and node.args:
-                    note(str_const(node.args[0]), mod, node.lineno)
-            elif isinstance(node, ast.Subscript):
-                # os.environ["X"] (read or write — both are knob surface)
-                base = node.value
-                if isinstance(base, ast.Attribute) \
-                        and base.attr == "environ":
-                    note(str_const(node.slice), mod, node.lineno)
+            name = _env_read_name(node)
+            if name and name not in reads:
+                reads[name] = (mod.relpath, node.lineno)
     return reads
 
 
@@ -325,6 +347,81 @@ def _doc_metrics(doc_path):
     return doc
 
 
+def _knob_catalog(modules):
+    """(knobs {name: {"env", "wire", "line"}}, non_tunable set, relpath)
+    from the `KNOBS = {...}` and `NON_TUNABLE_ENV = {...}` literals
+    (mx.tune.space). Computed entries are invisible by design — the
+    catalog is a literal contract, like POINTS."""
+    knobs, non_tunable, relpath = {}, set(), None
+    for mod in modules:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [t.id for t in node.targets
+                     if isinstance(t, ast.Name)]
+            if "KNOBS" in names and isinstance(node.value, ast.Dict):
+                relpath = mod.relpath
+                for k, v in zip(node.value.keys, node.value.values):
+                    name = str_const(k)
+                    if not name or not isinstance(v, ast.Dict):
+                        continue
+                    spec = {"env": None, "wire": None, "line": k.lineno}
+                    for sk, sv in zip(v.keys, v.values):
+                        field = str_const(sk)
+                        if field in ("env", "wire"):
+                            spec[field] = str_const(sv)
+                    knobs[name] = spec
+            elif "NON_TUNABLE_ENV" in names \
+                    and isinstance(node.value, ast.Set):
+                for el in node.value.elts:
+                    s = str_const(el)
+                    if s:
+                        non_tunable.add(s)
+    return knobs, non_tunable, relpath
+
+
+def _doc_knob_table(doc_path):
+    """{knob: line} from the "Knob catalog" table in TUNING.md —
+    SECTION-scoped (rows between the heading containing "knob catalog"
+    and the next heading), so dotted knob names never collide with env
+    vars or metric names mentioned elsewhere in the doc."""
+    doc = {}
+    if not os.path.exists(doc_path):
+        return doc
+    in_section = False
+    with open(doc_path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            stripped = line.strip()
+            if stripped.startswith("#"):
+                in_section = "knob catalog" in stripped.lower()
+                continue
+            if not in_section or not stripped.startswith("|"):
+                continue
+            first_cell = stripped.split("|")[1] if "|" in stripped[1:] \
+                else ""
+            for m in re.finditer(r"`([a-z0-9_.]+)`", first_cell):
+                if _METRIC_NAME_RE.match(m.group(1)):
+                    doc.setdefault(m.group(1), i)
+    return doc
+
+
+def _wired_env_reads(modules, wires):
+    """[(var, relpath, line)] — every literal MXNET_* read inside a
+    knob-WIRED module (one whose relpath ends with some knob's `wire`
+    value), first site per (module, var)."""
+    out = []
+    for mod in modules:
+        if not any(mod.relpath.endswith(w) for w in wires):
+            continue
+        seen = set()
+        for node in ast.walk(mod.tree):
+            name = _env_read_name(node)
+            if name and name not in seen:
+                seen.add(name)
+                out.append((name, mod.relpath, node.lineno))
+    return out
+
+
 def _tests_text(tests_dir):
     chunks = []
     if os.path.isdir(tests_dir):
@@ -341,11 +438,13 @@ def _tests_text(tests_dir):
 
 def run(modules, root,
         env_doc="docs/ENV_VARS.md", resilience_doc="docs/RESILIENCE.md",
-        obs_doc="docs/OBSERVABILITY.md", tests_dir="tests"):
+        obs_doc="docs/OBSERVABILITY.md", tuning_doc="docs/TUNING.md",
+        tests_dir="tests"):
     findings = []
     env_doc_path = os.path.join(root, env_doc)
     res_doc_path = os.path.join(root, resilience_doc)
     obs_doc_path = os.path.join(root, obs_doc)
+    tune_doc_path = os.path.join(root, tuning_doc)
     tests_path = os.path.join(root, tests_dir)
 
     # ---- env vars ------------------------------------------------------
@@ -466,4 +565,39 @@ def run(modules, root,
                     f"which no code registers — delete the row or "
                     f"restore the registration",
                     scope="doc", symbol=owner))
+
+    # ---- tune knob catalog (mx.tune) -----------------------------------
+    # guard on a KNOBS literal existing so fixture repos (and pre-tune
+    # trees) produce no tune findings at all
+    knobs, non_tunable, knobs_path = _knob_catalog(modules)
+    if knobs:
+        doc_knobs = _doc_knob_table(tune_doc_path)
+        for name, spec in sorted(knobs.items()):
+            if name not in doc_knobs:
+                findings.append(Finding(
+                    "tune-knob-undocumented", knobs_path or "",
+                    spec["line"],
+                    f"tune knob `{name}` is declared in KNOBS but "
+                    f"missing from the {tuning_doc} knob-catalog table",
+                    scope="KNOBS", symbol=name))
+        for name, line in sorted(doc_knobs.items()):
+            if name not in knobs:
+                findings.append(Finding(
+                    "tune-doc-stale", tuning_doc, line,
+                    f"{tuning_doc} catalogs knob `{name}` which is not "
+                    f"declared in KNOBS — delete the row or declare "
+                    f"the knob", scope="doc", symbol=name))
+        declared_env = {s["env"] for s in knobs.values() if s["env"]}
+        wires = {s["wire"] for s in knobs.values() if s["wire"]}
+        for var, relpath, line in sorted(
+                _wired_env_reads(modules, wires)):
+            if var in declared_env or var in non_tunable:
+                continue
+            findings.append(Finding(
+                "tune-env-undeclared", relpath, line,
+                f"`{var}` is read in knob-wired module {relpath} but is "
+                f"neither a declared knob env nor in NON_TUNABLE_ENV — "
+                f"an undeclared tunable the sweep cannot see; declare "
+                f"it in KNOBS or exempt it",
+                scope="tune-env", symbol=var))
     return findings

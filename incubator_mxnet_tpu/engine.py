@@ -46,6 +46,15 @@ def current_bulk_size():
     if _bulk_size[0] is not None:
         return _bulk_size[0]
     try:
+        # knob precedence: set_bulk_size override > deployment profile
+        # (mx.tune) > MXNET_ENGINE_BULK_SIZE env > default
+        from .tune.profile import resolve as _tune_resolve
+        v = _tune_resolve("dispatch.bulk_size")
+        if v is not None:
+            return int(v)
+    except ImportError:
+        pass
+    try:
         return int(get_env("MXNET_ENGINE_BULK_SIZE", "4096") or 4096)
     except (TypeError, ValueError):
         return 4096

@@ -116,6 +116,9 @@ POINTS = {
                      "respawned (failure bills a consecutive restart)",
     "fleet.swap": "serve.Fleet.swap, before each replica's "
                   "drain-and-swap (failure aborts the rolling upgrade)",
+    "tune.trial": "tune sweep, before each trial's measurement "
+                  "subprocess is launched (failure is a recorded failed "
+                  "TRIAL; the sweep itself completes)",
 }
 
 _KINDS = ("ioerror", "oserror", "error", "timeout", "nan", "stall", "kill")

@@ -30,6 +30,11 @@ import numpy as _np
 from ...base import MXNetError
 from ...ops import fused as _fused_mod
 
+# "argument not given" marker for knobs whose default is a real value
+# (None = XLA-default remat, True = donate) — lets the mx.tune profile
+# tier slot in UNDER an explicit argument but OVER the built-in default
+_TUNE_UNSET = object()
+
 __all__ = ["FusedTrainStep", "FusedInferStep"]
 
 _staging = None   # (jax.Array, maybe_device_put), resolved on first step
@@ -183,9 +188,17 @@ class FusedTrainStep:
     trace constant, like the reference's update_on_kvstore batching)."""
 
     def __init__(self, net, fn, optimizer, clip_global_norm=None,
-                 steps_per_call=1, remat=None, donate=True,
+                 steps_per_call=1, remat=_TUNE_UNSET, donate=_TUNE_UNSET,
                  use_fusion=None):
         from ... import optimizer as opt_mod
+        from ...tune.profile import resolve as _tune_resolve
+        # knob precedence: explicit arg > deployment profile > default.
+        # `None` is a meaningful remat policy (XLA default), so "caller
+        # said nothing" needs its own sentinel for the profile tier.
+        if remat is _TUNE_UNSET:
+            remat = _tune_resolve("train.remat")
+        if donate is _TUNE_UNSET:
+            donate = _tune_resolve("train.donate", True)
         optimizer = opt_mod.create(optimizer)
         # same eligibility rules as the multi-tensor fused path
         # (optimizer/__init__.py fused_update_all): host-stateful rules

@@ -655,8 +655,16 @@ class ImageRecordIter(DataIter):
                 "device) or the float32 handoff (normalize in the "
                 "decoders), or drop the mean/std arguments and normalize "
                 "in your step")
+        # knob precedence: explicit arg > deployment profile (mx.tune) >
+        # MXNET_* env > default (shm_mb's profile/env tiers resolve at
+        # the DecodePool wire site, where the arg's None is consumed)
+        from ..tune.profile import resolve as _tune_resolve
+        if workers is None:
+            workers = _tune_resolve("io.workers")
         self._workers = (get_env("MXNET_IO_WORKERS", 0, typ=int)
                          if workers is None else int(workers))
+        if lookahead is None:
+            lookahead = _tune_resolve("io.lookahead")
         ahead = (get_env("MXNET_IMAGEREC_LOOKAHEAD", 2, typ=int)
                  if lookahead is None else int(lookahead))
         self._ahead = max(0, ahead) if prefetch else 0

@@ -90,6 +90,15 @@ _register_env("MXNET_SERVE_MAX_SLOTS", int, 8,
 _register_env("MXNET_SERVE_PREFILL_BUDGET", int, 256,
               "Max prompt tokens prefilled per engine iteration "
               "(bounds prefill's added latency on in-flight decode)")
+_register_env("MXNET_SERVE_DECODE_STEPS", int, 4,
+              "Decode micro-iterations per compiled dispatch in the "
+              "continuous engine (host round-trip amortization)")
+_register_env("MXNET_SERVE_PREFILL_LANES", int, None,
+              "Fixed lane count of the prefill program (unset = "
+              "min(max_slots, 8)); sized to the admission rate")
+_register_env("MXNET_SERVE_KV_DTYPE", str, None,
+              "KV pool storage dtype ('int8' = quantized codes + "
+              "scales; unset = model dtype)")
 _register_env("MXNET_FLEET_REPLICAS", int, 2,
               "Replica worker processes a serve.Fleet spawns")
 _register_env("MXNET_FLEET_HEARTBEAT_MS", float, 500.0,

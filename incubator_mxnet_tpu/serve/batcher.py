@@ -189,8 +189,19 @@ class CallableModel:
     small-closed-set contract as BucketedModel, without the export
     round-trip — the in-process deployment path)."""
 
-    def __init__(self, fn, batch_sizes, row_specs, single_output=True):
+    def __init__(self, fn, batch_sizes=None, row_specs=(),
+                 single_output=True):
         import jax
+        if batch_sizes is None:
+            # knob precedence: explicit arg > deployment profile >
+            # default ladder (each bucket is one compiled program, so
+            # the set is a measured cost/padding trade — swept by
+            # mx.tune's serve_batch phase)
+            from ..tune.profile import resolve as _tune_resolve
+            batch_sizes = _tune_resolve("serve.batch_buckets",
+                                        [1, 2, 4, 8, 16, 32])
+        if not row_specs:
+            raise ServeError("CallableModel needs row_specs")
         self._jit = jax.jit(fn)
         self.batch_sizes = sorted(int(b) for b in batch_sizes)
         self.row_specs = [(tuple(s), str(d)) for s, d in row_specs]

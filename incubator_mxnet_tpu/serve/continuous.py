@@ -800,7 +800,10 @@ class ContinuousEngine:
             fut = eng.submit([3, 14, 15], max_new_tokens=16)
             tokens = fut.result()            # np.int32 generated ids
 
-    Knobs (constructor arg > MXNET_SERVE_* env > default):
+    Knobs (constructor arg > deployment profile (mx.tune) >
+    MXNET_SERVE_* env > default — the profile is a measured,
+    fingerprint-checked artifact, so ambient shell exports must not
+    defeat it; MXNET_TUNE_DISABLE=1 restores raw env behavior):
 
       max_slots        KV slots = max concurrently-decoding requests
       prefill_budget   max prompt TOKENS prefilled per engine iteration
@@ -835,13 +838,20 @@ class ContinuousEngine:
     """
 
     def __init__(self, model, *, max_slots=None, prefill_budget=None,
-                 prefill_lanes=None, prefill_window=None, decode_steps=4,
+                 prefill_lanes=None, prefill_window=None, decode_steps=None,
                  max_queue=None, default_deadline_ms=None, eos_id=None,
                  draft_tokens=None, kv_dtype=None,
                  name="serve.continuous"):
+        from ..tune.profile import resolve as _tune_resolve
         self.model = model
         self.name = name
         self.eos_id = eos_id
+        if max_slots is None:
+            max_slots = _tune_resolve("serve.max_slots")
+        if kv_dtype is None:
+            kv_dtype = _tune_resolve("serve.kv_dtype")
+            if kv_dtype is None:
+                kv_dtype = get_env("MXNET_SERVE_KV_DTYPE")
         self.kv_dtype = kv_dtype
         self.pool = model.new_pool(max_slots, dtype=kv_dtype)
         self.max_slots = self.pool.max_slots
@@ -849,7 +859,14 @@ class ContinuousEngine:
         # host round-trip over K tokens; admission/retirement happen at
         # wave granularity (a lane finishing mid-wave holds its slot
         # until the wave ends, never computes past its budget)
+        if decode_steps is None:
+            decode_steps = _tune_resolve("serve.decode_steps")
+            if decode_steps is None:
+                decode_steps = get_env("MXNET_SERVE_DECODE_STEPS", 4,
+                                       typ=int)
         self.decode_steps = max(1, int(decode_steps))
+        if draft_tokens is None:
+            draft_tokens = _tune_resolve("serve.draft_tokens")
         self.draft_tokens = int(
             draft_tokens if draft_tokens is not None
             else get_env("MXNET_SERVE_DRAFT_TOKENS", 0, typ=int))
@@ -874,6 +891,11 @@ class ContinuousEngine:
             else get_env("MXNET_SERVE_PREFILL_BUDGET", 256, typ=int))
         if self.prefill_budget < 1:
             raise ServeError("prefill_budget must be >= 1")
+        if prefill_lanes is None:
+            prefill_lanes = _tune_resolve("serve.prefill_lanes")
+            if prefill_lanes is None:
+                prefill_lanes = get_env("MXNET_SERVE_PREFILL_LANES",
+                                        typ=int)
         self.prefill_lanes = int(prefill_lanes if prefill_lanes is not None
                                  else min(self.max_slots, 8))
         if not 1 <= self.prefill_lanes <= self.max_slots:

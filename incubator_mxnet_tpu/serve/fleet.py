@@ -84,6 +84,8 @@ FLEET_STATS = stats_group("fleet", {
     "respawns": 0,            # failure respawns (swap restarts excluded)
     "swaps": 0,               # completed rolling drain-and-swap operations
     "drain_ms": 0.0,          # cumulative replica drain time
+    "profile_divergence": 0,  # hellos that revealed replicas serving the
+                              # same fleet under DIFFERENT tune profiles
 }, lock=_STATS_LOCK, help="serving-fleet supervisor/router counters")
 
 
@@ -159,6 +161,56 @@ class _FleetRequest:
                 self.deadline_at if self.deadline_at is not None
                 else self.t_submit,
                 self.t_submit)
+
+
+class _EDFGate:
+    """Deadline-ordered admission to replica claiming.
+
+    `_pick()` breaks load ties by index, and concurrent dispatch threads
+    race it arbitrarily — so under contention a deadline-less request
+    could claim the least-loaded replica ahead of one about to expire.
+    The gate serializes the CLAIM step in earliest-deadline-first order
+    (`_FleetRequest.sort_key`: deadline holders first, FIFO among
+    deadline-less peers): every dispatching request registers, and only
+    the tightest-deadline waiter may proceed to `_pick()`; everyone else
+    blocks on the condition until the head leaves. Claims are quick
+    (pick + one socket write), so this orders the queue without
+    meaningfully serializing throughput — and it unifies admission with
+    the EDF failover re-dispatch order `_async_dispatch` already uses.
+    """
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._waiting = []
+
+    def enter(self, freq):
+        with self._cv:
+            self._waiting.append(freq)
+            self._cv.notify_all()
+
+    def leave(self, freq):
+        with self._cv:
+            try:
+                self._waiting.remove(freq)
+            except ValueError:
+                pass
+            self._cv.notify_all()
+
+    def wait_turn(self, freq, timeout=0.02):
+        """True when `freq` is the tightest-deadline waiter right now;
+        otherwise block (bounded) for the head to advance and report
+        whether it is our turn yet. Callers loop — their loop re-checks
+        the request's own deadline/closing state between waits, so a
+        non-head request is never parked unboundedly."""
+        with self._cv:
+            head = min(self._waiting, key=_FleetRequest.sort_key,
+                       default=None)
+            if head is freq or head is None:
+                return True
+            self._cv.wait(timeout)
+            head = min(self._waiting, key=_FleetRequest.sort_key,
+                       default=None)
+            return head is freq or head is None
 
 
 class _Replica:
@@ -241,6 +293,7 @@ class Fleet:
         # cap on how long a dispatch may wait for SOME replica to accept
         # (covers the respawn window when every replica died at once)
         self._dispatch_wait_s = max(30.0, self.drain_timeout_s)
+        self._edf = _EDFGate()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -413,11 +466,13 @@ class Fleet:
             gen = h.generation
         _set_state_gauge(i, "serving")
         self._update_live()
+        self._check_profile_divergence()
         logger.info("fleet: replica %d serving version %s "
                     "(pid %s, metrics port %s, warmup %.3fs, "
-                    "compile cache %s)", i, h.version, h.pid,
+                    "compile cache %s, profile %s)", i, h.version, h.pid,
                     h.metrics_port, hello.get("warmup_s") or 0.0,
-                    hello.get("compile_cache_size"))
+                    hello.get("compile_cache_size"),
+                    hello.get("profile_hash") or "-")
         h.ready_evt.set()
         self._reader(h, rf, gen)
 
@@ -579,7 +634,16 @@ class Fleet:
 
     def _dispatch(self, freq, exclude=()):
         """Place one request (dispatch, or re-dispatch after failover /
-        drain re-route). Retries alternate replicas under the budget."""
+        drain re-route), EDF-gated: among requests concurrently waiting
+        to claim a replica, the tightest deadline claims first. Retries
+        alternate replicas under the budget."""
+        self._edf.enter(freq)
+        try:
+            self._dispatch_inner(freq, exclude)
+        finally:
+            self._edf.leave(freq)
+
+    def _dispatch_inner(self, freq, exclude=()):
         exclude = set(exclude)
         wait_deadline = time.perf_counter() + self._dispatch_wait_s
         while True:
@@ -595,6 +659,8 @@ class Fleet:
                         f"ms before a replica accepted the request"))
                     return
                 remaining_ms = max(1.0, left * 1e3)
+            if not self._edf.wait_turn(freq):
+                continue            # not the tightest deadline waiting
             h = self._pick(exclude)
             if h is None:
                 if exclude:
@@ -930,10 +996,30 @@ class Fleet:
                 "compile_cache_size": (h.pong or h.hello).get(
                     "compile_cache_size"),
                 "retraces": h.pong.get("retraces"),
+                "profile_hash": h.hello.get("profile_hash"),
             } for h in self._replicas]
         out = {"version": self.version, "replicas": reps}
         out.update(FLEET_STATS.snapshot())
         return out
+
+    def _check_profile_divergence(self):
+        """A fleet must be homogeneously tuned: every serving replica's
+        hello-reported deployment-profile hash should agree (None =
+        untuned, which is homogeneous too). More than one distinct hash
+        means some replica found a different — or stale — profile on
+        disk; bill `fleet.profile_divergence` and log the structured
+        event so operators see it the moment the odd replica hellos."""
+        with self._lock:
+            hashes = sorted({h.hello.get("profile_hash")
+                             for h in self._replicas
+                             if h.state == "serving"
+                             and h.hello.get("profile_hash")})
+        if len(hashes) > 1:
+            with _STATS_LOCK:
+                FLEET_STATS["profile_divergence"] += 1
+            _log_event("fleet.profile_divergence", hashes=hashes)
+            return True
+        return False
 
     def retraces_after_warmup(self):
         """Max replica-reported compiled-program growth since warmup

@@ -187,10 +187,40 @@ class _StubEngine:
         self._thread.join(timeout=timeout)
 
 
+def _resolve_profile(spec):
+    """Activate the deployment profile for this replica's model, if one
+    exists: explicit `spec["profile"]` path > ``MXNET_TUNE_PROFILE`` >
+    lookup by (model, hardware) fingerprint under the profile dir.
+    Returns the applied profile hash (reported in the hello so the Fleet
+    can detect divergent tunings) or None — a mismatched or corrupt
+    profile falls back loudly inside tune.profile and the replica boots
+    on env/defaults; tuning must never keep a replica down."""
+    if spec.get("stub"):
+        # jax-free protocol stub: pass a declared hash through verbatim
+        # (lets fleet-level divergence plumbing be tested without a model)
+        return spec.get("profile_hash")
+    try:
+        from ..tune import profile as _tprof
+        model_fp = _tprof.model_fingerprint(spec.get("config", {}))
+        path = spec.get("profile") or os.environ.get("MXNET_TUNE_PROFILE")
+        if path:
+            prof = _tprof.DeploymentProfile.load(path)
+        else:
+            prof = _tprof.lookup(model_fp)
+        if prof is not None and _tprof.activate(prof, model_fp=model_fp,
+                                                source="replica"):
+            return prof.profile_hash
+    except Exception as e:  # noqa: BLE001 — boot anyway, on defaults
+        logger.warning("deployment profile unavailable (%s); replica "
+                       "starts on env/defaults", e)
+    return None
+
+
 def _build_engine(spec):
     """Engine from a version-pinned spec manifest. `stub: true` selects
     the jax-free protocol stub (tests/bench harness plumbing); otherwise a
-    CachedDecoder + ContinuousEngine (warm via MXNET_COMPILE_CACHE_DIR)."""
+    CachedDecoder + ContinuousEngine (warm via MXNET_COMPILE_CACHE_DIR,
+    tuned via the activated deployment profile)."""
     if spec.get("stub"):
         return _StubEngine(spec)
     from .continuous import (CachedDecoder, ContinuousEngine,
@@ -219,6 +249,7 @@ def main(argv=None):
     version = str(spec.get("version", "v0"))
 
     metrics_port = _start_metrics(args.replica)
+    profile_hash = _resolve_profile(spec)    # before any program builds
     eng = _build_engine(spec)
 
     sock = socket.create_connection(("127.0.0.1", args.connect),
@@ -238,7 +269,8 @@ def main(argv=None):
     send({"type": "hello", "replica": args.replica, "pid": os.getpid(),
           "version": version, "metrics_port": metrics_port,
           "warmup_s": eng.warmup_s,
-          "compile_cache_size": eng.compile_cache_size()})
+          "compile_cache_size": eng.compile_cache_size(),
+          "profile_hash": profile_hash})
 
     drain_started = threading.Event()
     done = threading.Event()

@@ -22,10 +22,26 @@ def inject(point, value=None):
 
 def f():
     get_env("MXNET_FIXTURE_DOCUMENTED")
-    get_env("MXNET_FIXTURE_SECRET")      # env-undocumented
+    get_env("MXNET_FIXTURE_SECRET")      # env-undocumented (and, because
+    #                                      this module is knob-wired:
+    #                                      tune-env-undeclared)
+    get_env("MXNET_FIXTURE_KNOB")        # declared knob env: clean
     inject("alpha.save")
     inject("gamma.run")
     inject("delta.crash")                # fault-point-unregistered
+
+
+# --- tune knob catalog (mx.tune.space shape; parsed only) ------------------
+KNOBS = {
+    "fix.good": {"kind": "int", "default": 1, "choices": [1, 2],
+                 "env": "MXNET_FIXTURE_KNOB", "phase": "p",
+                 "wire": "pkg/mod.py"},      # declared + documented: clean
+    "fix.secret": {"kind": "bool", "default": True,
+                   "choices": [True, False], "env": None, "phase": "p",
+                   "wire": None},            # -> tune-knob-undocumented
+}
+
+NON_TUNABLE_ENV = {"MXNET_FIXTURE_DOCUMENTED"}
 
 
 def stats_group(family, initial, lock=None):

@@ -15,6 +15,8 @@ import time
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # nightly tier (~10s each)
+
 
 @pytest.mark.nightly
 def test_million_vocab_embedding_trains():

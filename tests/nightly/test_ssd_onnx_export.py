@@ -5,11 +5,15 @@ ONNX NonMaxSuppression node — and the file is structurally valid
 tiny-SSD graph in tests/test_onnx_export.py; evaluating VGG16 at 300x300
 through the numpy conv is too slow for CI."""
 import numpy as np
+import pytest
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import onnx as mxonnx
 from incubator_mxnet_tpu.gluon.model_zoo import detection
 from incubator_mxnet_tpu.onnx import _runtime
+
+# nightly tier: full VGG16 backbone trace is ~30s on one CPU core
+pytestmark = pytest.mark.slow
 
 
 def test_ssd300_exports_with_nms(tmp_path):

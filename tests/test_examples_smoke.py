@@ -18,6 +18,7 @@ def _load(name):
     return mod
 
 
+@pytest.mark.slow  # nightly-grade convergence run (~30s)
 def test_actor_critic_learns():
     m = _load("actor_critic")
     # run() now seeds the global numpy stream too (action sampling), so
@@ -44,6 +45,7 @@ def test_sn_gan_rejects_hybridize():
         layer.hybridize()
 
 
+@pytest.mark.slow  # nightly-grade convergence run (~25s)
 def test_tree_lstm_converges():
     m = _load("tree_lstm")
     losses = m.run(epochs=4, n_trees=30)

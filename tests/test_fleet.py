@@ -337,7 +337,7 @@ def test_real_fleet_zero_retraces_fleet_wide(real_fleet):
 def test_fleet_stats_group_and_replica_state_gauge(real_fleet):
     assert set(serve.FLEET_STATS) == {
         "replicas_live", "failovers", "retries", "respawns", "swaps",
-        "drain_ms"}
+        "drain_ms", "profile_divergence"}
     snap = telemetry.REGISTRY.snapshot()
     for key in ("fleet.replicas_live", "fleet.failovers", "fleet.retries",
                 "fleet.respawns", "fleet.swaps", "fleet.drain_ms"):

@@ -247,6 +247,19 @@ def test_registry_consistency_fixture_findings():
         {"fixture_owner_ghost"}
     assert "fixture_tag_owner" not in {
         f.symbol for f in by["mem-owner-undocumented"]}
+    # tune knob catalog (mx.tune): the KNOBS literal vs the section-scoped
+    # TUNING.md "Knob catalog" table, both directions, plus MXNET_* reads
+    # in knob-wired modules that are neither declared knob envs nor in
+    # NON_TUNABLE_ENV
+    assert {f.symbol for f in by["tune-knob-undocumented"]} == \
+        {"fix.secret"}
+    assert {f.symbol for f in by["tune-doc-stale"]} == {"fix.ghost"}
+    assert "fix.off_section" not in {
+        f.symbol for f in by["tune-doc-stale"]}
+    assert {f.symbol for f in by["tune-env-undeclared"]} == \
+        {"MXNET_FIXTURE_SECRET"}
+    assert "MXNET_FIXTURE_KNOB" not in {
+        f.symbol for f in by["tune-env-undeclared"]}
 
 
 def test_stats_group_adoption_still_yields_stats_keys():

@@ -110,6 +110,7 @@ def test_export_isfinite_semantics(tmp_path):
     np.testing.assert_array_equal(got, [1.0, 0.0, 0.0, 0.0, 1.0])
 
 
+@pytest.mark.slow  # nightly-grade: full resnet50 export + runtime (~25s)
 def test_export_resnet50_numeric(tmp_path):
     """VERDICT-r3 Next #8: the flagship CNN exports (64px input keeps the
     numpy-evaluator runtime bounded; the graph is identical to 224px)."""

@@ -104,6 +104,7 @@ def test_collectives_inside_shard_map():
     np.testing.assert_array_equal(np.asarray(g).ravel(), x.ravel())
 
 
+@pytest.mark.slow  # nightly-grade: multichip dry-run compile (~18s)
 def test_transformer_multichip_dryrun():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)

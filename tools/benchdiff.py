@@ -114,6 +114,13 @@ TREND_KEYS = {
     # quantized-cache capacity win — must not shrink
     "serve_decode_tokens_per_sec_spec": "higher",
     "kv_slots_per_gb": "higher",
+    # tune phase (PR 18, mx.tune): the swept profile's worst per-phase
+    # score over the hand-tuned committed baseline — a FLOOR metric with
+    # 1.0 as its structural floor (trial 0 measures the hand assignment
+    # itself, so best < hand can only mean the sweep machinery broke);
+    # failed trials are gated absolutely below (healthy baseline is 0)
+    "tune_profile_vs_hand_speedup": "higher",
+    "tune_trials_failed": "lower",
 }
 
 # floor metrics whose healthy committed baseline IS 0 (a ratio threshold
@@ -124,6 +131,7 @@ TREND_KEYS = {
 ABS_THRESHOLDS = {
     "leakcheck_growth_mb": 1.0,     # a real leak is tens of MB/round
     "fleet_swap_dropped_requests": 0.5,   # ANY dropped request regresses
+    "tune_trials_failed": 0.5,      # ANY crashed sweep trial regresses
 }
 
 DEFAULT_THRESHOLD = 0.10
@@ -481,6 +489,28 @@ def self_test():
                        kv_slots_per_gb=34000.0))
     check("improving decode keys pass with improvements reported",
           rep["status"] == "ok" and len(rep["improvements"]) == 2)
+    # tune keys (PR 18, mx.tune): the swept profile's worst-phase speedup
+    # over hand-tuned falling below its structural 1.0 floor gates the
+    # trend; tune_trials_failed is a FLOOR metric like leakcheck — the
+    # healthy committed baseline is 0 failed trials and ANY crashed
+    # trial must fire from it
+    tune_base = {"backend_ok": True,
+                 "tune_profile_vs_hand_speedup": 1.2,
+                 "tune_trials_failed": 0.0}
+    rep = compare(tune_base,
+                  dict(tune_base, tune_profile_vs_hand_speedup=0.9))
+    check("profile-vs-hand speedup drop is a regression",
+          rep["status"] == "regression"
+          and rep["regressions"][0]["key"]
+          == "tune_profile_vs_hand_speedup")
+    rep = compare(tune_base, dict(tune_base, tune_trials_failed=2.0))
+    check("any failed sweep trial fires from a 0 committed baseline",
+          rep["status"] == "regression"
+          and rep["regressions"][0]["key"] == "tune_trials_failed")
+    rep = compare(tune_base,
+                  dict(tune_base, tune_profile_vs_hand_speedup=1.5))
+    check("improving tune keys pass with improvements reported",
+          rep["status"] == "ok" and len(rep["improvements"]) == 1)
     missing_only_new = {"backend_ok": True,
                         "io_pipeline_images_per_sec": 700.0}
     check("keys missing from one side are skipped, not regressions",
