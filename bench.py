@@ -780,6 +780,40 @@ def _phase_serve_decode(quick=False):
     return out
 
 
+def _phase_serve_prefill(quick=False):
+    """Shared-prefix prefill trend row (serve_bench --shared-prefix):
+    prompt tokens/s cache-on vs cache-off on the N-system-prompts ×
+    M-users workload, the cached-token share and short-request
+    interference TTFT p99 (both benchdiff-gated), the hit/chunked
+    token-exactness verdict, and the zero-retrace observables."""
+    args = ["--shared-prefix", "--duration", "2.0" if quick else "6.0"]
+    if quick:
+        args.append("--quick")
+    r = _run_serve_bench(args, timeout=900)
+    if r is None:
+        return {}
+    out = {}
+    for k in ("serve_prefill_speedup_cached",
+              "serve_prefill_ttft_p50_speedup",
+              "prefill_cached_token_share",
+              "serve_ttft_p99_ms_interference",
+              "serve_ttft_p99_ms_no_longs",
+              "interference_ttft_p99_blowup",
+              "prefill_token_exact"):
+        if r.get(k) is not None:
+            out[k] = r[k]
+    on = r.get("cache_on", {})
+    for k in ("prefill_tokens_per_sec", "prefix_hit_rate",
+              "retraces_after_warmup"):
+        if on.get(k) is not None:
+            out[f"serve_prefill_{k}"] = on[k]
+    off = r.get("cache_off", {})
+    if off.get("prefill_tokens_per_sec") is not None:
+        out["serve_prefill_tokens_per_sec_nocache"] = \
+            off["prefill_tokens_per_sec"]
+    return out
+
+
 def bench_fused_train(model="resnet18", batch_size=32, iters=12, warmup=4,
                       layout="NHWC", use_amp=True, remat=None, donate=True,
                       use_fusion=True, tiny=False):
@@ -1146,6 +1180,7 @@ PHASES = [
     ("serve", _phase_serve),
     ("serve_continuous", _phase_serve_continuous),
     ("serve_decode", _phase_serve_decode),
+    ("serve_prefill", _phase_serve_prefill),
     ("fleet", _phase_fleet),
     ("tune", _phase_tune),
     ("elastic", _phase_elastic),
@@ -1205,6 +1240,12 @@ def _phase_serve_decode_quick():
     return _phase_serve_decode(quick=True)
 
 
+def _phase_serve_prefill_quick():
+    # same keys, tiny decoder + short windows: the tier-1 smoke exercises
+    # cache A/B + chunked interference + hit/chunked exactness end to end
+    return _phase_serve_prefill(quick=True)
+
+
 def _phase_fleet_quick():
     # same keys, stub replicas + short windows (stamped meta.stub inside
     # fleet_bench): the tier-1 smoke exercises supervisor + router +
@@ -1234,6 +1275,7 @@ QUICK_PHASES = {
     "elastic": _phase_elastic_quick,
     "serve_continuous": _phase_serve_continuous_quick,
     "serve_decode": _phase_serve_decode_quick,
+    "serve_prefill": _phase_serve_prefill_quick,
     "fleet": _phase_fleet_quick,
     "tune": _phase_tune_quick,
     "memory": _phase_memory_quick,
@@ -1244,7 +1286,8 @@ QUICK_PHASES = {
 PHASE_TIMEOUTS = {
     "dispatch": 300, "eager": 900, "train32": 1500, "train128": 1500,
     "infer": 900, "io": 700, "input_pipeline": 700, "serve": 700,
-    "serve_continuous": 900, "serve_decode": 900, "fleet": 700,
+    "serve_continuous": 900, "serve_decode": 900,
+    "serve_prefill": 900, "fleet": 700,
     "tune": 1200, "elastic": 700, "memory": 700,
     "offenders": 700,
     "fused_sweep": 2000, "calib": 900, "xla_flops": 600,

@@ -57,6 +57,8 @@ from .batcher import (ServeError, QueueFullError, RequestTimeout,
 from .metrics import SERVE_STATS, ServeMetrics, serve_stats as stats
 from .kv_pool import (KVCachePool, SlotsFullError, KVPOOL_STATS,
                       kvpool_stats)
+from .prefix_cache import (PrefixCache, PrefixCacheError, PREFIX_STATS,
+                           prefix_stats)
 from .continuous import (ContinuousEngine, CachedDecoder, DecoderConfig,
                          init_decoder_params)
 from .fleet import (Fleet, FleetError, ReplicaDied, FLEET_STATS,
@@ -71,6 +73,8 @@ __all__ = [
     "ContinuousEngine", "CachedDecoder", "DecoderConfig",
     "init_decoder_params", "KVCachePool", "SlotsFullError",
     "KVPOOL_STATS", "kvpool_stats",
+    # shared-prefix KV cache
+    "PrefixCache", "PrefixCacheError", "PREFIX_STATS", "prefix_stats",
     # multi-replica serving fleet
     "Fleet", "FleetError", "ReplicaDied", "ReplicaDraining",
     "FLEET_STATS", "fleet_stats",
@@ -99,6 +103,15 @@ _register_env("MXNET_SERVE_PREFILL_LANES", int, None,
 _register_env("MXNET_SERVE_KV_DTYPE", str, None,
               "KV pool storage dtype ('int8' = quantized codes + "
               "scales; unset = model dtype)")
+_register_env("MXNET_SERVE_PREFIX_BLOCK", int, 16,
+              "Shared-prefix cache granularity in tokens (prefixes "
+              "cache and match on whole blocks only)")
+_register_env("MXNET_SERVE_PREFIX_CACHE_SLOTS", int, 0,
+              "Dedicated KV-pool rows holding shared-prefix KV for "
+              "reuse across requests (0 = prefix cache off)")
+_register_env("MXNET_SERVE_PREFIX_CACHE_INSERT", int, 1,
+              "Publish a retiring request's own prompt prefix back "
+              "into the shared-prefix cache (0 = read-only cache)")
 _register_env("MXNET_FLEET_REPLICAS", int, 2,
               "Replica worker processes a serve.Fleet spawns")
 _register_env("MXNET_FLEET_HEARTBEAT_MS", float, 500.0,

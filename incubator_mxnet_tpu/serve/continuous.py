@@ -102,6 +102,7 @@ from .batcher import (ServeError, QueueFullError, RequestTimeout,
                       ServerClosed, ReplicaDraining, _fail, _profiler_on)
 from .metrics import SERVE_STATS, _STATS_LOCK, percentile
 from .kv_pool import KVCachePool, SlotsFullError
+from .prefix_cache import PrefixCache
 
 __all__ = ["DecoderConfig", "CachedDecoder", "ContinuousEngine",
            "init_decoder_params"]
@@ -268,14 +269,27 @@ def _store_pos(cache, rows, l, wpos, val):
             scales.at[rows, l, wpos].set(s))
 
 
-def _paged_attn(k_cache, v_cache, q, lengths, l):
+def _paged_attn(k_cache, v_cache, q, lengths, l, extent=None):
     """Decode-side attention read over the slot slab via
     `ops.fused.paged_attention`: Pallas block-sparse kernel on TPU (or
     interpret-mode CI), identical masked-einsum jnp fallback elsewhere.
-    q is (S, C, H, D); chunk offset j reads positions [0, lengths+j]."""
+    q is (S, C, H, D); chunk offset j reads positions [0, lengths+j].
+
+    `extent` statically slices the slab's position axis to [0, extent)
+    before the read: when the caller can bound `lengths + j < extent`
+    for every lane, the masked positions beyond it contribute exact
+    zeros, so the output is bit-identical to the full-width read at a
+    fraction of the cost (the chunk-prefill extent ladder)."""
     from ..ops import fused as _fused
     k_slab, k_scale = _kv_split(k_cache)
     v_slab, v_scale = _kv_split(v_cache)
+    if extent is not None and extent < k_slab.shape[2]:
+        k_slab = k_slab[:, :, :extent]
+        v_slab = v_slab[:, :, :extent]
+        if k_scale is not None:
+            k_scale = k_scale[:, :, :extent]
+        if v_scale is not None:
+            v_scale = v_scale[:, :, :extent]
     return _fused.paged_attention(q, k_slab, v_slab, lengths, l,
                                   k_scale=k_scale, v_scale=v_scale)
 
@@ -337,6 +351,92 @@ def _make_prefill(config, window=None):
         return k_cache, v_cache, logits
 
     return prefill
+
+
+def _make_chunk_prefill(config, window=None, extent=None):
+    """Build the CHUNK prefill step: one window-sized slice of a prompt,
+    scattered into its slot page at an arbitrary offset — the PR-17
+    spec-decode verify-chunk idiom (explicit-position `_store_pos`
+    writes + a paged-attention read clamped to `[0, offset + j]`)
+    widened from draft+1 to `window` positions. This is how prompts
+    longer than `prefill_window` stream in across waves, and how a
+    prefix-cache hit prefills only its suffix.
+
+    Unlike `_make_prefill`, lanes here are POOL ROWS (lane s writes row
+    s, exactly like decode): ONE fixed-shape dispatch advances EVERY
+    slot with pending chunk work — a long cold prompt mid-stream and a
+    cache hit's suffix alike — and a lane with `nvalid == 0` scatters
+    into the garbage row. Queries at chunk offset j attend over slab
+    positions [0, offsets + j]; positions below `offsets` must already
+    hold the prefix KV (earlier chunks, or a prefix-cache row copy).
+    Logits come from each lane's LAST valid chunk position — only
+    meaningful for a lane whose chunk ends at its prompt tail, which is
+    exactly when the engine samples the first token from them.
+
+    `extent` bounds the attention read to slab positions [0, extent):
+    valid for a wave whose furthest lane satisfies offset + nvalid <=
+    extent. Positions past the bound are mask-excluded zeros either
+    way, so a smaller extent is bit-identical and cheaper — the engine
+    warms a ladder of extents and dispatches the smallest one that
+    covers the wave."""
+    import jax
+    import jax.numpy as jnp
+    c = config
+    W = int(window if window is not None else c.max_len)
+    if not 1 <= W <= c.max_len:
+        raise ServeError(f"chunk window {W} outside [1, {c.max_len}]")
+    E = int(extent if extent is not None else c.max_len)
+    if not W <= E <= c.max_len:
+        raise ServeError(
+            f"chunk extent {E} outside [window={W}, {c.max_len}]")
+
+    def chunk_prefill(params, k_cache, v_cache, tokens, offsets, nvalid):
+        # tokens (S, W) int32 chunk slice; offsets (S,) page position of
+        # tokens[:, 0]; nvalid (S,) valid token count (0 = idle lane)
+        S = tokens.shape[0]
+        T = c.max_len
+        j = jnp.arange(W)
+        wposs = jnp.clip(offsets[:, None] + j[None, :], 0, T - 1)
+        valid = j[None, :] < nvalid[:, None]                    # (S, W)
+        rows = jnp.where(valid, jnp.arange(S)[:, None], S)   # garbage=S
+        x = params["emb"][tokens] + params["pos"][wposs]
+        for l in range(c.layers):
+            h = _rmsnorm(x, params["ln1"][l])
+            q = (h @ params["wq"][l]).reshape(S, W, c.heads, c.head_dim)
+            k = (h @ params["wk"][l]).reshape(S, W, c.heads, c.head_dim)
+            v = (h @ params["wv"][l]).reshape(S, W, c.heads, c.head_dim)
+            k_cache = _store_pos(k_cache, rows, l, wposs, k)
+            v_cache = _store_pos(v_cache, rows, l, wposs, v)
+            att = _paged_attn(k_cache, v_cache, q, offsets, l, extent=E)
+            x = x + att.reshape(S, W, c.embed) @ params["wo"][l]
+            h2 = _rmsnorm(x, params["ln2"][l])
+            x = x + jax.nn.gelu(h2 @ params["w1"][l]) @ params["w2"][l]
+        xf = _rmsnorm(x, params["lnf"])
+        last = xf[jnp.arange(S), jnp.maximum(nvalid - 1, 0)]
+        logits = last @ params["emb"].T
+        return k_cache, v_cache, logits
+
+    return chunk_prefill
+
+
+def _copy_slot_rows(k_cache, v_cache, src_rows, dst_rows):
+    """Whole-row slab-to-slab KV copy — the prefix-cache data mover: a
+    cache row gathers into a claimed request slot at admission (the
+    memory-bound copy that replaces compute-bound prefill attention) and
+    a retiring request's slot gathers into a cache row at publish. Fixed
+    (C,) lane shapes; an idle lane copies the garbage row onto itself.
+    Donation makes it an in-place slab update on accelerators. int8
+    pools copy codes AND scales, so a copied position dequantizes
+    bit-identically to the original."""
+    k_slab, k_scale = _kv_split(k_cache)
+    v_slab, v_scale = _kv_split(v_cache)
+    k_slab = k_slab.at[dst_rows].set(k_slab[src_rows])
+    v_slab = v_slab.at[dst_rows].set(v_slab[src_rows])
+    if k_scale is None:
+        return k_slab, v_slab
+    k_scale = k_scale.at[dst_rows].set(k_scale[src_rows])
+    v_scale = v_scale.at[dst_rows].set(v_scale[src_rows])
+    return (k_slab, k_scale), (v_slab, v_scale)
 
 
 def _make_decode(config, steps=1, eos_id=None):
@@ -584,7 +684,9 @@ class CachedDecoder:
         # decode scan length + eos), each its own jit: built once per
         # engine at construction — steady state replays, never re-builds
         self._prefills = {}
+        self._chunks = {}
         self._decodes = {}
+        self._copy = None
         self._prefill = self.prefill_program(config.max_len)
         self._decode = self.decode_program(1, None)
 
@@ -619,6 +721,34 @@ class CachedDecoder:
                          donate_argnums=(1, 2))
             self._prefills[key] = fn
         return fn
+
+    def chunk_prefill_program(self, window, extent=None):
+        """The jitted CHUNK prefill program for a (window, extent) pair:
+        scatter one window-sized prompt slice at an arbitrary page
+        offset and emit logits at each lane's chunk tail (chunked
+        prefill of long prompts + prefix-cache suffix prefill,
+        serve/continuous.py). `extent` bounds the attention read — the
+        engine warms a ladder of extents per window and dispatches the
+        smallest one covering each wave."""
+        import jax
+        key = (int(window),
+               int(extent if extent is not None else self.config.max_len))
+        fn = self._chunks.get(key)
+        if fn is None:
+            fn = jax.jit(_make_chunk_prefill(self.config, window=key[0],
+                                             extent=key[1]),
+                         donate_argnums=(1, 2))
+            self._chunks[key] = fn
+        return fn
+
+    def copy_program(self):
+        """The jitted slab-to-slab KV row-copy program (prefix-cache
+        admission hit / retire publish): a memory-bound gather, no
+        attention math, donated like every other slab consumer."""
+        import jax
+        if self._copy is None:
+            self._copy = jax.jit(_copy_slot_rows, donate_argnums=(0, 1))
+        return self._copy
 
     def decode_program(self, steps, eos_id=None, draft=0):
         """The jitted decode program for a (steps, eos, draft) variant
@@ -674,7 +804,11 @@ class CachedDecoder:
     def compile_cache_size(self):
         """Total compiled programs across every jit (-1 unknown) — the
         zero-retrace observable (≙ ExportedModel.compile_cache_size)."""
-        fns = list(self._prefills.values()) + list(self._decodes.values())
+        fns = (list(self._prefills.values())
+               + list(self._chunks.values())
+               + list(self._decodes.values()))
+        if self._copy is not None:
+            fns.append(self._copy)
         sizes = [int(getattr(f, "_cache_size", lambda: -1)())
                  for f in fns]
         if any(s < 0 for s in sizes):
@@ -684,39 +818,73 @@ class CachedDecoder:
     def reference_generate(self, prompt, max_new_tokens, eos_id=None,
                            window=None, temperature=0.0, top_k=0,
                            top_p=1.0, seed=0, draft_tokens=0,
-                           kv_dtype=None):
+                           kv_dtype=None, cached_prefix_len=0):
         """Generation through a PRIVATE 1-slot pool — the
         scheduling-free reference the engine's mixed-batch outputs must
         match token-for-token (tests). Uses the same compiled math; pass
         the engine's `prefill_window` so the prefill page width (and so
-        the float-op layout) matches bit-for-bit. Sampling
-        (`temperature > 0` with the request seed) matches the engine
-        because the draw key is a pure function of (seed, position);
-        `draft_tokens > 0` runs the speculative program one wave at a
-        time with a host-rebuilt history page — same tokens, by the
-        exact-verification contract. `kv_dtype="int8"` mirrors an int8
-        engine pool."""
+        the float-op layout) matches bit-for-bit. Prompts longer than
+        the window replay the engine's CHUNKED prefill: a windowed first
+        chunk at offset 0, then window-sized slices through the chunk
+        program. `cached_prefix_len=L` mirrors a prefix-cache HIT —
+        positions [0, L) carry the canonical cold provenance (windowed
+        head + chunked remainder; a cache row copy is bit-identical to
+        that by the causal mask, which makes prefix KV depend only on
+        prefix tokens), while the suffix [L, plen) goes through the
+        chunk program exactly as the engine prefills it after the row
+        copy — so hit-path outputs are checked against an explicit
+        reference, never assumed. Sampling (`temperature > 0` with the
+        request seed) matches the engine because the draw key is a pure
+        function of (seed, position); `draft_tokens > 0` runs the
+        speculative program one wave at a time with a host-rebuilt
+        history page — same tokens, by the exact-verification contract.
+        `kv_dtype="int8"` mirrors an int8 engine pool."""
         import jax.numpy as jnp
         pool = self.new_pool(max_slots=1, dtype=kv_dtype)
         W = int(window if window is not None else self.config.max_len)
-        plen = len(prompt)
-        if plen < 1 or plen > W or plen >= self.config.max_len:
+        prompt = _np.asarray(prompt, dtype=_np.int32).ravel()
+        plen = int(prompt.size)
+        if plen < 1 or plen >= self.config.max_len:
             raise ServeError(
-                f"prompt length {plen} outside [1, min(window={W}, "
-                f"max_len-1={self.config.max_len - 1})]")
+                f"prompt length {plen} outside [1, max_len-1="
+                f"{self.config.max_len - 1}]")
+        L = int(cached_prefix_len)
+        if not 0 <= L < plen:
+            raise ServeError(
+                f"cached_prefix_len {L} outside [0, plen-1={plen - 1}]")
         temps = jnp.asarray([float(temperature)], dtype=jnp.float32)
         tks = jnp.asarray([int(top_k)], dtype=jnp.int32)
         tps = jnp.asarray([float(top_p)], dtype=jnp.float32)
         keys = jnp.asarray(_seed_key(seed)[None, :])
+        # windowed head: a cold request's offset-0 wave covers
+        # min(plen, W) tokens; a hit's head stops at the cache boundary
+        # (its suffix is chunk-prefilled even when it would fit windowed)
+        head = min(plen if L == 0 else L, W)
         toks = _np.zeros((1, W), dtype=_np.int32)
-        toks[0, :plen] = prompt
+        toks[0, :head] = prompt[:head]
         k, v = pool.buffers()
-        k, v, first = self.prefill(
-            k, v, jnp.asarray(toks),
-            jnp.asarray([plen], dtype=jnp.int32),
-            jnp.asarray([0], dtype=jnp.int32),
-            temps, tks, tps, keys)
+        k, v, logits = self.prefill_program(W)(
+            self.params, k, v, jnp.asarray(toks),
+            jnp.asarray([head], dtype=jnp.int32),
+            jnp.asarray([0], dtype=jnp.int32))
         pool.swap_buffers(k, v)
+        # chunked remainder through the SAME chunk program the engine
+        # dispatches; the final chunk's logits sit at the prompt tail
+        pos = head
+        while pos < plen:
+            n = min(W, plen - pos)
+            ctoks = _np.zeros((1, W), dtype=_np.int32)
+            ctoks[0, :n] = prompt[pos:pos + n]
+            k, v = pool.buffers()
+            k, v, logits = self.chunk_prefill_program(W)(
+                self.params, k, v, jnp.asarray(ctoks),
+                jnp.asarray([pos], dtype=jnp.int32),
+                jnp.asarray([n], dtype=jnp.int32))
+            pool.swap_buffers(k, v)
+            pos += n
+        first = _sample_first(
+            logits, temps, tks, tps, keys,
+            jnp.asarray([plen - 1], dtype=jnp.int32))
         out = [int(first[0])]
         cache_len = plen
         draft = int(draft_tokens)
@@ -761,7 +929,8 @@ class CachedDecoder:
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "future", "deadline", "t_submit",
                  "ctx", "slot", "generated", "cache_len", "t_first",
-                 "t_last", "temperature", "top_k", "top_p", "key")
+                 "t_last", "temperature", "top_k", "top_p", "key",
+                 "entry", "cached_len", "prefill_pos")
 
     def __init__(self, prompt, max_new, deadline, ctx,
                  temperature=0.0, top_k=0, top_p=1.0, key=None):
@@ -780,6 +949,9 @@ class _GenRequest:
         self.top_k = top_k
         self.top_p = top_p
         self.key = key if key is not None else _seed_key(0)  # uint32 (2,)
+        self.entry = None        # pinned prefix-cache entry (hit path)
+        self.cached_len = 0      # prompt tokens served from the cache
+        self.prefill_pos = 0     # prompt tokens already in KV (chunked)
 
     def sort_key(self):
         """Earliest-deadline-first; deadline-less requests rank after
@@ -832,6 +1004,22 @@ class ContinuousEngine:
                        codes + per-position f32 scales (~4x KV bytes
                        saved at float32 serving dtype — see
                        pool.stats()["slots_per_gb"])
+      prefix_cache_slots  dedicated pool rows holding shared-prefix KV
+                       (MXNET_SERVE_PREFIX_CACHE_SLOTS, default 0 =
+                       off): admission matches the longest cached
+                       prefix, row-copies its KV into the claimed slot,
+                       and prefills ONLY the suffix
+      prefix_block     prefix-cache granularity in tokens
+                       (MXNET_SERVE_PREFIX_BLOCK): prefixes cache and
+                       match on whole blocks only
+      prefix_cache_insert  publish a retiring request's own prompt
+                       prefix back into the cache
+                       (MXNET_SERVE_PREFIX_CACHE_INSERT, default on)
+
+    Prompts longer than `prefill_window` stream in window-sized CHUNKS
+    across successive waves (the chunk program advances every
+    mid-prefill lane per wave), so one long prompt never monopolizes a
+    prefill wave and short requests' TTFT stays bounded.
 
     Exactly one scheduler thread runs the compiled steps, so the donated
     KV buffers have a single writer; submit() is safe from any thread.
@@ -840,7 +1028,8 @@ class ContinuousEngine:
     def __init__(self, model, *, max_slots=None, prefill_budget=None,
                  prefill_lanes=None, prefill_window=None, decode_steps=None,
                  max_queue=None, default_deadline_ms=None, eos_id=None,
-                 draft_tokens=None, kv_dtype=None,
+                 draft_tokens=None, kv_dtype=None, prefix_block=None,
+                 prefix_cache_slots=None, prefix_cache_insert=None,
                  name="serve.continuous"):
         from ..tune.profile import resolve as _tune_resolve
         self.model = model
@@ -853,8 +1042,49 @@ class ContinuousEngine:
             if kv_dtype is None:
                 kv_dtype = get_env("MXNET_SERVE_KV_DTYPE")
         self.kv_dtype = kv_dtype
-        self.pool = model.new_pool(max_slots, dtype=kv_dtype)
-        self.max_slots = self.pool.max_slots
+        # shared-prefix reuse tier (serve/prefix_cache.py): cached
+        # prefixes live in DEDICATED pool rows claimed once here, so
+        # admission capacity (max_slots) and cache capacity are
+        # independent knobs and SlotsFullError semantics are unchanged
+        if prefix_block is None:
+            prefix_block = _tune_resolve("serve.prefix_block")
+            if prefix_block is None:
+                prefix_block = get_env("MXNET_SERVE_PREFIX_BLOCK", 16,
+                                       typ=int)
+        self.prefix_block = int(prefix_block)
+        if self.prefix_block < 1:
+            raise ServeError("prefix_block must be >= 1")
+        if prefix_cache_slots is None:
+            prefix_cache_slots = _tune_resolve("serve.prefix_cache_slots")
+            if prefix_cache_slots is None:
+                prefix_cache_slots = get_env(
+                    "MXNET_SERVE_PREFIX_CACHE_SLOTS", 0, typ=int)
+        self.prefix_cache_slots = int(prefix_cache_slots)
+        if self.prefix_cache_slots < 0:
+            raise ServeError("prefix_cache_slots must be >= 0")
+        if prefix_cache_insert is None:
+            prefix_cache_insert = _tune_resolve(
+                "serve.prefix_cache_insert")
+            if prefix_cache_insert is None:
+                prefix_cache_insert = bool(get_env(
+                    "MXNET_SERVE_PREFIX_CACHE_INSERT", 1, typ=int))
+        self.prefix_cache_insert = bool(prefix_cache_insert)
+        if max_slots is None:
+            max_slots = get_env("MXNET_SERVE_MAX_SLOTS", 8, typ=int)
+        self.max_slots = int(max_slots)
+        if self.max_slots < 1:
+            raise ServeError("max_slots must be >= 1")
+        # the pool is carved with max_slots REQUEST rows plus the
+        # dedicated prefix-cache rows; self.max_slots stays the request
+        # capacity every admission/queue bound sees
+        self.pool = model.new_pool(
+            self.max_slots + self.prefix_cache_slots, dtype=kv_dtype)
+        self._cache = None
+        if self.prefix_cache_slots:
+            self._cache = PrefixCache(
+                self.prefix_block,
+                [self.pool.claim()
+                 for _ in range(self.prefix_cache_slots)])
         # micro-iterations per compiled decode dispatch: >1 amortizes the
         # host round-trip over K tokens; admission/retirement happen at
         # wave granularity (a lane finishing mid-wave holds its slot
@@ -886,6 +1116,29 @@ class ContinuousEngine:
                 f"prefill_window must be in [1, max_len], got "
                 f"{self.prefill_window}")
         self._prefill_prog = model.prefill_program(self.prefill_window)
+        # the chunk programs exist whenever a prompt can outgrow the
+        # window (chunked streaming) or a cache hit leaves a suffix to
+        # prefill at a nonzero page offset. They form an EXTENT LADDER
+        # (window, 2*window, ... max_len): attention cost follows how
+        # far a wave's furthest lane has actually streamed, not
+        # max_len — every rung is warmed, so picking one per wave is
+        # still zero-retrace
+        self._chunk_progs = None
+        self._chunk_extents = ()
+        if (self.prefill_window < model.config.max_len
+                or self._cache is not None):
+            exts, e = [], self.prefill_window
+            while e < model.config.max_len:
+                exts.append(e)
+                e *= 2
+            exts.append(model.config.max_len)
+            self._chunk_extents = tuple(exts)
+            self._chunk_progs = {
+                x: model.chunk_prefill_program(self.prefill_window,
+                                               extent=x)
+                for x in exts}
+        self._copy_prog = (model.copy_program()
+                           if self._cache is not None else None)
         self.prefill_budget = int(
             prefill_budget if prefill_budget is not None
             else get_env("MXNET_SERVE_PREFILL_BUDGET", 256, typ=int))
@@ -912,6 +1165,7 @@ class ContinuousEngine:
 
         self._cv = threading.Condition()
         self._waiting = deque()              # submitted, no slot yet
+        self._prefilling = {}                # slot -> req, prompt KV partial
         self._running = {}                   # slot -> _GenRequest
         self._closing = False
         self._drain = True
@@ -929,7 +1183,8 @@ class ContinuousEngine:
             "admitted", "retired", "decode_iterations", "decode_tokens",
             "prefill_tokens", "prefill_batches", "programs_compiled",
             "active_sum", "sampled_tokens", "draft_accepted",
-            "draft_rejected")}
+            "draft_rejected", "prefix_hits", "prefix_misses",
+            "prefix_cached_tokens")}
         self._auto_seed = 0                  # per-engine seed fountain
         self._ttft_ms = deque(maxlen=4096)
         self._tpot_ms = deque(maxlen=4096)
@@ -955,14 +1210,15 @@ class ContinuousEngine:
         return self
 
     def _warmup(self):
-        """One garbage-lane prefill + one all-inactive decode: compiles
-        (or loads from MXNET_COMPILE_CACHE_DIR) both programs without
-        touching any real slot."""
+        """One garbage-lane pass through EVERY step program (prefill +
+        decode, plus the chunk-prefill and row-copy programs when
+        configured): compiles (or loads from MXNET_COMPILE_CACHE_DIR)
+        each without touching any real slot."""
         import jax
         import jax.numpy as jnp
         g = self.pool.garbage_row
         P = self.prefill_lanes
-        S = self.max_slots
+        S = self.pool.max_slots
         kb, vb = self.pool.buffers()
         lens = jnp.ones((P,), dtype=jnp.int32)
         k, v, logits = self._prefill_prog(
@@ -990,9 +1246,38 @@ class ContinuousEngine:
         out = self._decode_prog(*args)
         k, v = out[0], out[1]
         self.pool.swap_buffers(k, v)
+        n_progs = 2
+        if self._chunk_progs is not None:
+            # all-idle chunk wave (every lane scatters into garbage)
+            # through EVERY extent rung, so wave-time extent selection
+            # never compiles; warm the first-token sampler at the
+            # (S, vocab) shape the chunk path samples from too
+            logits = None
+            for prog in self._chunk_progs.values():
+                kb, vb = self.pool.buffers()
+                k, v, logits = prog(
+                    self.model.params, kb, vb,
+                    jnp.zeros((S, self.prefill_window), dtype=jnp.int32),
+                    jnp.zeros((S,), dtype=jnp.int32),
+                    jnp.zeros((S,), dtype=jnp.int32))
+                self.pool.swap_buffers(k, v)
+                n_progs += 1
+            _sample_first(logits, jnp.zeros((S,), dtype=jnp.float32),
+                          jnp.zeros((S,), dtype=jnp.int32),
+                          jnp.ones((S,), dtype=jnp.float32),
+                          jnp.zeros((S, 2), dtype=jnp.uint32),
+                          jnp.zeros((S,), dtype=jnp.int32))
+        if self._copy_prog is not None:
+            # garbage-onto-garbage row copy
+            kb, vb = self.pool.buffers()
+            k, v = self._copy_prog(
+                kb, vb, jnp.full((P,), g, dtype=jnp.int32),
+                jnp.full((P,), g, dtype=jnp.int32))
+            self.pool.swap_buffers(k, v)
+            n_progs += 1
         # wait for the compiles to actually finish so warmup_s is honest
-        jax.block_until_ready(k)
-        self._count("programs_compiled", 2)
+        jax.block_until_ready(self.pool.buffers()[0])
+        self._count("programs_compiled", n_progs)
 
     def __enter__(self):
         return self.start()
@@ -1038,9 +1323,11 @@ class ContinuousEngine:
 
     def queue_depth(self):
         """(waiting, running) request counts — the fleet router's
-        least-loaded placement signal."""
+        least-loaded placement signal. Mid-prefill (chunk-streaming)
+        requests hold slots, so they count as running."""
         with self._cv:
-            return len(self._waiting), len(self._running)
+            return (len(self._waiting),
+                    len(self._running) + len(self._prefilling))
 
     # -- submission --------------------------------------------------------
     def submit(self, prompt_tokens, max_new_tokens=16, deadline_ms=None,
@@ -1073,11 +1360,8 @@ class ContinuousEngine:
             raise ServeError(
                 f"prompt length {prompt.size} >= max_len {self.max_len} "
                 f"(one slot page holds prompt + generated tokens)")
-        if prompt.size > self.prefill_window:
-            raise ServeError(
-                f"prompt length {prompt.size} > prefill_window "
-                f"{self.prefill_window} (raise the engine's "
-                f"prefill_window for longer prompts)")
+        # prompts longer than prefill_window are fine: they stream in
+        # window-sized chunks across successive waves (chunked prefill)
         if max_new_tokens < 1:
             raise ServeError("max_new_tokens must be >= 1")
         _fault.inject("serve.enqueue")
@@ -1143,6 +1427,12 @@ class ContinuousEngine:
     def compile_cache_size(self):
         return self.model.compile_cache_size()
 
+    def prefix_hit_count(self):
+        """Lifetime prefix-cache hits — the cheap accessor the replica
+        heartbeat pong carries (full breakdown in `stats()`)."""
+        with self._mlock:
+            return self._counters["prefix_hits"]
+
     def retraces_after_warmup(self):
         """Compiled-program growth since start() — MUST be 0 in steady
         state (-1 when the jax version hides the counter)."""
@@ -1184,7 +1474,8 @@ class ContinuousEngine:
                 self.pool.scale_shape, "float32"))
         else:
             pool_aval = slab
-        P, S, W = self.prefill_lanes, self.max_slots, self.prefill_window
+        P, S, W = (self.prefill_lanes, self.pool.max_slots,
+                   self.prefill_window)
         prefill = self._prefill_prog.lower(
             params_avals, pool_aval, pool_aval, aval((P, W)), aval((P,)),
             aval((P,)))
@@ -1230,6 +1521,20 @@ class ContinuousEngine:
                 / (c["draft_accepted"] + c["draft_rejected"]), 4)
         out["prefill_lanes"] = self.prefill_lanes
         out["prefill_window"] = self.prefill_window
+        if self._cache is not None:
+            out["prefix_block"] = self.prefix_block
+            out["prefix_cache"] = self._cache.stats()
+            if c["prefix_hits"] + c["prefix_misses"] > 0:
+                out["prefix_hit_rate"] = round(
+                    c["prefix_hits"]
+                    / (c["prefix_hits"] + c["prefix_misses"]), 4)
+            if c["prefill_tokens"] + c["prefix_cached_tokens"] > 0:
+                # share of prompt tokens served by copy, not compute —
+                # the bench's prefill_cached_token_share trend number
+                out["prefill_cached_token_share"] = round(
+                    c["prefix_cached_tokens"]
+                    / (c["prefill_tokens"]
+                       + c["prefix_cached_tokens"]), 4)
         out["compile_cache_size"] = self.compile_cache_size()
         out["retraces_after_warmup"] = self.retraces_after_warmup()
         return out
@@ -1240,9 +1545,10 @@ class ContinuousEngine:
         while True:
             with self._cv:
                 while (not self._waiting and not self._running
-                       and not self._closing):
+                       and not self._prefilling and not self._closing):
                     self._cv.wait()
                 if self._closing and not self._running \
+                        and not self._prefilling \
                         and (not self._drain or not self._waiting):
                     for req in self._waiting:
                         _fail(req, ServerClosed(
@@ -1263,16 +1569,20 @@ class ContinuousEngine:
                     f"deadline expired after "
                     f"{(now - req.t_submit) * 1e3:.1f}ms waiting for a "
                     f"KV slot"))
-            if not admitted and not expired and not self._running:
+            if (not admitted and not expired and not self._running
+                    and not self._prefilling):
                 # waiting requests exist but no slot freed up (something
                 # outside the engine holds claims): timed wait, re-check —
                 # never a busy spin
                 with self._cv:
-                    if self._waiting and not self._running:
+                    if (self._waiting and not self._running
+                            and not self._prefilling):
                         self._cv.wait(timeout=0.005)
                 continue
             try:
-                if admitted:
+                # _prefilling is only ever mutated on this thread, so the
+                # unlocked read is single-writer safe
+                if admitted or self._prefilling:
                     self._run_prefill(admitted, jnp)
                 if self._running:
                     self._run_decode(jnp)
@@ -1287,9 +1597,14 @@ class ContinuousEngine:
                 err = e if isinstance(e, MXNetError) else ServeError(
                     f"engine step failed: {type(e).__name__}: {e}")
                 with self._cv:
-                    doomed = list(self._running.values())
+                    doomed = (list(self._running.values())
+                              + list(self._prefilling.values()))
                     self._running.clear()
+                    self._prefilling.clear()
                 for req in doomed:
+                    if req.entry is not None:
+                        self._cache.release(req.entry)
+                        req.entry = None
                     if req.slot is not None:
                         self.pool.free(req.slot)
                     _fail(req, err)
@@ -1300,12 +1615,28 @@ class ContinuousEngine:
                 # on 'Array has been deleted'. Every in-flight request
                 # was just failed, so zeroed slabs are the correct state.
                 self.pool.reallocate()
+                if self._cache is not None:
+                    # the reallocation zeroed the slab: every cached
+                    # prefix's KV bytes are gone, so the index goes too
+                    # (its dedicated rows stay claimed and refill later)
+                    self._cache.clear()  # mxlint: disable=lock-shared-mutation -- PrefixCache serializes internally (leaf lock); every ref was just released above
 
     def _admit_locked(self):
         """Deadline-aware admission (runs under self._cv): drop expired
         waiters from the queue, then grant free slots
-        earliest-deadline-first within the prefill token budget. Returns
-        (admitted, expired); the caller resolves expired futures off-lock."""
+        earliest-deadline-first within the prefill token budget.
+
+        A waiting request's cost is its POST-CACHE cost: the tokens the
+        next prefill wave will actually process — the uncached suffix,
+        capped at one window (longer suffixes stream chunk by chunk). So
+        a fully-cached request is near-free, ranks ahead of a cold long
+        prompt at an equal deadline, and a request that would bust the
+        budget no longer blocks cheaper waiters behind it (`continue`,
+        not `break` — the old full-prompt `break` both overbilled cache
+        hits and head-of-line-blocked on them). Chunks already streaming
+        bill the budget first. The >= 1 admission guarantee when a slot
+        is free is unchanged. Returns (admitted, expired); the caller
+        resolves expired futures off-lock."""
         now = time.perf_counter()
         expired = [r for r in self._waiting
                    if r.deadline is not None and now > r.deadline]
@@ -1315,19 +1646,42 @@ class ContinuousEngine:
                                   if id(r) not in dropset)
         admitted = []
         budget = self.prefill_budget
+        for req in self._prefilling.values():
+            budget -= min(self.prefill_window,
+                          int(req.prompt.size) - req.prefill_pos)
         free = self.pool.free_count()
         if free and self._waiting:
-            ranked = sorted(self._waiting, key=_GenRequest.sort_key)
+            costs = {}
+            for req in self._waiting:
+                mlen = 0
+                if self._cache is not None:
+                    _, mlen = self._cache.match(req.prompt,
+                                                acquire=False)
+                costs[id(req)] = min(int(req.prompt.size) - mlen,
+                                     self.prefill_window)
+            ranked = sorted(
+                self._waiting,
+                key=lambda r: r.sort_key()[:2] + (costs[id(r)],
+                                                  r.t_submit))
             for req in ranked:
                 if not free or len(admitted) >= self.prefill_lanes:
                     break
-                cost = int(req.prompt.size)
+                cost = costs[id(req)]
                 if admitted and budget - cost < 0:
-                    break               # budget spent; next iteration
+                    continue    # over budget; a cheaper waiter may fit
                 try:
                     req.slot = self.pool.claim()
                 except SlotsFullError:   # raced a test's direct claim
                     break
+                if self._cache is not None:
+                    # pin the matched prefix for this request's lifetime
+                    # (released at retire); eviction can never reclaim
+                    # the row while the copy/read is possible
+                    entry, mlen = self._cache.match(req.prompt)
+                    if entry is not None:
+                        req.entry = entry
+                        req.cached_len = mlen
+                        req.prefill_pos = mlen
                 free -= 1
                 budget -= cost
                 admitted.append(req)
@@ -1336,53 +1690,135 @@ class ContinuousEngine:
                 self._waiting = deque(r for r in self._waiting  # mxlint: disable=lock-shared-mutation -- _admit_locked runs with self._cv held by its only caller (_loop)
                                       if id(r) not in dropset)
         for req in admitted:
-            self._running[req.slot] = req  # mxlint: disable=lock-shared-mutation -- _admit_locked runs with self._cv held by its only caller (_loop)
+            self._prefilling[req.slot] = req  # mxlint: disable=lock-shared-mutation -- _admit_locked runs with self._cv held by its only caller (_loop)
         return admitted, expired
 
     def _run_prefill(self, admitted, jnp):
-        """One fixed-shape prefill wave for the just-admitted requests."""
+        """One prefill wave: slab-to-slab KV row copies for the admitted
+        prefix-cache hits, the fixed-shape windowed program for lanes
+        starting at page offset 0, then ONE chunk dispatch advancing
+        EVERY lane with pending suffix/chunk work (admitted hits and
+        long prompts mid-stream alike). A request emits its first token
+        the wave its prefill completes — `prefill_tokens` bills only
+        tokens a program actually processed (suffix-only on a hit)."""
         _fault.inject("serve.execute")
-        P = self.prefill_lanes
+        W = self.prefill_window
         g = self.pool.garbage_row
-        toks = _np.zeros((P, self.prefill_window), dtype=_np.int32)
-        lens = _np.ones((P,), dtype=_np.int32)
-        rows = _np.full((P,), g, dtype=_np.int32)
-        temps = _np.zeros((P,), dtype=_np.float32)
-        tks = _np.zeros((P,), dtype=_np.int32)
-        tps = _np.ones((P,), dtype=_np.float32)
-        keys = _np.zeros((P, 2), dtype=_np.uint32)
-        for i, req in enumerate(admitted):
-            toks[i, :req.prompt.size] = req.prompt
-            lens[i] = req.prompt.size
-            rows[i] = req.slot
-            temps[i] = req.temperature
-            tks[i] = req.top_k
-            tps[i] = req.top_p
-            keys[i] = req.key
         t0 = time.perf_counter()
-        kb, vb = self.pool.buffers()
-        jlens = jnp.asarray(lens)
-        k, v, logits = self._prefill_prog(
-            self.model.params, kb, vb,
-            jnp.asarray(toks), jlens, jnp.asarray(rows))
-        first = _sample_first(logits, jnp.asarray(temps),
-                              jnp.asarray(tks), jnp.asarray(tps),
-                              jnp.asarray(keys), jlens - 1)
-        self.pool.swap_buffers(k, v)
-        first_host = _np.asarray(first)
+        hits = [r for r in admitted if r.cached_len > 0]
+        cold = [r for r in admitted if r.cached_len == 0]
+        if hits:
+            # memory-bound copy replaces compute-bound prefill: the
+            # pinned cache rows land in the claimed slots before this
+            # wave's programs run (same thread, same device stream)
+            self._dispatch_copy([(r.entry.row, r.slot) for r in hits])
+            self._count("prefix_hits", len(hits))
+            self._count("prefix_cached_tokens",
+                        int(sum(r.cached_len for r in hits)))
+        if self._cache is not None and cold:
+            self._count("prefix_misses", len(cold))
+        n_tokens = 0
+        finished = []                        # (req, first token)
+        if cold:
+            P = self.prefill_lanes
+            toks = _np.zeros((P, W), dtype=_np.int32)
+            lens = _np.ones((P,), dtype=_np.int32)
+            rows = _np.full((P,), g, dtype=_np.int32)
+            temps = _np.zeros((P,), dtype=_np.float32)
+            tks = _np.zeros((P,), dtype=_np.int32)
+            tps = _np.ones((P,), dtype=_np.float32)
+            keys = _np.zeros((P, 2), dtype=_np.uint32)
+            for i, req in enumerate(cold):
+                head = min(int(req.prompt.size), W)
+                toks[i, :head] = req.prompt[:head]
+                lens[i] = head
+                rows[i] = req.slot
+                temps[i] = req.temperature
+                tks[i] = req.top_k
+                tps[i] = req.top_p
+                keys[i] = req.key
+            kb, vb = self.pool.buffers()
+            jlens = jnp.asarray(lens)
+            k, v, logits = self._prefill_prog(
+                self.model.params, kb, vb,
+                jnp.asarray(toks), jlens, jnp.asarray(rows))
+            first = _sample_first(logits, jnp.asarray(temps),
+                                  jnp.asarray(tks), jnp.asarray(tps),
+                                  jnp.asarray(keys), jlens - 1)
+            self.pool.swap_buffers(k, v)
+            first_host = _np.asarray(first)
+            for i, req in enumerate(cold):
+                head = min(int(req.prompt.size), W)
+                req.prefill_pos = head
+                n_tokens += head
+                if head == req.prompt.size:
+                    finished.append((req, int(first_host[i])))
+        # chunk wave: admitted hits prefill their suffix, long prompts
+        # mid-stream advance one window — ONE fixed-shape dispatch at
+        # pool width; lanes with no chunk work scatter into garbage
+        with self._cv:
+            pre = [self._prefilling[s] for s in sorted(self._prefilling)]
+        coldset = set(id(r) for r in cold)
+        chunkers = [r for r in pre
+                    if id(r) not in coldset
+                    and r.prefill_pos < int(r.prompt.size)]
+        if chunkers:
+            S = self.pool.max_slots
+            ctoks = _np.zeros((S, W), dtype=_np.int32)
+            offs = _np.zeros((S,), dtype=_np.int32)
+            nval = _np.zeros((S,), dtype=_np.int32)
+            temps = _np.zeros((S,), dtype=_np.float32)
+            tks = _np.zeros((S,), dtype=_np.int32)
+            tps = _np.ones((S,), dtype=_np.float32)
+            keys = _np.zeros((S, 2), dtype=_np.uint32)
+            fold = _np.zeros((S,), dtype=_np.int32)
+            for req in chunkers:
+                s = req.slot
+                n = min(W, int(req.prompt.size) - req.prefill_pos)
+                ctoks[s, :n] = req.prompt[req.prefill_pos:
+                                          req.prefill_pos + n]
+                offs[s] = req.prefill_pos
+                nval[s] = n
+                temps[s] = req.temperature
+                tks[s] = req.top_k
+                tps[s] = req.top_p
+                keys[s] = req.key
+                fold[s] = int(req.prompt.size) - 1
+            # smallest warmed extent covering the furthest lane: the
+            # wave's attention read scales with streamed progress
+            need = max(int(offs[r.slot]) + int(nval[r.slot])
+                       for r in chunkers)
+            ext = next(x for x in self._chunk_extents if x >= need)
+            kb, vb = self.pool.buffers()
+            k, v, logits = self._chunk_progs[ext](
+                self.model.params, kb, vb, jnp.asarray(ctoks),
+                jnp.asarray(offs), jnp.asarray(nval))
+            first = _sample_first(logits, jnp.asarray(temps),
+                                  jnp.asarray(tks), jnp.asarray(tps),
+                                  jnp.asarray(keys), jnp.asarray(fold))
+            self.pool.swap_buffers(k, v)
+            first_host = _np.asarray(first)
+            for req in chunkers:
+                n = int(nval[req.slot])
+                req.prefill_pos += n
+                n_tokens += n
+                if req.prefill_pos == int(req.prompt.size):
+                    finished.append((req, int(first_host[req.slot])))
         now = time.perf_counter()
-        n_tokens = int(sum(r.prompt.size for r in admitted))
-        self._count("admitted", len(admitted))
-        self._count("prefill_batches")
-        self._count("prefill_tokens", n_tokens)
-        n_sampled = sum(1 for r in admitted if r.temperature > 0)
+        if admitted:
+            self._count("admitted", len(admitted))
+        if cold or chunkers:
+            self._count("prefill_batches")
+        if n_tokens:
+            self._count("prefill_tokens", n_tokens)
+        n_sampled = sum(1 for r, _ in finished if r.temperature > 0)
         if n_sampled:
             self._count("sampled_tokens", n_sampled)
         prof = _profiler_on()
         done = []
-        for i, req in enumerate(admitted):
+        for req, tok in finished:
             req.cache_len = int(req.prompt.size)
-            req.generated.append(int(first_host[i]))
+            req.generated.append(tok)
             req.t_first = req.t_last = now
             with self._mlock:
                 self._ttft_ms.append((now - req.t_submit) * 1e3)
@@ -1394,20 +1830,45 @@ class ContinuousEngine:
                             ctx=_trace.child_context(req.ctx,
                                                      "serve.prefill"),
                             prompt_tokens=req.prompt.size,
+                            cached_tokens=req.cached_len,
                             slot=req.slot)
             if self._finished(req):
                 done.append(req)
+        with self._cv:
+            for req, _ in finished:
+                self._prefilling.pop(req.slot, None)
+                self._running[req.slot] = req
         if _trace.enabled() and _trace.collector_active():
             record_span("serve.prefill_batch", (now - t0) * 1e6,
                         ts_us=t0 * 1e6, cat="serve",
                         requests=len(admitted), tokens=n_tokens)
         self._retire(done)
 
+    def _dispatch_copy(self, pairs):
+        """ONE fixed-shape donated gather program copies whole KV slot
+        rows slab-to-slab: cache row -> claimed slot at admission,
+        retiring slot -> cache row at publish. Idle lanes copy the
+        garbage row onto itself."""
+        import jax.numpy as jnp
+        g = self.pool.garbage_row
+        src = _np.full((self.prefill_lanes,), g, dtype=_np.int32)
+        dst = _np.full((self.prefill_lanes,), g, dtype=_np.int32)
+        for i, (s, d) in enumerate(pairs):
+            src[i] = s
+            dst[i] = d
+        kb, vb = self.pool.buffers()
+        k, v = self._copy_prog(kb, vb, jnp.asarray(src),
+                               jnp.asarray(dst))
+        self.pool.swap_buffers(k, v)
+
     def _run_decode(self, jnp):
         """ONE decode wave: every active slot advances up to
         `decode_steps` tokens (times up to `draft_tokens + 1` when
-        speculating) through the compiled multi-step program."""
-        S = self.max_slots
+        speculating) through the compiled multi-step program. Lanes are
+        ALL pool rows (request slots, mid-prefill slots, and prefix-cache
+        rows alike) so lane index == slab row; non-decoding lanes are
+        inactive and scatter into the garbage row."""
+        S = self.pool.max_slots
         draft = self.draft_tokens
         toks = _np.zeros((S,), dtype=_np.int32)
         lens = _np.zeros((S,), dtype=_np.int32)
@@ -1512,6 +1973,21 @@ class ContinuousEngine:
         for req in done:
             with self._cv:
                 self._running.pop(req.slot, None)
+            if self._cache is not None:
+                if req.entry is not None:
+                    # the hit path never publishes: its suffix KV came
+                    # from the chunk program, and the cache must stay
+                    # canonical-provenance (windowed head + chunks) so
+                    # every later hit is bit-identical to a cold build
+                    self._cache.release(req.entry)  # mxlint: disable=lock-shared-mutation -- PrefixCache serializes internally (leaf lock)
+                    req.entry = None
+                elif self.prefix_cache_insert:
+                    row = self._cache.insert(req.prompt)  # mxlint: disable=lock-shared-mutation -- PrefixCache serializes internally (leaf lock)
+                    if row is not None:
+                        # publish BEFORE free: the copy is dispatched on
+                        # this thread ahead of any wave that could
+                        # rewrite the retiring slot's row
+                        self._dispatch_copy([(req.slot, row)])
             self.pool.free(req.slot)
             out = _np.asarray(req.generated, dtype=_np.int32)
             if self.eos_id is not None:

@@ -20,6 +20,16 @@ made MXNet multi-process) rebuilt as a serving fleet:
     budget is recomputed at every (re-)dispatch, and failover re-enqueues
     earliest-deadline-first — the EDF admission inside each engine then
     orders the merged queue.
+  * **Prefix affinity**: the router remembers which replica last served
+    each block-quantized prompt-prefix hash (`serve.prefix_cache`'s
+    `rolling_hash`, block = MXNET_SERVE_PREFIX_BLOCK) and prefers that
+    replica while it is healthy and non-draining — repeated prefixes
+    land where their KV is already cached, turning cross-replica cache
+    misses into `prefix.hits`. Pure preference, never a constraint:
+    a dead/draining/excluded affinity target falls back to the normal
+    EDF + least-loaded pick, and the map is a bounded LRU (stale
+    entries age out, respawned replicas just re-learn). Counted in
+    `fleet.affinity_hits`.
   * **Health** (`fleet.heartbeat`): pings every MXNET_FLEET_HEARTBEAT_MS;
     a replica missing `heartbeat_misses` consecutive beats is declared
     hung and SIGKILLed into the death path. Process exit is ALSO polled,
@@ -54,6 +64,7 @@ import json
 import logging
 import os
 import socket
+from collections import OrderedDict
 import subprocess
 import sys
 import tempfile
@@ -70,6 +81,7 @@ from ..telemetry import record_span, trace as _trace
 from ..telemetry.registry import gauge, stats_group
 from .batcher import (QueueFullError, RequestTimeout, ServeError,
                       ServerClosed, _profiler_on)
+from .prefix_cache import rolling_hash as _rolling_hash
 
 logger = logging.getLogger("mx.serve.fleet")
 
@@ -86,6 +98,8 @@ FLEET_STATS = stats_group("fleet", {
     "drain_ms": 0.0,          # cumulative replica drain time
     "profile_divergence": 0,  # hellos that revealed replicas serving the
                               # same fleet under DIFFERENT tune profiles
+    "affinity_hits": 0,       # dispatches routed by prefix affinity (the
+                              # remembered replica was healthy and chosen)
 }, lock=_STATS_LOCK, help="serving-fleet supervisor/router counters")
 
 
@@ -134,10 +148,10 @@ _WIRE_ERRORS = {
 class _FleetRequest:
     __slots__ = ("rid", "prompt", "max_new", "deadline_at", "future",
                  "ctx", "attempts", "reroutes", "t_submit", "replica",
-                 "first_error", "sampling")
+                 "first_error", "sampling", "prefix_hash")
 
     def __init__(self, rid, prompt, max_new, deadline_at, ctx,
-                 sampling=None):
+                 sampling=None, prefix_hash=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new = max_new
@@ -153,6 +167,7 @@ class _FleetRequest:
         # re-dispatch — including the router-assigned seed, so a retried
         # sampled request draws the SAME tokens on the new replica)
         self.sampling = sampling or {}
+        self.prefix_hash = prefix_hash    # affinity key (None = no prefix)
 
     def sort_key(self):
         """EDF for failover re-dispatch: earliest deadline first,
@@ -294,6 +309,14 @@ class Fleet:
         # (covers the respawn window when every replica died at once)
         self._dispatch_wait_s = max(30.0, self.drain_timeout_s)
         self._edf = _EDFGate()
+        # prefix-affinity routing: block-quantized prefix hash -> replica
+        # index that last served it. Bounded LRU under self._lock; the
+        # block width mirrors the engines' prefix-cache granularity so
+        # the router's key equals what a replica's cache could hit on.
+        self._prefix_block = max(1, get_env("MXNET_SERVE_PREFIX_BLOCK",
+                                            16, typ=int))
+        self._affinity = OrderedDict()
+        self._affinity_cap = 1024
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -604,8 +627,15 @@ class Fleet:
             rid = self._rid[0]
         deadline_at = (time.perf_counter() + deadline_ms / 1e3
                        if deadline_ms is not None else None)
+        # affinity key: hash of the longest block-quantized prefix a
+        # replica's cache could actually hit on ((plen-1)//block blocks —
+        # one suffix token must remain to prefill). None = too short.
+        nblocks = (int(prompt.size) - 1) // self._prefix_block
+        phash = (_rolling_hash(prompt[:nblocks * self._prefix_block])
+                 if nblocks >= 1 else None)
         freq = _FleetRequest(rid, prompt, int(max_new_tokens),
-                             deadline_at, ctx, sampling=sampling)
+                             deadline_at, ctx, sampling=sampling,
+                             prefix_hash=phash)
         self._dispatch(freq)
         return freq.future
 
@@ -618,15 +648,22 @@ class Fleet:
                            temperature=temperature, top_k=top_k,
                            top_p=top_p, seed=seed).result(timeout=timeout)
 
-    def _pick(self, exclude=()):
+    def _pick(self, exclude=(), prefer=None):
         """Least-loaded SERVING replica: router-side in-flight count,
-        then replica-reported queue depth, then lowest index."""
+        then replica-reported queue depth, then lowest index. `prefer`
+        (the prefix-affinity target) wins outright when it is among the
+        healthy candidates — affinity beats load balance because a row
+        copy from a warm prefix cache is cheaper than any prefill."""
         with self._lock:
             cands = [h for h in self._replicas
                      if h.state == "serving" and h.sock is not None
                      and h.index not in exclude]
             if not cands:
                 return None
+            if prefer is not None:
+                for h in cands:
+                    if h.index == prefer:
+                        return h
             return min(cands, key=lambda h: (
                 len(h.inflight),
                 h.pong.get("waiting", 0) + h.pong.get("running", 0),
@@ -661,7 +698,11 @@ class Fleet:
                 remaining_ms = max(1.0, left * 1e3)
             if not self._edf.wait_turn(freq):
                 continue            # not the tightest deadline waiting
-            h = self._pick(exclude)
+            prefer = None
+            if freq.prefix_hash is not None:
+                with self._lock:
+                    prefer = self._affinity.get(freq.prefix_hash)
+            h = self._pick(exclude, prefer=prefer)
             if h is None:
                 if exclude:
                     exclude = set()     # wrap around before giving up
@@ -692,6 +733,15 @@ class Fleet:
                     h.inflight[freq.rid] = freq
                     freq.replica = h.index
                 self._send(h, msg)
+                if freq.prefix_hash is not None:
+                    with self._lock:
+                        self._affinity[freq.prefix_hash] = h.index
+                        self._affinity.move_to_end(freq.prefix_hash)
+                        while len(self._affinity) > self._affinity_cap:
+                            self._affinity.popitem(last=False)
+                    if prefer == h.index:
+                        with _STATS_LOCK:
+                            FLEET_STATS["affinity_hits"] += 1
                 return
             except (OSError, MXNetError, TimeoutError) as e:
                 with self._lock:
@@ -997,6 +1047,7 @@ class Fleet:
                     "compile_cache_size"),
                 "retraces": h.pong.get("retraces"),
                 "profile_hash": h.hello.get("profile_hash"),
+                "prefix_hits": h.pong.get("prefix_hits"),
             } for h in self._replicas]
         out = {"version": self.version, "replicas": reps}
         out.update(FLEET_STATS.snapshot())

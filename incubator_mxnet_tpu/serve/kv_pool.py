@@ -262,6 +262,26 @@ class KVCachePool:
             self.v = jnp.full(self.shape, value, dtype=self.dtype)
         _note_slab(self)
 
+    def poison_slot(self, slot, value=1e9):
+        """`poison()` at slot granularity: overwrite ONE row (both slabs,
+        plus its scale rows on a quantized pool) with the sentinel,
+        leaving every other slot's live KV intact. Test hook for the
+        shared-prefix isolation contract: poison a FREED prefix-cache
+        row, keep serving, and any tenant that could still read it shows
+        the sentinel. Never called on the serving path."""
+        import jax.numpy as jnp
+        slot = int(slot)
+        if not 0 <= slot <= self.max_slots:
+            raise ServeError(
+                f"slot {slot} outside [0, {self.max_slots}]")
+        code = 1 if self.quantized else value
+        self.k = self.k.at[slot].set(jnp.asarray(code, dtype=self.dtype))
+        self.v = self.v.at[slot].set(jnp.asarray(code, dtype=self.dtype))
+        if self.quantized:
+            self.k_scale = self.k_scale.at[slot].set(value)
+            self.v_scale = self.v_scale.at[slot].set(value)
+        _note_slab(self)
+
     # -- slot bookkeeping --------------------------------------------------
     def claim(self):
         """Take a free slot (int in [0, max_slots)); raises SlotsFullError

@@ -103,7 +103,8 @@ class _StubEngine:
     delay with a deterministic token pattern derived from (prompt,
     version). Mirrors the exact ContinuousEngine surface the replica loop
     touches — submit / queue_depth / begin_drain / draining / close /
-    warmup_s / compile_cache_size / retraces_after_warmup."""
+    warmup_s / compile_cache_size / retraces_after_warmup /
+    prefix_hit_count."""
 
     def __init__(self, spec):
         self.version = str(spec.get("version", "v0"))
@@ -122,6 +123,9 @@ class _StubEngine:
         return 0
 
     def retraces_after_warmup(self):
+        return 0
+
+    def prefix_hit_count(self):
         return 0
 
     @property
@@ -346,7 +350,10 @@ def main(argv=None):
                   "waiting": waiting, "running": running,
                   "draining": bool(getattr(eng, "draining", False)),
                   "retraces": eng.retraces_after_warmup(),
-                  "compile_cache_size": eng.compile_cache_size()})
+                  "compile_cache_size": eng.compile_cache_size(),
+                  # prefix-cache effectiveness, surfaced so the router's
+                  # affinity decisions are observable fleet-wide
+                  "prefix_hits": eng.prefix_hit_count()})
         elif t == "drain":
             if not drain_started.is_set():
                 drain_started.set()

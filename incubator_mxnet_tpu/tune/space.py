@@ -75,6 +75,27 @@ KNOBS = {
         "wire": "serve/continuous.py",
         "help": "KV pool storage dtype (None = model dtype; int8 = "
                 "quantized codes + scales, 3.76x slots/GB)"},
+    "serve.prefix_block": {
+        "kind": "pow2", "default": 16, "choices": [4, 8, 16, 32],
+        "env": "MXNET_SERVE_PREFIX_BLOCK", "phase": "serve_prefill",
+        "wire": "serve/continuous.py",
+        "help": "shared-prefix cache granularity in tokens (prefixes "
+                "cache and match on whole blocks; smaller = finer reuse "
+                "but more hash/verify work per lookup)"},
+    "serve.prefix_cache_slots": {
+        "kind": "int", "default": 0, "choices": [0, 2, 4, 8],
+        "env": "MXNET_SERVE_PREFIX_CACHE_SLOTS", "phase": "serve_prefill",
+        "wire": "serve/continuous.py",
+        "help": "dedicated KV-pool rows holding shared-prefix KV (0 = "
+                "off); each costs one slot page of HBM and turns a "
+                "repeated prefix's prefill into a row copy"},
+    "serve.prefix_cache_insert": {
+        "kind": "bool", "default": True, "choices": [True, False],
+        "env": "MXNET_SERVE_PREFIX_CACHE_INSERT", "phase": "serve_prefill",
+        "wire": "serve/continuous.py",
+        "help": "publish retiring prompts' prefixes back into the cache "
+                "(False = read-only cache, for pinned system prompts "
+                "warmed once)"},
     "serve.batch_buckets": {
         "kind": "categorical", "default": [1, 2, 4, 8, 16, 32],
         "choices": [[1, 2, 4, 8, 16, 32], [8, 16, 32], [1, 4, 16, 64],

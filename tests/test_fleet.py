@@ -143,6 +143,25 @@ def test_stub_fleet_serves_and_reports_live_replicas(stub_fleet):
     assert st["version"] == "v1"
 
 
+def test_prefix_affinity_pins_shared_prefix_to_one_replica(stub_fleet):
+    """Requests sharing a block-quantized prefix route to the replica
+    that served the prefix first (its prefix cache is warm there):
+    after the first dispatch records the mapping, every follow-up
+    counts `fleet.affinity_hits`. Sub-block prompts carry no affinity
+    key and never touch the counter."""
+    fleet, _ = stub_fleet
+    prompt = list(range(1, 21))               # 20 tokens = 1 block of 16
+    before = serve.fleet_stats()["affinity_hits"]
+    for _ in range(4):                        # sequential: no load races
+        fleet.submit(prompt, max_new_tokens=2).result(timeout=30)
+    assert serve.fleet_stats()["affinity_hits"] - before == 3
+    # shorter than one block (19//16 == 1 needs 17+ tokens): no key
+    before = serve.fleet_stats()["affinity_hits"]
+    fleet.submit([1, 2, 3], max_new_tokens=2).result(timeout=30)
+    fleet.submit([1, 2, 3], max_new_tokens=2).result(timeout=30)
+    assert serve.fleet_stats()["affinity_hits"] == before
+
+
 # ---------------------------------------------------------------------------
 # fault points: fleet.dispatch / fleet.heartbeat (fleet.respawn and
 # fleet.swap below; the respawn-exhaustion test runs LAST — it
@@ -337,10 +356,11 @@ def test_real_fleet_zero_retraces_fleet_wide(real_fleet):
 def test_fleet_stats_group_and_replica_state_gauge(real_fleet):
     assert set(serve.FLEET_STATS) == {
         "replicas_live", "failovers", "retries", "respawns", "swaps",
-        "drain_ms", "profile_divergence"}
+        "drain_ms", "profile_divergence", "affinity_hits"}
     snap = telemetry.REGISTRY.snapshot()
     for key in ("fleet.replicas_live", "fleet.failovers", "fleet.retries",
-                "fleet.respawns", "fleet.swaps", "fleet.drain_ms"):
+                "fleet.respawns", "fleet.swaps", "fleet.drain_ms",
+                "fleet.affinity_hits"):
         assert key in snap, key
     # serve.replica_state is a labeled gauge: one series per replica,
     # level 2 == serving

@@ -114,6 +114,13 @@ TREND_KEYS = {
     # quantized-cache capacity win — must not shrink
     "serve_decode_tokens_per_sec_spec": "higher",
     "kv_slots_per_gb": "higher",
+    # prefill phase (PR 19, serve.prefix_cache): the cached-token share
+    # of the shared-prefix workload must not shrink (the cache going
+    # quietly dead would read as "no hits", not a crash), and the
+    # short-request TTFT p99 under long-prompt interference must not
+    # grow — the chunked-prefill isolation guarantee
+    "prefill_cached_token_share": "higher",
+    "serve_ttft_p99_ms_interference": "lower",
     # tune phase (PR 18, mx.tune): the swept profile's worst per-phase
     # score over the hand-tuned committed baseline — a FLOOR metric with
     # 1.0 as its structural floor (trial 0 measures the hand assignment
@@ -511,6 +518,24 @@ def self_test():
                   dict(tune_base, tune_profile_vs_hand_speedup=1.5))
     check("improving tune keys pass with improvements reported",
           rep["status"] == "ok" and len(rep["improvements"]) == 1)
+    # prefill keys (PR 19, serve.prefix_cache): a shrinking cached-token
+    # share or a fatter interference TTFT p99 gates the trend
+    pref_base = {"backend_ok": True,
+                 "prefill_cached_token_share": 0.85,
+                 "serve_ttft_p99_ms_interference": 12.0}
+    rep = compare(pref_base,
+                  dict(pref_base, prefill_cached_token_share=0.4,
+                       serve_ttft_p99_ms_interference=30.0))
+    check("cached share shrink / interference p99 rise is a regression",
+          rep["status"] == "regression"
+          and {r["key"] for r in rep["regressions"]}
+          == {"prefill_cached_token_share",
+              "serve_ttft_p99_ms_interference"})
+    rep = compare(pref_base,
+                  dict(pref_base, prefill_cached_token_share=0.95,
+                       serve_ttft_p99_ms_interference=8.0))
+    check("improving prefill keys pass with improvements reported",
+          rep["status"] == "ok" and len(rep["improvements"]) == 2)
     missing_only_new = {"backend_ok": True,
                         "io_pipeline_images_per_sec": 700.0}
     check("keys missing from one side are skipped, not regressions",
