@@ -744,6 +744,45 @@ def bench_autoreg_continuous(model, workload, concurrency, duration_s,
     return out
 
 
+def bench_sanitize_ab(quick, concurrency, duration_s, max_slots=None):
+    """Runtime-sanitizer overhead A/B (ISSUE 20): the SAME quick
+    autoregressive continuous workload run with `mx.sanitize` off, then
+    with all three modes armed (donation poison-and-trap, retrace
+    sentinel polled every wave, slot canary row). Each arm builds its
+    own model so the sanitized arm's programs are actually wrapped at
+    build time — exactly how `MXNET_SANITIZE` deploys. Emits
+    `sanitize_overhead_pct` (benchdiff trend key, gated absolutely) and
+    asserts the sanitized arm stayed silent: zero retraces, zero canary
+    trips, zero donation violations on the clean loop."""
+    from incubator_mxnet_tpu import sanitize, serve
+
+    def one_arm(label):
+        model, workload, max_prompt, _ = _build_autoreg(quick)
+        slots = max_slots or min(32, concurrency)
+        row = bench_autoreg_continuous(model, workload, concurrency,
+                                       duration_s, max_slots=slots,
+                                       max_prompt=max_prompt)
+        row["arm"] = label
+        return row
+
+    off = one_arm("sanitize_off")
+    with sanitize.scope("all"):
+        on = one_arm("sanitize_all")
+    sanitize.clear()
+    tps_off = off["decode_tokens_per_sec"]
+    tps_on = on["decode_tokens_per_sec"]
+    overhead = (100.0 * (tps_off - tps_on) / tps_off if tps_off > 0
+                else 0.0)
+    errs = on["errors"]
+    n_errs = (sum(errs.values()) if isinstance(errs, dict)
+              else int(errs or 0))
+    return {"sanitize_off": off, "sanitize_on": on,
+            "sanitize_modes": "donation,retrace,slot",
+            "sanitize_overhead_pct": round(overhead, 2),
+            "sanitize_retraces": on["retraces_after_warmup"],
+            "sanitize_errors": n_errs}
+
+
 def bench_decode_ab(model, workload, concurrency, duration_s,
                     max_slots=None, max_prompt=None, draft=4):
     """Speculative-decoding A/B (ISSUE 17): the SAME engine/workload run
@@ -1297,6 +1336,10 @@ def main():
                          "'auto' (closed-loop calibration x 0.3..2.6)")
     ap.add_argument("--seed", type=int, default=11,
                     help="open-loop arrival-process seed")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="runtime-sanitizer overhead A/B: the quick "
+                         "continuous workload with MXNET_SANITIZE off "
+                         "vs all modes armed (ISSUE 20)")
     ap.add_argument("--trace-ab", action="store_true",
                     help="paired traced-vs-untraced A/B (interleaved "
                          "MXNET_TELEMETRY windows on one server) instead "
@@ -1425,6 +1468,36 @@ def main():
             out["telemetry"] = telemetry.scalar_snapshot()
         except Exception:
             pass
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {args.out}")
+        return 0
+
+    if args.sanitize:
+        out = {"meta": {"bench": "serve_bench", "mode": "sanitize",
+                        "quick": bool(args.quick),
+                        "concurrency": args.concurrency,
+                        "duration_s": duration,
+                        "host_cores": os.cpu_count(),
+                        "platform": "cpu"}}
+        out.update(bench_sanitize_ab(args.quick, args.concurrency,
+                                     duration, max_slots=args.max_slots))
+        print(f"sanitizer overhead (all modes vs off): "
+              f"{out['sanitize_overhead_pct']}% decode tokens/s, "
+              f"{out['sanitize_retraces']} retraces, "
+              f"{out['sanitize_errors']} errors")
+        out["note"] = (
+            "serve_bench --sanitize: the continuous engine's quick "
+            "autoregressive workload with MXNET_SANITIZE off vs all "
+            "three modes armed (donation poison-and-trap + per-wave "
+            "retrace poll + slot canary row), same workload and host. "
+            "sanitize_overhead_pct is the decode-tokens/s cost of "
+            "arming everything; the ISSUE-20 budget is <= 5% and the "
+            "sanitized arm must stay silent (zero retraces, zero "
+            "errors) on the clean loop.")
+        out["backend_ok"] = True
         os.makedirs(os.path.dirname(os.path.abspath(args.out)),
                     exist_ok=True)
         with open(args.out, "w") as f:

@@ -41,7 +41,7 @@ _LAZY = ("gluon", "optimizer", "kvstore", "parallel", "amp", "profiler",
          "runtime", "io", "image", "engine", "context", "recordio",
          "checkpoint", "visualization", "models", "native", "deploy",
          "symbol", "onnx", "contrib", "operator", "library", "name",
-         "attribute")
+         "attribute", "sanitize", "analysis")
 
 
 def __getattr__(name):

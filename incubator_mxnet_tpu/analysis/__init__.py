@@ -1,13 +1,20 @@
 """mx.analysis — the mxlint static-analysis suite.
 
-Three AST pass families over the package's own source (stdlib `ast` only):
+Five AST pass families over the package's own source (stdlib `ast` only):
 
   trace_safety          host-Python hazards in jit-reachable functions
   lock_discipline       shared-state mutation outside the owning lock,
                         lock-acquisition-order cycles
   registry_consistency  MXNET_* knobs vs docs/ENV_VARS.md, fault.POINTS
-                        vs inject sites vs docs/RESILIENCE.md, stats keys
-                        vs test coverage
+                        vs inject sites vs docs/RESILIENCE.md AND vs test
+                        drills (fault coverage, both directions), stats
+                        keys/families vs test coverage
+  donation_safety       use-after-donate dataflow over donated jitted
+                        programs; except handlers that swallow errors
+                        around donated calls without restoring buffers
+  retrace_hazard        run-varying Python values (len()/.shape, static
+                        args, set-ordered pytrees) reaching trace-time
+                        positions inside steady-state loops
 
 CLI: `python -m tools.mxlint [--changed] [--json]` (tier-1 gate:
 `tests/test_lint.py`). Rule catalog and workflow: docs/LINT.md.
@@ -16,7 +23,8 @@ from __future__ import annotations
 
 import os
 
-from . import lock_discipline, registry_consistency, trace_safety
+from . import (donation_safety, lock_discipline, registry_consistency,
+               retrace_hazard, trace_safety)
 from .core import Baseline, Finding, Module, load_modules, repo_root
 
 __all__ = ["run_all", "PASS_FAMILIES", "ALL_RULES", "Baseline", "Finding",
@@ -26,6 +34,8 @@ PASS_FAMILIES = {
     "trace-safety": trace_safety,
     "lock-discipline": lock_discipline,
     "registry-consistency": registry_consistency,
+    "donation-safety": donation_safety,
+    "retrace-hazard": retrace_hazard,
 }
 
 ALL_RULES = tuple(r for m in PASS_FAMILIES.values() for r in m.RULES)

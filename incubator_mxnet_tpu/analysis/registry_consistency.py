@@ -22,6 +22,22 @@ Three symbol families, six rules:
     fault-point-undocumented  registered but missing from RESILIENCE.md
     fault-doc-stale           a RESILIENCE.md table point not in POINTS
 
+  fault COVERAGE (ISSUE 20) — registration and wiring are necessary but
+  not sufficient: a point nobody drills is a fire alarm nobody has ever
+  pressed. Both directions against the tests/ tree:
+
+    fault-point-untested      a POINTS entry never named by any test
+                              literal (a quoted `"pt"` / `"pt:hit:kind"`
+                              MXNET_FAULT_SPEC string or inject call) —
+                              the drill is dead
+    fault-test-unknown-point  an MXNET_FAULT_SPEC-shaped literal in a
+                              test (`"name.sub:N:kind"`) naming a point
+                              POINTS doesn't register — the spec is
+                              silently inert, the test drills nothing.
+                              Bare `inject("x")` literals in tests are
+                              NOT checked: tests legitimately register
+                              ad-hoc demo points at runtime
+
   profiler stats keys — module-level dict literals named `*_STATS`
   (DISPATCH_STATS / SERVE_STATS / FEED_STATS / KV_STATS), whether assigned
   bare or wrapped in a `stats_group("family", {...})` adoption call, are
@@ -29,6 +45,13 @@ Three symbol families, six rules:
 
     stats-key-untested  a stats key never appears in any tests/*.py —
                         nothing would notice the counter going dead
+
+    stats-family-untested  a `stats_group("family", ...)` adoption whose
+                           FAMILY name never appears (quoted, or as a
+                           quoted `family.` dotted prefix) in any test —
+                           per-key coverage can pass while the group's
+                           telemetry surface (snapshot()/prometheus
+                           export under `family.*`) goes dark unnoticed
 
   memory census owners (mx.inspect.memory) — owner strings are the
   attribution surface a live-buffer census groups by, and like stats
@@ -94,7 +117,9 @@ __all__ = ["run"]
 
 RULES = ("env-undocumented", "env-doc-stale", "fault-point-unwired",
          "fault-point-unregistered", "fault-point-undocumented",
-         "fault-doc-stale", "stats-key-untested",
+         "fault-doc-stale", "fault-point-untested",
+         "fault-test-unknown-point", "stats-key-untested",
+         "stats-family-untested",
          "telemetry-metric-undocumented", "telemetry-doc-stale",
          "telemetry-metric-untested",
          "mem-owner-undocumented", "mem-owner-doc-stale",
@@ -422,18 +447,38 @@ def _wired_env_reads(modules, wires):
     return out
 
 
-def _tests_text(tests_dir):
-    chunks = []
+def _tests_files(tests_dir, root):
+    """[(root-relative path, source)] for every tests/*.py (fixtures
+    excluded — they are parsed specimens, not coverage)."""
+    out = []
     if os.path.isdir(tests_dir):
         for dirpath, dirnames, filenames in os.walk(tests_dir):
             dirnames[:] = [d for d in dirnames
                            if d not in ("__pycache__", "lint_fixtures")]
-            for fn in filenames:
+            for fn in sorted(filenames):
                 if fn.endswith(".py"):
-                    with open(os.path.join(dirpath, fn),
-                              encoding="utf-8") as f:
-                        chunks.append(f.read())
-    return "\n".join(chunks)
+                    p = os.path.join(dirpath, fn)
+                    with open(p, encoding="utf-8") as f:
+                        out.append((os.path.relpath(p, root), f.read()))
+    return out
+
+
+def _tests_text(tests_dir, root=None):
+    return "\n".join(t for _, t in
+                     _tests_files(tests_dir, root or tests_dir))
+
+
+# an MXNET_FAULT_SPEC-shaped literal: `"name.sub:HITS:kind` — the quote
+# anchors it to string literals, the :digits: tail to real specs
+_SPEC_LIT_RE = re.compile(
+    r"""["']([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+):\d+:[a-z]""")
+
+
+def _quoted_in(name, text):
+    """`name` appears in `text` at the start of a string literal —
+    matching both exact quoting and `"name:1:error"` spec forms /
+    `"name.key"` dotted forms."""
+    return f'"{name}' in text or f"'{name}" in text
 
 
 def run(modules, root,
@@ -494,8 +539,35 @@ def run(modules, root,
                 f"{resilience_doc} documents injection point `{pt}` "
                 f"which is not in POINTS", scope="doc", symbol=pt))
 
+    # ---- fault coverage: POINTS <-> test literals, both directions -----
+    test_files = _tests_files(tests_path, root)
+    tests_text = "\n".join(t for _, t in test_files)
+    if tests_text and points:
+        for pt, line in sorted(points.items()):
+            if _quoted_in(pt, tests_text):
+                continue
+            findings.append(Finding(
+                "fault-point-untested", points_path or "", line,
+                f"fault point `{pt}` is never named by any test literal "
+                f"(no MXNET_FAULT_SPEC spec or quoted point in tests/) — "
+                f"the drill has never been run; add an injection test",
+                scope="POINTS", symbol=pt))
+        seen_unknown = set()
+        for relpath, text in test_files:
+            for i, ln in enumerate(text.splitlines(), 1):
+                for m in _SPEC_LIT_RE.finditer(ln):
+                    pt = m.group(1)
+                    if pt in points or (relpath, pt) in seen_unknown:
+                        continue
+                    seen_unknown.add((relpath, pt))
+                    findings.append(Finding(
+                        "fault-test-unknown-point", relpath, i,
+                        f"test fault spec names point `{pt}` which is "
+                        f"not registered in POINTS — the spec is "
+                        f"silently inert and the test drills nothing",
+                        scope="tests", symbol=pt))
+
     # ---- stats keys ----------------------------------------------------
-    tests_text = _tests_text(tests_path)
     stats = _stats_dicts(modules)
     if tests_text:
         for dname, keys, relpath, dline, _family in stats:
@@ -507,6 +579,23 @@ def run(modules, root,
                     f"stats key `{dname}[{key!r}]` never appears in any "
                     f"test — nothing notices if the counter goes dead",
                     scope=dname, symbol=key))
+        seen_fams = set()
+        for dname, keys, relpath, dline, family in stats:
+            if not family or family in seen_fams:
+                continue
+            seen_fams.add(family)
+            # a family is covered only by its DOTTED telemetry names
+            # ("family.key"): a bare substring like "prefix_hit_rate"
+            # exercises a different surface, not the stats group export
+            if _quoted_in(family + ".", tests_text):
+                continue
+            findings.append(Finding(
+                "stats-family-untested", relpath, dline,
+                f"stats group family `{family}` ({dname}) never appears "
+                f"as a quoted literal in any test — its telemetry "
+                f"surface (`{family}.*` in snapshot()/prometheus "
+                f"export) can go dark unnoticed",
+                scope=dname, symbol=family))
 
     # ---- telemetry metric names ---------------------------------------
     # registered surface: stats_group families ({family}.{key}) + literal

@@ -251,6 +251,7 @@ class ElasticTrainer:
             else get_env("MXNET_ELASTIC_COLLECTIVE_RETRIES", 2, typ=int))
         self._probe_fn = probe_fn
         self._grad_fns = {}
+        self._sanitize_armed = False
         self._pending_gather = False
         self._step_idx = 0
         self._overlap_hits = 0
@@ -476,8 +477,19 @@ class ElasticTrainer:
 
     def step(self, batch):
         """One full elastic step; returns the (host) mean loss."""
+        from .. import sanitize as _sanitize
         loss, gshards = self.forward_backward(batch)
         self.apply(gshards)
+        if _sanitize.enabled("retrace"):
+            # the first step compiles the grad + sharded-update programs;
+            # from the second on, any growth is a retrace-hazard breach.
+            # A shrunk() trainer is a NEW instance, so it re-arms over its
+            # own fresh programs after its own first step.
+            if not self._sanitize_armed:
+                _sanitize.arm()
+                self._sanitize_armed = True
+            else:
+                _sanitize.poll(where="elastic.step")
         return loss
 
     # ------------------------------------------------------------------

@@ -138,7 +138,10 @@ class FusedInferStep:
                                                length=n_steps)
             return logits_all[-1], x_final
 
-        return jax.jit(step, donate_argnums=(1,))
+        from ... import sanitize as _sanitize
+        return _sanitize.maybe_wrap_donated(
+            jax.jit(step, donate_argnums=(1,)), (1,),
+            "fused.chain_step")
 
     def lowered(self, x=None):
         """The chained-inference program lowered for inspection
@@ -415,8 +418,11 @@ class FusedTrainStep:
         # donate only the trainable weight + optimizer-state buffers; frozen
         # params keep their buffers live across calls. donate=False is the
         # other arm of the bench policy sweep (docs/PERF.md "Kernel tier").
-        return jax.jit(step,
-                       donate_argnums=(0, 1) if self._donate else ())
+        from ... import sanitize as _sanitize
+        donate = (0, 1) if self._donate else ()
+        return _sanitize.maybe_wrap_donated(
+            jax.jit(step, donate_argnums=donate), donate,
+            "fused.train_step")
 
     # ------------------------------------------------------------------
     def lowered(self, *inputs):

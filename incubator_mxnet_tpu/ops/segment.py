@@ -462,6 +462,11 @@ def flush_all():
         if not seg.flushed:
             try:
                 seg.flush()
+            # flush() has already restored by the time this handler runs:
+            # it clears its op/const refs and records self.error before
+            # re-raising (the SURVEY §5.3 deferred-error contract), so
+            # deferring `err` here cannot leak a donated buffer.
+            # mxlint: disable=donation-unrestored-on-error -- restored above
             except Exception as e:   # surface after flushing the rest
                 err = e
     if err is not None:

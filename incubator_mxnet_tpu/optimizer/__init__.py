@@ -70,7 +70,10 @@ def _jit_update(fn, donate=()):
     """Jit an update kernel donating weight+state buffers so XLA aliases
     them in place (≙ the reference's in-place FCompute updates)."""
     import jax
-    return jax.jit(fn, donate_argnums=donate)
+    from .. import sanitize as _sanitize
+    return _sanitize.maybe_wrap_donated(
+        jax.jit(fn, donate_argnums=donate), donate,
+        f"optimizer.{getattr(fn, '__name__', 'update')}")
 
 
 class Optimizer:
@@ -384,7 +387,10 @@ class Optimizer:
                 finally:
                     opt.rescale_grad = prev  # mxlint: disable=trace-closure-mutation -- restore of the trace-time swap
 
-            cached = jax.jit(f, donate_argnums=(0, 2))
+            from .. import sanitize as _sanitize
+            cached = _sanitize.maybe_wrap_donated(
+                jax.jit(f, donate_argnums=(0, 2)), (0, 2),
+                "optimizer.aggregate_step")
             self._jitted[key] = cached
 
         wbufs = [w._arr for _, w, _, _ in items]

@@ -311,4 +311,7 @@ class ShardedOptimizer:
             return tuple(new_w) + tuple(jtu.tree_leaves(new_s))
 
         donate = tuple(range(nw)) + tuple(range(2 * nw, 2 * nw + ns))
-        return jax.jit(f, donate_argnums=donate)
+        from .. import sanitize as _sanitize
+        return _sanitize.maybe_wrap_donated(
+            jax.jit(f, donate_argnums=donate), donate,
+            "optimizer.sharded_step")

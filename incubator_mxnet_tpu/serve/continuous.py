@@ -96,6 +96,7 @@ import numpy as _np
 
 from ..base import MXNetError, get_env
 from .. import fault as _fault
+from .. import sanitize as _sanitize
 from ..telemetry import (record_span, trace as _trace, mem_on_oom,
                          mem_install_oom_hook)
 from .batcher import (ServeError, QueueFullError, RequestTimeout,
@@ -717,8 +718,10 @@ class CachedDecoder:
         key = int(window)
         fn = self._prefills.get(key)
         if fn is None:
-            fn = jax.jit(_make_prefill(self.config, window=key),
-                         donate_argnums=(1, 2))
+            fn = _sanitize.maybe_wrap_donated(
+                jax.jit(_make_prefill(self.config, window=key),
+                        donate_argnums=(1, 2)),
+                (1, 2), f"prefill[w={key}]")
             self._prefills[key] = fn
         return fn
 
@@ -735,9 +738,11 @@ class CachedDecoder:
                int(extent if extent is not None else self.config.max_len))
         fn = self._chunks.get(key)
         if fn is None:
-            fn = jax.jit(_make_chunk_prefill(self.config, window=key[0],
-                                             extent=key[1]),
-                         donate_argnums=(1, 2))
+            fn = _sanitize.maybe_wrap_donated(
+                jax.jit(_make_chunk_prefill(self.config, window=key[0],
+                                            extent=key[1]),
+                        donate_argnums=(1, 2)),
+                (1, 2), f"chunk_prefill[w={key[0]},e={key[1]}]")
             self._chunks[key] = fn
         return fn
 
@@ -747,7 +752,9 @@ class CachedDecoder:
         attention math, donated like every other slab consumer."""
         import jax
         if self._copy is None:
-            self._copy = jax.jit(_copy_slot_rows, donate_argnums=(0, 1))
+            self._copy = _sanitize.maybe_wrap_donated(
+                jax.jit(_copy_slot_rows, donate_argnums=(0, 1)),
+                (0, 1), "copy_slot_rows")
         return self._copy
 
     def decode_program(self, steps, eos_id=None, draft=0):
@@ -766,7 +773,9 @@ class CachedDecoder:
             else:
                 built = _make_decode(self.config, steps=key[0],
                                      eos_id=eos_id)
-            fn = jax.jit(built, donate_argnums=(1, 2))
+            fn = _sanitize.maybe_wrap_donated(
+                jax.jit(built, donate_argnums=(1, 2)), (1, 2),
+                f"decode[s={key[0]},eos={key[1]},d={key[2]}]")
             self._decodes[key] = fn
         return fn
 
@@ -1171,6 +1180,7 @@ class ContinuousEngine:
         self._drain = True
         self._started = False
         self._warm_cache_size = None
+        self._canary = None
         self.warmup_s = None
         self._thread = threading.Thread(
             target=self._loop, name=f"{name}-scheduler", daemon=True)
@@ -1204,6 +1214,14 @@ class ContinuousEngine:
             self._warm_cache_size = self.model.compile_cache_size()
             self._started = True
         self.warmup_s = round(time.perf_counter() - t0, 3)
+        if _sanitize.enabled("retrace"):
+            # warmup compiled everything; from here any growth is a
+            # broken zero-retrace contract (polled once per decode wave)
+            _sanitize.arm()
+        if _sanitize.enabled("slot"):
+            with self._cv:
+                if self._canary is None:
+                    self._canary = _sanitize.SlotCanary(self.pool)
         _trace.install_crash_hooks()
         mem_install_oom_hook()
         self._thread.start()
@@ -1300,6 +1318,10 @@ class ContinuousEngine:
             _fail(req, ServerClosed("engine closed before admission"))
         if self._started:
             self._thread.join(timeout=timeout)
+        with self._cv:
+            canary, self._canary = self._canary, None
+        if canary is not None:
+            canary.release()
 
     def __exit__(self, *exc):
         self.close()
@@ -1615,6 +1637,9 @@ class ContinuousEngine:
                 # on 'Array has been deleted'. Every in-flight request
                 # was just failed, so zeroed slabs are the correct state.
                 self.pool.reallocate()
+                if self._canary is not None:
+                    # fresh zeroed slabs replaced the poisoned row
+                    self._canary.rearm()
                 if self._cache is not None:
                     # the reallocation zeroed the slab: every cached
                     # prefix's KV bytes are gone, so the index goes too
@@ -1918,6 +1943,9 @@ class ContinuousEngine:
             k, v, out_toks, emitted = self._decode_prog(*args)
             out_host = _np.asarray(out_toks)    # (decode_steps, S)
         self.pool.swap_buffers(k, v)
+        if self._canary is not None:
+            self._canary.check(where="serve.decode")
+        _sanitize.poll(where="serve.decode")
         emitted_host = _np.asarray(emitted)
         now = time.perf_counter()
         n_active = len(running)

@@ -1,0 +1,97 @@
+"""mxlint fixture: seeded donation-safety violations. NEVER imported —
+the analyzer parses it; tests/test_lint.py asserts each rule fires
+exactly where expected, that the clean idioms stay silent, and that
+suppressions work."""
+import jax
+
+step = jax.jit(lambda w, g: w - g, donate_argnums=(0,))
+
+
+def decode_program(width):
+    def _decode(params, k_cache, v_cache, toks):
+        return toks, k_cache, v_cache
+
+    return jax.jit(_decode, donate_argnums=(1, 2))
+
+
+class Engine:
+    def __init__(self, model):
+        self._decode = decode_program(8)
+
+    # -- donation-use-after-donate ----------------------------------------
+    def use_after_donate(self, params, toks):
+        kb, vb = self.pool.buffers()
+        out, k2, v2 = self._decode(params, kb, vb, toks)
+        return out, kb                    # BAD: kb read after donation
+
+    def redonate_in_loop(self, params, toks):
+        # buffers fetched ONCE outside the steady loop: iteration 2
+        # donates the arrays iteration 1 already consumed
+        kb, vb = self.pool.buffers()
+        for _ in range(4):
+            out, k2, v2 = self._decode(params, kb, vb, toks)   # BAD: kb, vb
+        return out
+
+    def rebind_is_clean(self, params, toks):
+        kb, vb = self.pool.buffers()
+        out, kb, vb = self._decode(params, kb, vb, toks)
+        return out, kb                    # clean: kb rebound from output
+
+    def branches_are_exclusive(self, params, toks, kb, vb, draft):
+        # sibling returns must not cross-poison each other
+        if draft > 0:
+            return self._decode(params, kb, vb, draft)
+        return self._decode(params, kb, vb, toks)
+
+    def suppressed_use(self, params, toks):
+        kb, vb = self.pool.buffers()
+        out, k2, v2 = self._decode(params, kb, vb, toks)
+        return kb  # mxlint: disable=donation-use-after-donate -- on purpose
+
+    # -- donation-unrestored-on-error -------------------------------------
+    def swallow_without_restore(self, params, toks, kb, vb):
+        try:
+            out, kb, vb = self._decode(params, kb, vb, toks)
+        except Exception:                 # BAD: swallows, no restore
+            out = None
+        return out
+
+    def swallow_via_helper(self, params, toks):
+        # the donated call is one level down; the handler still swallows
+        try:
+            out = self.run_wave(params, toks)
+        except Exception:                 # BAD: transitive donated call
+            out = None
+        return out
+
+    def run_wave(self, params, toks):
+        kb, vb = self.pool.buffers()
+        out, kb, vb = self._decode(params, kb, vb, toks)
+        return out
+
+    def restore_is_clean(self, params, toks, kb, vb):
+        try:
+            out, kb, vb = self._decode(params, kb, vb, toks)
+        except Exception:                 # clean: restores the pool
+            self.pool.reallocate()
+            out = None
+        return out
+
+    def reraise_is_clean(self, params, toks, kb, vb):
+        try:
+            out, kb, vb = self._decode(params, kb, vb, toks)
+        except Exception as e:            # clean: re-raises
+            raise RuntimeError("decode died") from e
+        return out
+
+    def narrow_handler_is_clean(self, params, toks, kb, vb):
+        try:
+            out, kb, vb = self._decode(params, kb, vb, toks)
+        except KeyError:                  # clean: cannot swallow a
+            out = None                    # compiled program's failure
+        return out
+
+
+def module_level_use(w, g):
+    w2 = step(w, g)
+    return w + w2                         # BAD: w read after donation

@@ -1,0 +1,65 @@
+"""mxlint fixture: seeded retrace-hazard violations. NEVER imported —
+the analyzer parses it; tests/test_lint.py asserts each rule fires
+exactly where expected and that the padded/steady idioms stay silent."""
+import jax
+import jax.numpy as jnp
+
+prog = jax.jit(lambda toks, n: toks * n, static_argnums=(1,))
+
+
+def decode_program(width):
+    def _decode(params, toks):
+        return toks
+
+    return jax.jit(_decode)
+
+
+class Engine:
+    def __init__(self, model):
+        self._decode = decode_program(8)
+
+    # -- retrace-shape-from-data ------------------------------------------
+    def shape_leak_loop(self, params, queue):
+        while True:
+            batch = queue.get()
+            toks = jnp.zeros((len(batch), 8))        # BAD: data-driven dim
+            out = self._decode(params, toks)
+
+    def shape_attr_leak(self, params, queue):
+        for req in queue:
+            buf = req.tokens
+            out = self._decode(params, buf.shape[0])  # BAD: .shape arg
+
+    def padded_is_clean(self, params, queue, width):
+        while True:
+            batch = queue.get()
+            toks = jnp.zeros((16, width))             # clean: fixed shape
+            out = self._decode(params, toks)
+
+    # -- retrace-unstable-static-arg --------------------------------------
+    def static_from_data(self, params, queue):
+        while True:
+            batch = queue.get()
+            n = len(batch)
+            out = prog(params, n)                     # BAD: varying static
+
+    def static_constant_is_clean(self, params, queue):
+        while True:
+            batch = queue.get()
+            out = prog(params, 16)                    # clean: literal
+
+    # -- retrace-unordered-pytree -----------------------------------------
+    def unordered_tree(self, params, queue):
+        for req in queue:
+            tree = {k: req[k] for k in set(req.keys())}   # BAD: set order
+            out = self._decode(params, tree)
+
+    def sorted_tree_is_clean(self, params, queue):
+        for req in queue:
+            tree = {k: req[k] for k in sorted(req.keys())}  # clean
+            out = self._decode(params, tree)
+
+
+def unhashable_static_outside_loop(params):
+    # fires everywhere, not only in steady loops: TypeError at call time
+    return prog(params, [1, 2, 3])                    # BAD: list static

@@ -54,6 +54,10 @@ def counter(name, help=""):
 
 TELE_STATS = stats_group("tele", {"good": 0, "lonely": 0})
 
+# family never quoted with its dotted prefix in tests -> stats-family-
+# untested (the key "hits" itself IS covered via PIPE_STATS's test)
+COLD_STATS = stats_group("cold", {"hits": 0})
+
 
 def g():
     counter("tele.obj_documented")

@@ -1,5 +1,10 @@
 """Fixture test file: exercises one PIPE_STATS key but not the other, both
-TELE_STATS keys, and the documented object metric."""
+TELE_STATS keys, the documented object metric, and (for the fault-coverage
+rules) two of the three registered fault points plus one ghost point."""
+
+MXNET_FAULT_SPEC = "alpha.save:1:error"     # drills a registered point
+BAD_SPEC = "zeta.ghost:1:error"             # names a point that is NOT
+                                            # registered -> inert spec
 
 
 def check_hits():
@@ -9,3 +14,8 @@ def check_hits():
 def check_tele():
     assert "good" and "lonely"
     assert "tele.obj_documented"
+    assert "tele.good" and "tele.lonely"    # dotted family coverage
+
+
+def check_faults(inject):
+    inject("gamma.run")                     # quoted-point drill

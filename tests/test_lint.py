@@ -21,9 +21,9 @@ import sys
 import pytest
 
 from incubator_mxnet_tpu import analysis
-from incubator_mxnet_tpu.analysis import (lock_discipline,
+from incubator_mxnet_tpu.analysis import (donation_safety, lock_discipline,
                                           registry_consistency,
-                                          trace_safety)
+                                          retrace_hazard, trace_safety)
 from incubator_mxnet_tpu.analysis.core import Baseline, Module
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -229,7 +229,17 @@ def test_registry_consistency_fixture_findings():
     assert {f.symbol for f in by["fault-point-unregistered"]} == \
         {"delta.crash"}
     assert {f.symbol for f in by["fault-doc-stale"]} == {"old.gone"}
+    # fault coverage, both directions: alpha.save is drilled by the spec
+    # literal in tests/cov_file.py and gamma.run by its quoted-point
+    # mention; beta.load is never named -> untested. The fixture's
+    # BAD_SPEC names an unregistered point -> inert spec.
+    assert {f.symbol for f in by["fault-point-untested"]} == {"beta.load"}
+    assert {f.symbol for f in by["fault-test-unknown-point"]} == \
+        {"zeta.ghost"}
     assert {f.symbol for f in by["stats-key-untested"]} == {"misses"}
+    # COLD_STATS' family never appears with its dotted prefix in any
+    # test; "tele." does (cov_file.py), so only "cold" fires
+    assert {f.symbol for f in by["stats-family-untested"]} == {"cold"}
     # telemetry surface: stats_group adoptions + literal object metrics vs
     # the OBSERVABILITY.md catalog (both directions) and tests
     assert {f.symbol for f in by["telemetry-metric-undocumented"]} == \
@@ -389,3 +399,134 @@ def test_cli_exit_one_on_violation(tmp_path):
     data = json.loads(r.stdout)
     assert data["counts"]["new"] == 1
     assert data["findings"][0]["rule"] == "trace-impure-host"
+
+
+# ---------------------------------------------------------------------------
+# donation-safety fixture
+# ---------------------------------------------------------------------------
+def _run_suppressed(pass_mod, mod):
+    """Pass output minus inline suppressions (run_all's central filter)."""
+    return [f for f in pass_mod.run([mod])
+            if not mod.suppressed(f.rule, f.line)]
+
+
+def test_donation_safety_fixture_findings():
+    mod = _fixture_module("bad_donation.py")
+    by = _by_rule(_run_suppressed(donation_safety, mod))
+
+    use = {(f.scope, f.symbol) for f in by["donation-use-after-donate"]}
+    assert ("Engine.use_after_donate", "kb") in use
+    # buffers fetched once outside the loop: iteration 2 re-donates dead
+    # arrays (both positions)
+    assert ("Engine.redonate_in_loop", "kb") in use
+    assert ("Engine.redonate_in_loop", "vb") in use
+    # a module-level program donated via `step(w, g)` then `w` read
+    assert ("module_level_use", "w") in use
+    # negatives: rebinding from output / exclusive branches / suppression
+    scopes = {f.scope for f in by["donation-use-after-donate"]}
+    assert "Engine.rebind_is_clean" not in scopes
+    assert "Engine.branches_are_exclusive" not in scopes
+    assert "Engine.suppressed_use" not in scopes
+
+    err = {(f.scope, f.symbol) for f in by["donation-unrestored-on-error"]}
+    assert ("Engine.swallow_without_restore", "self._decode") in err
+    # the donated call one helper down still counts (the PR-14 shape)
+    assert ("Engine.swallow_via_helper", "self.run_wave()") in err
+    err_scopes = {f.scope for f in by["donation-unrestored-on-error"]}
+    assert "Engine.restore_is_clean" not in err_scopes
+    assert "Engine.reraise_is_clean" not in err_scopes
+    assert "Engine.narrow_handler_is_clean" not in err_scopes
+
+
+def test_retrace_hazard_fixture_findings():
+    mod = _fixture_module("bad_retrace.py")
+    by = _by_rule(_run_suppressed(retrace_hazard, mod))
+
+    shape = {(f.scope, f.symbol) for f in by["retrace-shape-from-data"]}
+    assert ("Engine.shape_leak_loop", "zeros:len(...)") in shape
+    assert ("Engine.shape_attr_leak", "arg1:buf.shape") in shape
+    assert "Engine.padded_is_clean" not in {s for s, _ in shape}
+
+    static = {(f.scope, f.symbol)
+              for f in by["retrace-unstable-static-arg"]}
+    assert ("Engine.static_from_data", "static1") in static
+    # unhashable literals fire OUTSIDE steady loops too (TypeError class)
+    assert ("unhashable_static_outside_loop", "static1") in static
+    assert "Engine.static_constant_is_clean" not in {s for s, _ in static}
+
+    tree = {f.scope for f in by["retrace-unordered-pytree"]}
+    assert "Engine.unordered_tree" in tree
+    assert "Engine.sorted_tree_is_clean" not in tree
+
+
+# ---------------------------------------------------------------------------
+# hand-reverted real bugs (ISSUE 20 acceptance): re-introduce each PR-14
+# bug class in a SCRATCH copy of the live engine source; the pass must
+# flag the scratch copy while the live file stays clean
+# ---------------------------------------------------------------------------
+def _scratch_engine(replacing, replacement):
+    path = os.path.join(REPO, "incubator_mxnet_tpu", "serve",
+                        "continuous.py")
+    with open(path) as f:
+        src = f.read()
+    assert replacing in src, "hand-revert anchor drifted; update the test"
+    return Module(path, os.path.join("incubator_mxnet_tpu", "serve",
+                                     "continuous.py"),
+                  src.replace(replacing, replacement))
+
+
+def test_donation_safety_flags_reverted_pr14_pool_bug():
+    # the PR-14 bug: the engine loop's exception handler forgot
+    # pool.reallocate(), leaving donated KV slabs dead for every later
+    # wave. Reverting the fix must produce exactly the finding class
+    # this pass was built for — anchored at the loop's broad handler.
+    mod = _scratch_engine("self.pool.reallocate()", "pass")
+    by = _by_rule(_run_suppressed(donation_safety, mod))
+    hits = [f for f in by.get("donation-unrestored-on-error", ())
+            if f.scope.endswith("._loop")]
+    assert hits, "reverted pool.reallocate() bug was not flagged"
+    # the live file (reallocate present) is clean in that scope
+    live = _fixture_live_engine()
+    by_live = _by_rule(_run_suppressed(donation_safety, live))
+    assert not [f for f in by_live.get("donation-unrestored-on-error", ())
+                if f.scope.endswith("._loop")]
+
+
+def test_retrace_hazard_flags_planted_shape_drift():
+    # the PR-14-adjacent drift: sizing the prefill batch from len(cold)
+    # instead of the fixed lane count retraces every distinct batch size
+    mod = _scratch_engine("toks = _np.zeros((P, W), dtype=_np.int32)",
+                          "toks = _np.zeros((len(cold), W), "
+                          "dtype=_np.int32)")
+    by = _by_rule(_run_suppressed(retrace_hazard, mod))
+    assert any(f.symbol == "zeros:len(...)"
+               for f in by.get("retrace-shape-from-data", ()))
+    live = _fixture_live_engine()
+    by_live = _by_rule(_run_suppressed(retrace_hazard, live))
+    assert not by_live.get("retrace-shape-from-data")
+
+
+def _fixture_live_engine():
+    path = os.path.join(REPO, "incubator_mxnet_tpu", "serve",
+                        "continuous.py")
+    with open(path) as f:
+        src = f.read()
+    return Module(path, os.path.join("incubator_mxnet_tpu", "serve",
+                                     "continuous.py"), src)
+
+
+def test_cli_timing_budget():
+    """The full analysis run must fit its CI budget (ISSUE 20): the
+    analyzer re-parses the whole package per run, so an accidentally
+    quadratic pass shows up here long before it stalls the tier-1
+    suite. --timing enforces the 30s default budget (exit 1 when over)
+    and prints the wall time for the log."""
+    r = _run_cli("--timing")
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "mxlint --timing: full run" in r.stdout
+    assert "OVER BUDGET" not in r.stdout
+    # a deliberately impossible budget must fail loudly, proving the
+    # guard is live (not a formatting-only flag)
+    r = _run_cli("--timing", "--budget-s", "0.001")
+    assert r.returncode == 1
+    assert "OVER BUDGET" in r.stdout

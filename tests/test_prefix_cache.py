@@ -201,3 +201,23 @@ def test_cache_stats_snapshot_tracks_residency_and_refs():
                   "resident_tokens": 8, "live_refs": 1}
     cache.release(entry)
     assert cache.stats()["live_refs"] == 0
+
+
+def test_prefix_family_dotted_telemetry_surface():
+    """Every PREFIX_STATS counter surfaces under the dotted `prefix.*`
+    telemetry names (the mxlint `stats-family-untested` coverage rule
+    requires the family's dotted export to be pinned by a test)."""
+    from incubator_mxnet_tpu import telemetry
+    before = telemetry.snapshot()
+    for name in ("prefix.hits", "prefix.misses", "prefix.cached_tokens",
+                 "prefix.evictions", "prefix.collisions"):
+        assert name in before, name
+    cache = PrefixCache(block=2, rows=[0])
+    cache.insert(_prompt(1, 2, 3, 4))
+    entry, n = cache.match(_prompt(1, 2, 3, 4, 5))  # acquiring lookup
+    if entry is not None:
+        cache.release(entry)
+    after = telemetry.snapshot()
+    # a live lookup moved the family's dotted counters, not just the dict
+    assert (after["prefix.hits"] + after["prefix.misses"]
+            > before["prefix.hits"] + before["prefix.misses"])

@@ -1,0 +1,258 @@
+"""mx.sanitize — the runtime twin of the mxlint compiled-contract
+passes (ISSUE 20). Planted violations must trip with TYPED errors;
+real clean loops (engine, elastic) must stay silent; everything is off
+by default with a zero-cost wrapper."""
+import numpy as np
+import pytest
+
+from incubator_mxnet_tpu import sanitize, serve
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.serve.kv_pool import KVCachePool
+
+
+CFG = dict(vocab=64, embed=32, layers=2, heads=4, head_dim=8, max_len=48)
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    yield
+    sanitize.clear()
+
+
+def _prog(donate=(0,)):
+    import jax
+    return sanitize.maybe_wrap_donated(
+        jax.jit(lambda w, g: w - g, donate_argnums=donate),
+        donate, "step")
+
+
+# ---------------------------------------------------------------------------
+# mode plumbing: off by default, zero-cost when off
+# ---------------------------------------------------------------------------
+def test_off_by_default_wrapper_is_identity():
+    import jax
+    assert sanitize.modes() == frozenset()
+    f = jax.jit(lambda x: x, donate_argnums=(0,))
+    assert sanitize.maybe_wrap_donated(f, (0,), "t") is f
+
+
+def test_mode_parsing(monkeypatch):
+    monkeypatch.setenv("MXNET_SANITIZE", "donation, retrace")
+    assert sanitize.modes() == frozenset({"donation", "retrace"})
+    monkeypatch.setenv("MXNET_SANITIZE", "all")
+    assert sanitize.modes() == frozenset({"donation", "retrace", "slot"})
+    monkeypatch.setenv("MXNET_SANITIZE", "turbo")
+    with pytest.raises(MXNetError, match="unknown mode"):
+        sanitize.modes()
+
+
+def test_scope_overrides_and_restores():
+    assert not sanitize.enabled("donation")
+    with sanitize.scope("donation"):
+        assert sanitize.enabled("donation")
+        assert not sanitize.enabled("slot")
+    assert not sanitize.enabled("donation")
+
+
+# ---------------------------------------------------------------------------
+# donation mode
+# ---------------------------------------------------------------------------
+def test_donation_use_after_donate_trips_with_provenance():
+    import jax.numpy as jnp
+    with sanitize.scope("donation"):
+        step = _prog()
+        w = jnp.ones((4,))
+        step(w, jnp.ones((4,)))
+        with pytest.raises(sanitize.DonationViolation) as ei:
+            step(w, jnp.ones((4,)))          # w was consumed above
+        msg = str(ei.value)
+        assert "argument 0" in msg and "`step`" in msg
+        assert isinstance(ei.value, MXNetError)
+
+
+def test_donation_rebind_from_output_is_silent():
+    import jax.numpy as jnp
+    with sanitize.scope("donation"):
+        step = _prog()
+        w = jnp.ones((4,))
+        for _ in range(4):
+            w = step(w, jnp.ones((4,)))      # clean: rebinds each wave
+        assert float(w[0]) == -3.0
+
+
+def test_donation_deletes_consumed_buffer_like_tpu_would():
+    # CPU donation is a no-op; the sanitizer makes the donated leaf die
+    # for real, so the silent-on-CPU bug class fails in CI too
+    import jax.numpy as jnp
+    with sanitize.scope("donation"):
+        step = _prog()
+        w = jnp.ones((4,))
+        step(w, jnp.ones((4,)))
+        assert w.is_deleted()
+
+
+# ---------------------------------------------------------------------------
+# retrace mode
+# ---------------------------------------------------------------------------
+def test_retrace_poll_noop_until_armed():
+    import jax.numpy as jnp
+    with sanitize.scope("retrace"):
+        step = _prog()
+        step(jnp.ones((4,)), jnp.ones((4,)))
+        sanitize.poll("never armed")         # silent
+
+
+def test_retrace_growth_after_arm_trips_with_drift():
+    import jax.numpy as jnp
+    with sanitize.scope("retrace"):
+        step = _prog()
+        step(jnp.ones((4,)), jnp.ones((4,)))
+        sanitize.arm()
+        sanitize.poll("steady")              # silent: no growth
+        step(jnp.ones((8,)), jnp.ones((8,)))  # shape drift -> recompile
+        with pytest.raises(sanitize.RetraceViolation) as ei:
+            sanitize.poll("steady")
+        msg = str(ei.value)
+        assert "`step`" in msg and "(4,)" in msg and "(8,)" in msg
+
+
+def test_retrace_new_program_variant_after_arm_trips():
+    import jax.numpy as jnp
+    with sanitize.scope("retrace"):
+        step = _prog()
+        step(jnp.ones((4,)), jnp.ones((4,)))
+        sanitize.arm()
+        late = _prog()                       # a variant born after warmup
+        late(jnp.ones((2,)), jnp.ones((2,)))
+        with pytest.raises(sanitize.RetraceViolation, match="NEW program"):
+            sanitize.poll("steady")
+
+
+def test_steady_state_context_manager():
+    import jax.numpy as jnp
+    with sanitize.scope("retrace"):
+        step = _prog()
+        step(jnp.ones((4,)), jnp.ones((4,)))
+        with sanitize.steady_state("region"):
+            step(jnp.ones((4,)), jnp.ones((4,)))   # same shape: fine
+        with pytest.raises(sanitize.RetraceViolation):
+            with sanitize.steady_state("region"):
+                step(jnp.ones((16,)), jnp.ones((16,)))
+
+
+# ---------------------------------------------------------------------------
+# slot mode: the canary row
+# ---------------------------------------------------------------------------
+def test_slot_canary_silent_then_trips_on_corruption():
+    pool = KVCachePool(2, layers=1, max_len=8, heads=2, head_dim=4)
+    canary = sanitize.SlotCanary(pool)
+    canary.check("wave")                     # sentinel intact
+    canary.check("wave")
+    # a program writing through the slot masks would look like this:
+    pool.k = pool.k.at[canary.slot].set(0.0)
+    # the probe is pipelined one wave deep: the corrupt probe is read
+    # on the NEXT check, so the trip surfaces at most one wave late
+    with pytest.raises(sanitize.SlotCanaryError) as ei:
+        canary.check("wave")
+        canary.check("wave")
+    assert f"slot {canary.slot}" in str(ei.value)
+    canary.rearm()
+    canary.check("wave")                     # re-poisoned: clean again
+    canary.check("wave")
+    canary.release()
+
+
+def test_slot_canary_survives_reallocate_via_rearm():
+    pool = KVCachePool(2, layers=1, max_len=8, heads=2, head_dim=4)
+    canary = sanitize.SlotCanary(pool)
+    pool.reallocate()                        # slab replaced wholesale
+    canary.rearm()
+    canary.check("after-reallocate")
+    canary.check("after-reallocate")
+    canary.release()
+
+
+# ---------------------------------------------------------------------------
+# engine integration: silent on a clean loop, typed errors on breaches
+# ---------------------------------------------------------------------------
+def _engine(model, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("decode_steps", 3)
+    return serve.ContinuousEngine(model, **kw)
+
+
+def _workload(eng, n=6, seed=0):
+    rng = np.random.RandomState(seed)
+    futs = [eng.submit(rng.randint(1, 64,
+                                   size=rng.randint(2, 12)).tolist(),
+                       int(rng.randint(1, 10))) for _ in range(n)]
+    return [f.result(timeout=120) for f in futs]
+
+
+def test_engine_clean_loop_silent_under_all_modes():
+    with sanitize.scope("all"):
+        model = serve.CachedDecoder(serve.DecoderConfig(**CFG), seed=3)
+        with _engine(model) as eng:
+            assert eng._canary is not None   # slot mode claimed its row
+            outs = _workload(eng)
+            assert all(len(o) >= 1 for o in outs)
+            assert eng._canary.waves > 0     # checked every decode wave
+            assert eng.compile_cache_size() == eng._warm_cache_size
+
+
+def test_engine_slot_canary_catches_out_of_mask_write():
+    with sanitize.scope("slot"):
+        model = serve.CachedDecoder(serve.DecoderConfig(**CFG), seed=3)
+        with _engine(model) as eng:
+            _workload(eng, n=2)
+            # corrupt the canary row the way a mask-escaping scatter
+            # would; the NEXT decode wave's check fails its requests
+            # with the typed error, then the engine recovers
+            eng.pool.k = eng.pool.k.at[eng._canary.slot].set(0.0)
+            f = eng.submit([1, 2, 3], 6)
+            with pytest.raises(sanitize.SlotCanaryError):
+                f.result(timeout=120)
+            # handler reallocated + re-poisoned: engine keeps serving
+            out = eng.submit([4, 5, 6], 4).result(timeout=120)
+            assert len(out) >= 1
+
+
+def test_engine_retrace_sentinel_catches_post_warmup_variant():
+    with sanitize.scope("retrace"):
+        model = serve.CachedDecoder(serve.DecoderConfig(**CFG), seed=3)
+        with _engine(model) as eng:
+            _workload(eng, n=2)
+            # a bypassing caller compiles a prefill width the warmup
+            # never saw — exactly the drift the static pass hunts
+            import jax.numpy as jnp
+            side = model.new_pool(2)
+            kb, vb = side.buffers()
+            model.prefill(kb, vb,
+                          jnp.ones((1, 7), dtype=jnp.int32),
+                          jnp.full((1,), 7, dtype=jnp.int32),
+                          jnp.zeros((1,), dtype=jnp.int32))
+            f = eng.submit([1, 2, 3], 6)
+            with pytest.raises(sanitize.RetraceViolation):
+                f.result(timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# elastic integration: clean training loop stays silent
+# ---------------------------------------------------------------------------
+def test_elastic_clean_loop_silent_under_retrace():
+    from incubator_mxnet_tpu.fault.elastic import ElasticTrainer
+
+    def loss_fn(params, batch):
+        import jax.numpy as jnp
+        x, y = batch
+        pred = x @ params["w"]
+        return jnp.mean((pred - y) ** 2)
+
+    rng = np.random.RandomState(0)
+    params = {"w": rng.randn(4, 2).astype(np.float32)}
+    with sanitize.scope("retrace"):
+        tr = ElasticTrainer(loss_fn, params=params, optimizer="sgd")
+        batch = (rng.randn(8, 4).astype(np.float32),
+                 rng.randn(8, 2).astype(np.float32))
+        losses = [tr.step(batch) for _ in range(3)]
+        assert all(np.isfinite(l) for l in losses)

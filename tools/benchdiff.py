@@ -128,6 +128,12 @@ TREND_KEYS = {
     # failed trials are gated absolutely below (healthy baseline is 0)
     "tune_profile_vs_hand_speedup": "higher",
     "tune_trials_failed": "lower",
+    # sanitize phase (PR 20, mx.sanitize): the runtime contract
+    # sanitizer's serve-bench overhead in percent — gated ABSOLUTELY
+    # (the healthy committed baseline is a few percent, so a ratio
+    # threshold would fire on harmless jitter around a small number);
+    # the ISSUE-20 ceiling is 5%, the gate trips on a 2-point worsening
+    "sanitize_overhead_pct": "lower",
 }
 
 # floor metrics whose healthy committed baseline IS 0 (a ratio threshold
@@ -139,6 +145,7 @@ ABS_THRESHOLDS = {
     "leakcheck_growth_mb": 1.0,     # a real leak is tens of MB/round
     "fleet_swap_dropped_requests": 0.5,   # ANY dropped request regresses
     "tune_trials_failed": 0.5,      # ANY crashed sweep trial regresses
+    "sanitize_overhead_pct": 2.0,   # 2-point overhead creep regresses
 }
 
 DEFAULT_THRESHOLD = 0.10
@@ -536,6 +543,18 @@ def self_test():
                        serve_ttft_p99_ms_interference=8.0))
     check("improving prefill keys pass with improvements reported",
           rep["status"] == "ok" and len(rep["improvements"]) == 2)
+    # sanitize key (PR 20, mx.sanitize): overhead is gated on ABSOLUTE
+    # percentage points — around a small healthy baseline (a couple of
+    # percent) a ratio threshold would trip on pure jitter, while a real
+    # sanitizer cost explosion is a many-point jump
+    san_base = {"backend_ok": True, "sanitize_overhead_pct": 1.5}
+    rep = compare(san_base, dict(san_base, sanitize_overhead_pct=6.5))
+    check("sanitizer overhead creep past 2 points is a regression",
+          rep["status"] == "regression"
+          and rep["regressions"][0]["key"] == "sanitize_overhead_pct")
+    rep = compare(san_base, dict(san_base, sanitize_overhead_pct=2.8))
+    check("sub-2-point sanitizer overhead jitter stays ok",
+          rep["status"] == "ok" and rep["compared"] == 1)
     missing_only_new = {"backend_ok": True,
                         "io_pipeline_images_per_sec": 700.0}
     check("keys missing from one side are skipped, not regressions",

@@ -253,6 +253,123 @@ def _flightrec_mode(workdir, kill_at, run_child, point):
     return 0
 
 
+def _sanitize_child(args):
+    """Plant a use-after-donate and report what the process saw. With
+    MXNET_SANITIZE=donation the wrapper must trap it as a typed
+    DonationViolation at the offending call; with the sanitizer off the
+    bug either sails through silently (platforms where donation is a
+    no-op) or dies with an anonymous buffer-deleted error that names
+    neither the program nor the argument."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import sanitize
+    step = sanitize.maybe_wrap_donated(
+        jax.jit(lambda w, g: w - 0.1 * g, donate_argnums=(0,)),
+        (0,), "crashtest.step")
+    w = jnp.ones((64,))
+    g = jnp.ones((64,))
+    result = {"modes": sorted(sanitize.modes()), "error_type": None,
+              "typed": False, "message": None}
+    try:
+        step(w, g)
+        bad = step(w, g)          # planted: w was donated one line up
+        float(jnp.sum(bad))       # force materialization either way
+    except sanitize.DonationViolation as e:
+        result.update(error_type="DonationViolation", typed=True,
+                      message=str(e)[:300])
+    except (RuntimeError, ValueError) as e:
+        # the anonymous runtime failure: no program name, no argument
+        # index, no hint of which call donated the buffer
+        result.update(error_type=type(e).__name__, message=str(e)[:300])
+    print(json.dumps(result))
+    return 0
+
+
+def _sanitize_mode(workdir):
+    """Run the planted use-after-donate twice — sanitizer armed and off —
+    and assert the armed arm produced the typed error + flightrec
+    artifacts while the off arm shows the silent-on-CPU failure mode."""
+    import glob
+
+    rec_dir = os.path.join(workdir, "flightrec")
+    base_env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep
+                + os.environ.get("PYTHONPATH", "")}
+    base_env.pop("MXNET_SANITIZE", None)
+
+    def run(tag, extra):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "--sanitize"],
+            env={**base_env, **extra}, capture_output=True, text=True,
+            timeout=300)
+        if proc.returncode != 0:
+            print(proc.stdout + proc.stderr, file=sys.stderr)
+            print(f"crashtest: sanitize {tag} child failed",
+                  file=sys.stderr)
+            return None
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    armed = run("armed", {"MXNET_SANITIZE": "donation",
+                          "MXNET_FLIGHTREC_DIR": rec_dir})
+    if armed is None:
+        return 1
+    if not armed["typed"] or armed["error_type"] != "DonationViolation":
+        print(f"crashtest: armed run did NOT produce the typed "
+              f"DonationViolation: {armed}", file=sys.stderr)
+        return 1
+    if "crashtest.step" not in (armed["message"] or ""):
+        print(f"crashtest: violation lacks program provenance: "
+              f"{armed['message']}", file=sys.stderr)
+        return 1
+
+    # the black box: spooled violation event + rate-limited dump file
+    spools = glob.glob(os.path.join(rec_dir, "flightrec-*.jsonl"))
+    events = []
+    for path in spools:
+        with open(path) as f:
+            events += [json.loads(l) for l in f if l.strip()]
+    violations = [e for e in events
+                  if e.get("kind") == "sanitize.donation"]
+    if not violations:
+        print(f"crashtest: no sanitize.donation event spooled in "
+              f"{rec_dir} ({len(events)} events)", file=sys.stderr)
+        return 1
+    dumps = glob.glob(os.path.join(rec_dir, "flightrec-*.json"))
+    if not dumps:
+        print(f"crashtest: no flightrec dump file in {rec_dir}",
+              file=sys.stderr)
+        return 1
+
+    off = run("off", {})
+    if off is None:
+        return 1
+    if off["typed"] or off["error_type"] == "DonationViolation":
+        print(f"crashtest: UNSANITIZED run produced a typed violation "
+              f"({off}) — the sanitizer is leaking into the off arm",
+              file=sys.stderr)
+        return 1
+    if off["error_type"] is None:
+        contrast = ("unsanitized run sailed through SILENTLY (the bug "
+                    "class that only explodes on TPU)")
+    elif "crashtest.step" in (off["message"] or ""):
+        print(f"crashtest: unsanitized error unexpectedly carries "
+              f"provenance ({off['message']}) — harness premise changed",
+              file=sys.stderr)
+        return 1
+    else:
+        contrast = (f"unsanitized run died with an anonymous "
+                    f"{off['error_type']} carrying no program name or "
+                    f"argument index")
+
+    print(f"crashtest: sanitize OK — armed run trapped the planted "
+          f"use-after-donate as DonationViolation naming "
+          f"crashtest.step (flightrec: {len(violations)} violation "
+          f"event(s) spooled, dump {os.path.basename(dumps[0])}); "
+          f"{contrast}")
+    return 0
+
+
 def _oom_mode(workdir, kill_at, run_child):
     """Drive the OOM-forensics path: a planted allocation bomb under
     run_elastic must leave (a) a parseable flightrec spool recording the
@@ -502,6 +619,11 @@ def main(argv=None):
                     help="OOM-forensics mode: a planted allocation bomb "
                          "under run_elastic must leave an OOM dump "
                          "naming the planted owner as top census entry")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="sanitizer-parity mode: a planted use-after-"
+                         "donate must trap as a typed DonationViolation "
+                         "with a flightrec dump when MXNET_SANITIZE="
+                         "donation, and sail through silently when off")
     ap.add_argument("--fleet", action="store_true",
                     help="serving SIGKILL-parity mode: open-loop Poisson "
                          "traffic over a real 2-replica fleet, replica 0 "
@@ -519,9 +641,13 @@ def main(argv=None):
         args.elastic = True
 
     if args.child:
+        if args.sanitize:
+            return _sanitize_child(args)
         return _elastic_child(args) if args.elastic else _child(args)
 
     workdir = args.dir or tempfile.mkdtemp(prefix="mx_crashtest_")
+    if args.sanitize:
+        return _sanitize_mode(workdir)
     if args.fleet:
         return _fleet_mode(workdir, args)
     kill_at = args.kill_at or random.randint(2, max(2, args.steps - 1))

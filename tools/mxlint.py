@@ -93,6 +93,12 @@ def main(argv=None):
                     help="ignore the baseline (report everything)")
     ap.add_argument("--write-baseline", action="store_true",
                     help="write current findings as the new baseline")
+    ap.add_argument("--timing", action="store_true",
+                    help="print per-pass wall time and enforce the "
+                         "full-run budget (exit 1 when over)")
+    ap.add_argument("--budget-s", type=float, default=30.0,
+                    help="--timing budget in seconds (default 30; the "
+                         "tier-1 suite guards the full run under it)")
     ap.add_argument("--root", default=REPO, help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
@@ -123,8 +129,25 @@ def main(argv=None):
     baseline = analysis.Baseline() if args.no_baseline \
         else analysis.Baseline.load(bl_path)
 
+    import time
+    t0 = time.perf_counter()
     new, baselined, stale = analysis.run_all(
         root=args.root, files=files, passes=passes, baseline=baseline)
+    elapsed = time.perf_counter() - t0
+
+    if args.timing:
+        scope = ("quick" if args.quick
+                 else ("changed" if args.changed else "full"))
+        n_passes = len(passes or analysis.PASS_FAMILIES)
+        over = elapsed > args.budget_s
+        print(f"mxlint --timing: {scope} run, {n_passes} pass "
+              f"famil{'y' if n_passes == 1 else 'ies'}, "
+              f"{elapsed:.2f}s (budget {args.budget_s:.0f}s)"
+              + (" OVER BUDGET" if over else ""))
+        if over:
+            print("mxlint: analysis outgrew its CI budget — profile the "
+                  "newest pass before raising --budget-s", file=sys.stderr)
+            return 1
 
     if args.write_baseline:
         analysis.Baseline(path=bl_path).write(new + baselined)
